@@ -1,0 +1,32 @@
+"""TPUMounter: hot-attach / hot-detach TPU chips for running Kubernetes Pods.
+
+A TPU-native rebuild of the capabilities of cool9203/GPUMounter (reference at
+/root/reference): a master REST gateway fanning out over gRPC to per-node
+privileged workers which (a) allocate chips through scheduler-visible slave
+pods requesting ``google.com/tpu`` and (b) actuate the attachment on the host
+via cgroup device-permission control (v1 ``devices.allow`` file writes, v2 eBPF
+``BPF_CGROUP_DEVICE``) plus device-node creation inside the target container's
+mount namespace, so a running JAX process sees new chips via ``jax.devices()``
+without re-exec.
+
+Layer map (mirrors SURVEY.md §1; reference files cited per module):
+
+- :mod:`gpumounter_tpu.master`     — REST gateway  (ref ``cmd/GPUMounter-master``)
+- :mod:`gpumounter_tpu.api`        — RPC contract  (ref ``pkg/api/gpu-mount``)
+- :mod:`gpumounter_tpu.server`     — mount orchestration (ref ``pkg/server/gpu-mount``)
+- :mod:`gpumounter_tpu.allocator`  — slave-pod allocation (ref ``pkg/util/gpu/allocator``)
+- :mod:`gpumounter_tpu.collector`  — device discovery + kubelet PodResources
+  reconciliation (ref ``pkg/util/gpu/collector``)
+- :mod:`gpumounter_tpu.actuation`  — cgroup + namespace host actuation
+  (ref ``pkg/util``, ``pkg/util/cgroup``, ``pkg/util/namespace``)
+- :mod:`gpumounter_tpu.device`     — device model + native enumerator binding
+  (ref ``pkg/device``, ``pkg/util/gpu/collector/nvml``)
+- :mod:`gpumounter_tpu.k8s`        — minimal Kubernetes REST client
+  (ref ``pkg/config``)
+- :mod:`gpumounter_tpu.parallel`   — JAX-side post-attach validation (ICI mesh
+  probe; no reference equivalent — TPU-specific acceptance harness)
+- :mod:`gpumounter_tpu.utils`      — logging, config, constants, errors
+  (ref ``pkg/util/log``, ``pkg/util/gpu/types.go``)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+"""Device model and enumeration (ref ``pkg/device``, ``pkg/util/gpu/collector/nvml``)."""
+
+from gpumounter_tpu.device.model import DeviceState, TPUChip
+
+__all__ = ["DeviceState", "TPUChip"]

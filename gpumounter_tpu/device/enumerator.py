@@ -1,0 +1,219 @@
+"""Chip enumeration and device busy-detection.
+
+This is the TPU analog of the reference's native NVML binding
+(``pkg/util/gpu/collector/nvml/nvml.go:75-119`` — Init, GetDeviceCount, handle
+by index/UUID, minor number, running processes). There is no NVML-like
+userspace library for TPU, so enumeration reads the kernel's own surfaces:
+
+- ``/dev/accel*`` char nodes (tpu_common driver) and ``/dev/vfio/*`` groups,
+- ``stat(2)`` for the (dynamic) major:minor,
+- ``/sys/class/accel/accelN/device`` symlinks for the PCI address,
+- ``/proc/devices`` to confirm which major belongs to the accel driver.
+
+Two implementations share the :class:`Enumerator` interface:
+
+- :class:`PyEnumerator` (this module) — pure-Python reference implementation,
+  also the harness for fixture trees in tests (BASELINE config 1's fake-device
+  node path).
+- ``NativeEnumerator`` (:mod:`gpumounter_tpu.device.native_enumerator`) — the
+  production path, backed by the C++ ``libtpuprobe.so`` (the analog of the
+  reference's cgo NVML binding being native, ``nvml_dl.go:30``).
+
+Busy detection: the reference asks the driver for per-GPU PIDs via NVML
+(``pkg/device/nvidia.go:58-87``) and intersects with cgroup PIDs
+(``pkg/util/util.go:184-189``). No TPU equivalent exists, so we invert it:
+given the container's cgroup PIDs, scan ``/proc/<pid>/fd`` for open fds on the
+chip's device nodes (SURVEY.md §7 "Busy detection without NVML").
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import re
+import stat as stat_mod
+
+from gpumounter_tpu.device.model import TPUChip
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.config import HostPaths
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("device.enumerator")
+
+_ACCEL_RE = re.compile(r"^accel(\d+)$")
+_VFIO_GROUP_RE = re.compile(r"^\d+$")
+
+
+class Enumerator(abc.ABC):
+    """Enumerate attachable chips on this node and probe device usage."""
+
+    @abc.abstractmethod
+    def enumerate(self) -> list[TPUChip]:
+        """Return all chips physically present on the node."""
+
+    @abc.abstractmethod
+    def device_open_pids(self, pids: list[int],
+                         device_paths: list[str]) -> list[int]:
+        """Subset of ``pids`` holding an open fd on any of ``device_paths``."""
+
+
+def read_proc_devices(proc_root: str) -> dict[str, int]:
+    """Parse ``/proc/devices`` char section into {driver_name: major}.
+
+    TPU majors are dynamic (unlike NVIDIA's fixed 195, ref nvidia.go:37), so
+    the authoritative major must be read from the running kernel.
+    """
+    majors: dict[str, int] = {}
+    path = os.path.join(proc_root, "devices")
+    try:
+        with open(path) as f:
+            in_char = False
+            for line in f:
+                line = line.strip()
+                if line.startswith("Character devices"):
+                    in_char = True
+                    continue
+                if line.startswith("Block devices"):
+                    break
+                if in_char and line:
+                    parts = line.split(None, 1)
+                    if len(parts) == 2 and parts[0].isdigit():
+                        majors[parts[1]] = int(parts[0])
+    except OSError:
+        logger.warning("cannot read %s; majors will come from stat only", path)
+    return majors
+
+
+def _stat_majmin(path: str) -> tuple[int, int] | None:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    if stat_mod.S_ISCHR(st.st_mode):
+        return os.major(st.st_rdev), os.minor(st.st_rdev)
+    return None
+
+
+def _pci_address(sys_root: str, index: int) -> str:
+    """Resolve the chip's PCI address from /sys/class/accel/accelN/device."""
+    link = os.path.join(sys_root, "class", "accel", f"accel{index}", "device")
+    try:
+        target = os.readlink(link)
+    except OSError:
+        return ""
+    return os.path.basename(target)
+
+
+class PyEnumerator(Enumerator):
+    """Pure-Python node scan; also drives fixture trees in tests.
+
+    ``allow_fake=True`` accepts *regular* files named ``accelN`` as fake chips
+    (BASELINE config 1: "single fake-device attach ... CPU-only node"), taking
+    major:minor from an optional sibling ``accelN.majmin`` fixture file
+    (``"<major>:<minor>"``) or defaulting to 0:index.
+    """
+
+    def __init__(self, host: HostPaths | None = None, allow_fake: bool = False):
+        self.host = host or HostPaths()
+        self.allow_fake = allow_fake
+
+    # -- enumeration -----------------------------------------------------------
+
+    def enumerate(self) -> list[TPUChip]:
+        chips = self._scan_accel()
+        if not chips:
+            chips = self._scan_vfio()
+        return chips
+
+    def _scan_accel(self) -> list[TPUChip]:
+        chips: list[TPUChip] = []
+        try:
+            entries = sorted(os.listdir(self.host.dev_root))
+        except OSError:
+            return chips
+        for name in entries:
+            m = _ACCEL_RE.match(name)
+            if not m:
+                continue
+            index = int(m.group(1))
+            path = os.path.join(self.host.dev_root, name)
+            majmin = _stat_majmin(path)
+            if majmin is None:
+                if not self.allow_fake or not os.path.isfile(path):
+                    continue
+                majmin = self._fixture_majmin(path, index)
+            chips.append(TPUChip(
+                index=index,
+                device_path=path,
+                major=majmin[0],
+                minor=majmin[1],
+                uuid=str(index),
+                pci_address=_pci_address(self.host.sys_root, index),
+            ))
+        return chips
+
+    def _scan_vfio(self) -> list[TPUChip]:
+        """VFIO-based nodes (v4/v5p): one group node per chip + shared
+        /dev/vfio/vfio container node, exposed as companion paths."""
+        vfio_dir = os.path.join(self.host.dev_root, "vfio")
+        chips: list[TPUChip] = []
+        try:
+            entries = sorted(os.listdir(vfio_dir),
+                             key=lambda n: (not n.isdigit(),
+                                            int(n) if n.isdigit() else 0))
+        except OSError:
+            return chips
+        container = os.path.join(vfio_dir, "vfio")
+        companions = (container,) if os.path.exists(container) else ()
+        index = 0
+        for name in entries:
+            if not _VFIO_GROUP_RE.match(name):
+                continue
+            path = os.path.join(vfio_dir, name)
+            majmin = _stat_majmin(path)
+            if majmin is None:
+                if not self.allow_fake or not os.path.isfile(path):
+                    continue
+                majmin = self._fixture_majmin(path, index)
+            chips.append(TPUChip(
+                index=index,
+                device_path=path,
+                major=majmin[0],
+                minor=majmin[1],
+                uuid=str(index),
+                companion_paths=companions,
+            ))
+            index += 1
+        return chips
+
+    @staticmethod
+    def _fixture_majmin(path: str, index: int) -> tuple[int, int]:
+        sidecar = path + ".majmin"
+        try:
+            with open(sidecar) as f:
+                major_s, minor_s = f.read().strip().split(":")
+                return int(major_s), int(minor_s)
+        except (OSError, ValueError):
+            return 0, index
+
+    # -- busy detection --------------------------------------------------------
+
+    def device_open_pids(self, pids: list[int],
+                         device_paths: list[str]) -> list[int]:
+        targets = set(device_paths)
+        busy: list[int] = []
+        for pid in pids:
+            fd_dir = os.path.join(self.host.proc_root, str(pid), "fd")
+            try:
+                fds = os.listdir(fd_dir)
+            except OSError:
+                continue  # process exited, or no permission
+            for fd in fds:
+                try:
+                    target = os.readlink(os.path.join(fd_dir, fd))
+                except OSError:
+                    continue
+                if target in targets:
+                    busy.append(pid)
+                    break
+        return busy

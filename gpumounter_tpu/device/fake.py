@@ -1,0 +1,42 @@
+"""In-memory fake enumerator for unit tests.
+
+SURVEY.md §4: the reference has no test infrastructure; BASELINE config 1
+dictates interface-extracted fakes for the enumerator, the kubelet client, and
+actuation. This is the enumerator fake.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from gpumounter_tpu.device.enumerator import Enumerator
+from gpumounter_tpu.device.model import TPUChip
+
+
+def make_chips(n: int, major: int = 120) -> list[TPUChip]:
+    return [
+        TPUChip(index=i, device_path=f"/dev/accel{i}", major=major, minor=i,
+                uuid=str(i), pci_address=f"0000:0{i}:00.0")
+        for i in range(n)
+    ]
+
+
+class FakeEnumerator(Enumerator):
+    def __init__(self, chips: list[TPUChip] | None = None,
+                 busy_pids: dict[str, list[int]] | None = None):
+        self.chips = chips if chips is not None else make_chips(4)
+        # device_path -> pids that "hold it open"
+        self.busy_pids = busy_pids or {}
+
+    def enumerate(self) -> list[TPUChip]:
+        return copy.deepcopy(self.chips)
+
+    def device_open_pids(self, pids: list[int],
+                         device_paths: list[str]) -> list[int]:
+        out: list[int] = []
+        for pid in pids:
+            for path in device_paths:
+                if pid in self.busy_pids.get(path, []):
+                    out.append(pid)
+                    break
+        return out

@@ -1,0 +1,57 @@
+"""TPU chip device model.
+
+TPU analog of the reference's ``pkg/device/nvidia.go:10-41`` (``NvidiaGPU``
+struct, FREE/ALLOCATED states, device-file constants). Differences that are
+hardware, not style:
+
+- NVIDIA char devices use fixed major 195 (``nvidia.go:37``); TPU ``accel``
+  devices get a **dynamic major**, so ``major`` is a per-chip field resolved at
+  enumeration time from stat(2)/``/proc/devices``.
+- NVIDIA GPUs carry driver UUIDs (``GPU-xxxx``); TPU chips are identified by
+  their kubelet device-plugin ID (the string the KubeletPodResources API
+  reports for ``google.com/tpu``, normally the chip index) plus the PCI
+  address. ``uuid`` keeps the reference's field name for API parity and holds
+  the stable external ID.
+- TPU chips have ICI topology coordinates (from sysfs/GKE labels) used for
+  topology-aligned entire-mounts; NVIDIA had no equivalent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+
+
+class DeviceState(str, enum.Enum):
+    """Ref pkg/device/nvidia.go:20-23."""
+
+    FREE = "FREE"
+    ALLOCATED = "ALLOCATED"
+
+
+@dataclasses.dataclass
+class TPUChip:
+    """One attachable TPU chip on this node."""
+
+    index: int                  # chip index on the node (accelN)
+    device_path: str            # e.g. /dev/accel0
+    major: int                  # dynamic char major (cf. fixed 195, nvidia.go:37)
+    minor: int
+    uuid: str                   # stable external id == kubelet device-plugin id
+    pci_address: str = ""       # e.g. 0000:05:00.0 (from sysfs), "" if unknown
+    # Extra device nodes that must be exposed together with the chip node for
+    # the runtime to work (VFIO stacks need /dev/vfio/vfio + the group node).
+    companion_paths: tuple[str, ...] = ()
+    state: DeviceState = DeviceState.FREE
+    pod_name: str = ""          # set when ALLOCATED (ref nvidia.go:15-16)
+    namespace: str = ""
+
+    def reset_state(self) -> None:
+        """Ref nvidia.go ResetState: back to FREE with no pod binding."""
+        self.state = DeviceState.FREE
+        self.pod_name = ""
+        self.namespace = ""
+
+    def __str__(self) -> str:  # ref nvidia.go String(): JSON rendering
+        return json.dumps(dataclasses.asdict(self), default=str, sort_keys=True)

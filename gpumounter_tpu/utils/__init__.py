@@ -1,0 +1,1 @@
+"""Cross-cutting infrastructure: constants, typed errors, config, logging."""

@@ -1,0 +1,65 @@
+"""Runtime configuration.
+
+The reference configures itself with two bare env vars (``CGROUP_DRIVER`` at
+``pkg/util/cgroup/cgroup.go:78-84``, ``GPU_POOL_NAMESPACE`` read at 8 call
+sites e.g. ``allocator.go:199``) and hardcodes everything else. We centralise
+configuration in one dataclass, loadable from env, and — crucially for
+testability — make every *host path* (cgroupfs root, /dev, /proc, kubelet
+socket) a parameter so each layer can run against a fixture tree (SURVEY.md §4:
+the test story must be invented; fakes everywhere).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from gpumounter_tpu.utils import consts
+
+
+@dataclasses.dataclass
+class HostPaths:
+    """Roots of every host filesystem the worker touches.
+
+    Production uses the real roots (via hostPath mounts in the DaemonSet);
+    tests point these at tmp fixture trees.
+    """
+
+    dev_root: str = "/dev"
+    proc_root: str = "/proc"
+    sys_root: str = "/sys"
+    cgroup_root: str = "/sys/fs/cgroup"
+    kubelet_socket: str = consts.KUBELET_SOCKET_PATH
+
+
+@dataclasses.dataclass
+class Settings:
+    pool_namespace: str = consts.DEFAULT_POOL_NAMESPACE
+    cgroup_driver: str = "systemd"          # "systemd" | "cgroupfs"
+    resource_name: str = consts.TPU_RESOURCE_NAME
+    worker_grpc_port: int = consts.WORKER_GRPC_PORT
+    master_http_port: int = consts.MASTER_HTTP_PORT
+    worker_namespace: str = consts.WORKER_NAMESPACE
+    worker_label_selector: str = consts.WORKER_LABEL_SELECTOR
+    node_name: str = ""                     # downward-API injected NODE_NAME
+    # Watch deadline for slave-pod create/delete state machines. Replaces the
+    # reference's unbounded busy-polls (allocator.go:247-282, :296-317).
+    allocation_timeout_s: float = 120.0
+    host: HostPaths = dataclasses.field(default_factory=HostPaths)
+
+    @classmethod
+    def from_env(cls, env: dict[str, str] | None = None) -> "Settings":
+        env = dict(os.environ if env is None else env)
+        s = cls()
+        s.pool_namespace = env.get(consts.ENV_POOL_NAMESPACE,
+                                   consts.DEFAULT_POOL_NAMESPACE)
+        driver = env.get(consts.ENV_CGROUP_DRIVER, "systemd")
+        if driver not in ("systemd", "cgroupfs"):
+            raise ValueError(
+                f"unsupported cgroup driver {driver!r} "
+                "(ref cgroup.go:78-84 accepts systemd|cgroupfs)")
+        s.cgroup_driver = driver
+        s.node_name = env.get("NODE_NAME", "")
+        if t := env.get("TPU_ALLOCATION_TIMEOUT_S"):
+            s.allocation_timeout_s = float(t)
+        return s

@@ -1,0 +1,107 @@
+"""Constants and enums for the TPU mount control plane.
+
+Mirrors the reference's ``pkg/util/gpu/types.go:5-26`` (socket paths, resource
+name, status strings, mount-type enum) but TPU-native: the scheduler resource
+is ``google.com/tpu``, device files are ``/dev/accel*`` (+ ``/dev/vfio/*`` on
+v4/v5p VFIO-based nodes), and char-device majors are **dynamic** (resolved from
+``/proc/devices`` at runtime, unlike NVIDIA's fixed major 195 at the
+reference's ``pkg/device/nvidia.go:37``).
+"""
+
+from __future__ import annotations
+
+import enum
+
+# --- Kubelet PodResources API (ref pkg/util/gpu/types.go:6-9) -----------------
+KUBELET_SOCKET_DIR = "/var/lib/kubelet/pod-resources"
+KUBELET_SOCKET_PATH = KUBELET_SOCKET_DIR + "/kubelet.sock"
+PODRESOURCES_CONNECT_TIMEOUT_S = 10.0
+
+# --- Scheduler resource names (ref pkg/util/gpu/types.go:10) ------------------
+TPU_RESOURCE_NAME = "google.com/tpu"
+# Kept for API-surface parity with the reference so mixed clusters can reuse
+# the same control plane for NVIDIA devices.
+GPU_RESOURCE_NAME = "nvidia.com/gpu"
+
+# --- Device files -------------------------------------------------------------
+# Google TPU chips appear as /dev/accel0..N (tpu_common driver) on v5e/v6e GKE
+# nodes, or as /dev/vfio/<group> + /dev/vfio/vfio on VFIO-based stacks.
+ACCEL_DEV_PREFIX = "/dev/accel"
+VFIO_DEV_DIR = "/dev/vfio"
+VFIO_CONTAINER_DEV = "/dev/vfio/vfio"
+# Name the driver registers in /proc/devices; the major is dynamic.
+ACCEL_PROC_DEVICES_NAMES = ("accel", "tpu_common", "tpu")
+VFIO_PROC_DEVICES_NAME = "vfio"
+
+# Device node permissions inside the target container
+# (ref pkg/device/nvidia.go:38-40: "rw" cgroup permission, 0666 file mode).
+DEVICE_CGROUP_PERMISSIONS = "rw"
+DEVICE_FILE_MODE = 0o666
+
+# --- Slave pod conventions (ref pkg/util/gpu/allocator/allocator.go:192-231) --
+SLAVE_POD_INFIX = "-slave-pod-"
+SLAVE_POD_LABEL_KEY = "app"
+SLAVE_POD_LABEL_VALUE = "tpu-pool"
+# The reference infers entire-mount by *counting* slave pods
+# (allocator.go:181-187, acknowledged TODO). We store it explicitly instead.
+MOUNT_TYPE_LABEL_KEY = "tpumounter.io/mount-type"
+OWNER_POD_LABEL_KEY = "tpumounter.io/owner-pod"
+SLAVE_POD_IMAGE = "registry.k8s.io/pause:3.9"
+
+# --- Environment variables (ref: CGROUP_DRIVER cgroup.go:78, GPU_POOL_NAMESPACE
+# allocator.go:199) ------------------------------------------------------------
+ENV_POOL_NAMESPACE = "TPU_POOL_NAMESPACE"
+DEFAULT_POOL_NAMESPACE = "tpu-pool"
+ENV_CGROUP_DRIVER = "CGROUP_DRIVER"
+
+# --- Ports (ref: master main.go:235 :8080; worker main.go:24 :1200) -----------
+MASTER_HTTP_PORT = 8080
+WORKER_GRPC_PORT = 1200
+
+# --- Worker discovery (ref cmd/GPUMounter-master/main.go:255-257) -------------
+WORKER_NAMESPACE = "kube-system"
+WORKER_LABEL_SELECTOR = "app=tpu-mounter-worker"
+
+# --- Status strings (ref pkg/util/gpu/types.go:12-16) -------------------------
+STATUS_INSUFFICIENT = "InsufficientTPU"
+STATUS_CREATED = "SuccessfullyCreated"
+STATUS_FAILED_CREATE = "FailedCreated"
+STATUS_DELETED = "SuccessfullyDeleted"
+STATUS_FAILED_DELETE = "FailedDeleted"
+
+# --- GKE TPU topology node labels ---------------------------------------------
+# Used for topology-aware entire-mount: attach whole hosts / aligned chip
+# groups so the resulting ICI mesh is valid (SURVEY.md §7 "Topology-aware
+# allocation"). These are the standard GKE TPU nodepool labels.
+LABEL_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+LABEL_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+
+
+class MountType(str, enum.Enum):
+    """Ref pkg/util/gpu/types.go:19-26."""
+
+    ENTIRE = "entire-mount"
+    SINGLE = "single-mount"
+    NONE = "no-mount"
+    UNKNOWN = "unknown-mount"
+
+
+class AddResult(enum.IntEnum):
+    """Wire values of AddTPUResponse.result (ref api.proto:11-19)."""
+
+    SUCCESS = 0
+    INSUFFICIENT_TPU = 1
+    POD_NOT_FOUND = 2
+
+
+class RemoveResult(enum.IntEnum):
+    """Wire values of RemoveTPUResponse.result (ref api.proto:32-41).
+
+    Tag 3 is intentionally skipped to stay wire-compatible with the reference
+    proto, which skips it too (api.proto:32-41 note in SURVEY.md §2).
+    """
+
+    SUCCESS = 0
+    TPU_BUSY = 1
+    POD_NOT_FOUND = 2
+    TPU_NOT_FOUND = 4

@@ -1,0 +1,73 @@
+"""Typed errors for the control plane.
+
+The reference smuggles error kinds through string comparison (e.g. the literal
+``"GPUBusy"`` at ``pkg/util/util.go:108`` matched at
+``pkg/server/gpu-mount/server.go:70-76``). We use an exception hierarchy so
+every layer can classify failures without string matching, and the gRPC layer
+maps them onto the wire enums in one place.
+"""
+
+from __future__ import annotations
+
+
+class TPUMounterError(Exception):
+    """Base class for all framework errors."""
+
+
+class PodNotFoundError(TPUMounterError):
+    def __init__(self, namespace: str, name: str):
+        super().__init__(f"pod {namespace}/{name} not found")
+        self.namespace = namespace
+        self.name = name
+
+
+class InsufficientTPUError(TPUMounterError):
+    """The scheduler could not place slave pods: not enough free chips."""
+
+
+class DeviceBusyError(TPUMounterError):
+    """Processes inside the target container hold the device open."""
+
+    def __init__(self, device_id: str, pids: list[int]):
+        super().__init__(f"device {device_id} busy (pids={pids})")
+        self.device_id = device_id
+        self.pids = pids
+
+
+class DeviceNotFoundError(TPUMounterError):
+    def __init__(self, device_id: str):
+        super().__init__(f"device {device_id} not found / not removable")
+        self.device_id = device_id
+
+
+class MountPolicyError(TPUMounterError):
+    """The requested mount conflicts with the pod's current mount type
+    (ref ``pkg/util/util.go:207-226`` CanMount)."""
+
+
+class ActuationError(TPUMounterError):
+    """Host-side actuation (cgroup write / BPF attach / nsenter) failed."""
+
+
+class CgroupError(ActuationError):
+    """Could not resolve or modify the container's cgroup."""
+
+
+class AllocationTimeoutError(TPUMounterError):
+    """Slave pod did not reach Running/terminal state within the deadline.
+
+    The reference busy-polls the apiserver forever with no timeout
+    (allocator.go:247-282); we watch with a deadline instead.
+    """
+
+
+class KubeletUnavailableError(TPUMounterError):
+    """The kubelet PodResources socket is missing or unresponsive."""
+
+
+class K8sApiError(TPUMounterError):
+    """Non-404 failure talking to the kube-apiserver."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(f"apiserver error {status}: {message}")
+        self.status = status

@@ -1,0 +1,52 @@
+"""Structured logging.
+
+The reference uses a global zap SugaredLogger teed to stdout and a hostPath
+logfile (``pkg/util/log/log.go:11-29``). Equivalent here: stdlib logging with a
+single-line key=value formatter, stdout + optional rotating file handler.
+"""
+
+from __future__ import annotations
+
+import logging
+import logging.handlers
+import os
+import sys
+
+_FORMAT = "%(asctime)s\t%(levelname)s\t%(name)s\t%(message)s"
+_DATEFMT = "%Y-%m-%dT%H:%M:%S%z"  # ISO8601, matching the reference encoder
+
+_configured = False
+
+
+def init_logger(log_dir: str | None = None, filename: str | None = None,
+                level: int = logging.DEBUG) -> None:
+    """Configure the root ``tpumounter`` logger (ref log.go:11-29).
+
+    Idempotent; safe to call from both master and worker mains and from tests.
+    """
+    global _configured
+    root = logging.getLogger("tpumounter")
+    if _configured:
+        return
+    root.setLevel(level)
+    fmt = logging.Formatter(_FORMAT, datefmt=_DATEFMT)
+
+    stream = logging.StreamHandler(sys.stdout)
+    stream.setFormatter(fmt)
+    root.addHandler(stream)
+
+    if log_dir and filename:
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            fileh = logging.handlers.RotatingFileHandler(
+                os.path.join(log_dir, filename),
+                maxBytes=64 * 1024 * 1024, backupCount=3)
+            fileh.setFormatter(fmt)
+            root.addHandler(fileh)
+        except OSError:  # unwritable hostPath must not kill the daemon
+            root.warning("log dir %s unwritable; logging to stdout only", log_dir)
+    _configured = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(f"tpumounter.{name}")

@@ -1,0 +1,37 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh so sharding/mesh tests run on any
+machine (multi-chip TPU hardware is not available in CI); control-plane tests
+don't touch JAX at all.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def fake_host(tmp_path):
+    """A HostPaths rooted in a tmp fixture tree with fake /dev, /proc, /sys,
+    and cgroup roots."""
+    from gpumounter_tpu.utils.config import HostPaths
+    dev = tmp_path / "dev"
+    proc = tmp_path / "proc"
+    sysd = tmp_path / "sys"
+    cg = tmp_path / "sys" / "fs" / "cgroup"
+    for d in (dev, proc, sysd, cg):
+        d.mkdir(parents=True, exist_ok=True)
+    return HostPaths(
+        dev_root=str(dev), proc_root=str(proc), sys_root=str(sysd),
+        cgroup_root=str(cg),
+        kubelet_socket=str(tmp_path / "pod-resources" / "kubelet.sock"),
+    )

@@ -1,0 +1,36 @@
+import pytest
+
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.config import Settings
+
+
+def test_settings_defaults():
+    s = Settings.from_env({})
+    assert s.pool_namespace == consts.DEFAULT_POOL_NAMESPACE
+    assert s.cgroup_driver == "systemd"
+    assert s.resource_name == consts.TPU_RESOURCE_NAME
+    assert s.allocation_timeout_s == 120.0
+
+
+def test_settings_env_overrides():
+    s = Settings.from_env({
+        consts.ENV_POOL_NAMESPACE: "my-pool",
+        consts.ENV_CGROUP_DRIVER: "cgroupfs",
+        "NODE_NAME": "node-1",
+        "TPU_ALLOCATION_TIMEOUT_S": "7.5",
+    })
+    assert s.pool_namespace == "my-pool"
+    assert s.cgroup_driver == "cgroupfs"
+    assert s.node_name == "node-1"
+    assert s.allocation_timeout_s == 7.5
+
+
+def test_settings_rejects_unknown_cgroup_driver():
+    # ref cgroup.go:78-84: only systemd|cgroupfs are valid
+    with pytest.raises(ValueError):
+        Settings.from_env({consts.ENV_CGROUP_DRIVER: "bogus"})
+
+
+def test_remove_result_wire_parity():
+    # ref api.proto:32-41 skips enum tag 3
+    assert consts.RemoveResult.TPU_NOT_FOUND == 4

@@ -1,0 +1,103 @@
+"""Device model + enumerator tests (ref test analog: nvml_test.go, but
+hermetic — no live hardware required; SURVEY.md §4)."""
+
+import os
+
+from gpumounter_tpu.device.enumerator import PyEnumerator, read_proc_devices
+from gpumounter_tpu.device.fake import FakeEnumerator, make_chips
+from gpumounter_tpu.device.model import DeviceState, TPUChip
+
+
+def test_chip_reset_state():
+    chip = TPUChip(index=0, device_path="/dev/accel0", major=120, minor=0,
+                   uuid="0", state=DeviceState.ALLOCATED,
+                   pod_name="p", namespace="ns")
+    chip.reset_state()
+    assert chip.state is DeviceState.FREE
+    assert chip.pod_name == "" and chip.namespace == ""
+
+
+def test_chip_str_is_json():
+    import json
+    chip = make_chips(1)[0]
+    parsed = json.loads(str(chip))
+    assert parsed["device_path"] == "/dev/accel0"
+    assert parsed["major"] == 120
+
+
+def test_py_enumerator_fixture_accel_devices(fake_host):
+    for i in range(4):
+        path = os.path.join(fake_host.dev_root, f"accel{i}")
+        with open(path, "w"):
+            pass
+        with open(path + ".majmin", "w") as f:
+            f.write(f"120:{i}")
+    # distractor entries must be ignored
+    open(os.path.join(fake_host.dev_root, "null"), "w").close()
+    os.mkdir(os.path.join(fake_host.dev_root, "acceldir"))
+
+    chips = PyEnumerator(fake_host, allow_fake=True).enumerate()
+    assert [c.index for c in chips] == [0, 1, 2, 3]
+    assert all(c.major == 120 for c in chips)
+    assert [c.minor for c in chips] == [0, 1, 2, 3]
+    assert chips[0].device_path.endswith("/accel0")
+    assert chips[0].uuid == "0"
+
+
+def test_py_enumerator_requires_char_device_without_fake_flag(fake_host):
+    open(os.path.join(fake_host.dev_root, "accel0"), "w").close()
+    assert PyEnumerator(fake_host, allow_fake=False).enumerate() == []
+
+
+def test_py_enumerator_vfio_fallback(fake_host):
+    vfio = os.path.join(fake_host.dev_root, "vfio")
+    os.mkdir(vfio)
+    for name in ("0", "1", "vfio"):
+        open(os.path.join(vfio, name), "w").close()
+    chips = PyEnumerator(fake_host, allow_fake=True).enumerate()
+    assert len(chips) == 2
+    assert chips[0].device_path.endswith("/vfio/0")
+    assert all(p.endswith("/vfio/vfio") for c in chips
+               for p in c.companion_paths)
+
+
+def test_py_enumerator_pci_address_from_sysfs(fake_host):
+    accel_cls = os.path.join(fake_host.sys_root, "class", "accel", "accel0")
+    os.makedirs(accel_cls)
+    pci_dir = os.path.join(fake_host.sys_root, "devices", "pci0", "0000:05:00.0")
+    os.makedirs(pci_dir)
+    os.symlink(pci_dir, os.path.join(accel_cls, "device"))
+    path = os.path.join(fake_host.dev_root, "accel0")
+    open(path, "w").close()
+    chips = PyEnumerator(fake_host, allow_fake=True).enumerate()
+    assert chips[0].pci_address == "0000:05:00.0"
+
+
+def test_read_proc_devices(fake_host):
+    with open(os.path.join(fake_host.proc_root, "devices"), "w") as f:
+        f.write("Character devices:\n  1 mem\n120 accel\n511 vfio\n\n"
+                "Block devices:\n  8 sd\n")
+    majors = read_proc_devices(fake_host.proc_root)
+    assert majors["accel"] == 120
+    assert majors["vfio"] == 511
+    assert "sd" not in majors
+
+
+def test_busy_detection_proc_fd_scan(fake_host):
+    dev = os.path.join(fake_host.dev_root, "accel0")
+    open(dev, "w").close()
+    # pid 100 holds the device open; pid 200 holds something else; 300 is gone
+    for pid, target in ((100, dev),
+                        (200, os.path.join(fake_host.dev_root, "null"))):
+        fd_dir = os.path.join(fake_host.proc_root, str(pid), "fd")
+        os.makedirs(fd_dir)
+        os.symlink(target, os.path.join(fd_dir, "3"))
+    enum = PyEnumerator(fake_host, allow_fake=True)
+    assert enum.device_open_pids([100, 200, 300], [dev]) == [100]
+
+
+def test_fake_enumerator_busy():
+    fake = FakeEnumerator(busy_pids={"/dev/accel1": [42]})
+    assert fake.device_open_pids([41, 42], ["/dev/accel1"]) == [42]
+    assert fake.device_open_pids([41, 42], ["/dev/accel0"]) == []
+    assert len(fake.enumerate()) == 4

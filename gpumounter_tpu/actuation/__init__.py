@@ -1,0 +1,3 @@
+"""Host actuation: cgroup device permissioning (v1 file / v2 eBPF), mount
+namespace entry, device-node lifecycle (ref ``pkg/util``, ``pkg/util/cgroup``,
+``pkg/util/namespace``)."""

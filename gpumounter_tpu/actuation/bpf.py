@@ -1,0 +1,158 @@
+"""cgroup-v2 device gating: Python side of the native BPF gate.
+
+The reference's device permissioning is a cgroup-v1 file write
+(``pkg/util/cgroup/cgroup.go:143-169``); on cgroup v2 (GKE >= 1.26) the
+controller is an eBPF program and permissions can only be *extended* by
+replacing the runtime's attached program with one whose allowlist is
+(container defaults ∪ attached chips). See
+``gpumounter_tpu/native/bpf_gate.cc`` for kernel mechanics; this module owns
+the *policy*: the canonical container default rule set (what runc/crun grant
+every container) and the desired-state composition.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import os
+
+from gpumounter_tpu.device.model import TPUChip
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("actuation.bpf")
+
+_LIB_NAME = "libbpfgate.so"
+_ABI_VERSION = 1
+
+ACC_MKNOD = 1
+ACC_READ = 2
+ACC_WRITE = 4
+ACC_RWM = ACC_MKNOD | ACC_READ | ACC_WRITE
+ACC_RW = ACC_READ | ACC_WRITE
+
+
+class CDeviceRule(ctypes.Structure):
+    _fields_ = [
+        ("dev_type", ctypes.c_int32),   # ord('c') | ord('b') | ord('a')
+        ("access", ctypes.c_int32),
+        ("major", ctypes.c_int32),
+        ("minor", ctypes.c_int32),
+        ("has_major", ctypes.c_int32),
+        ("has_minor", ctypes.c_int32),
+    ]
+
+
+class CBpfInsn(ctypes.Structure):
+    _fields_ = [
+        ("code", ctypes.c_uint8),
+        ("regs", ctypes.c_uint8),       # dst:4 | src:4
+        ("off", ctypes.c_int16),
+        ("imm", ctypes.c_int32),
+    ]
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceRule:
+    dev_type: str = "c"       # 'c' char, 'b' block, 'a' all
+    access: int = ACC_RWM
+    major: int | None = None  # None = wildcard
+    minor: int | None = None
+
+    def to_c(self) -> CDeviceRule:
+        return CDeviceRule(
+            dev_type=ord(self.dev_type),
+            access=self.access,
+            major=self.major or 0,
+            minor=self.minor or 0,
+            has_major=0 if self.major is None else 1,
+            has_minor=0 if self.minor is None else 1,
+        )
+
+
+# The devices every OCI container is granted by default (runc/crun defaults:
+# mknod of any char/block device, plus rwm on null, zero, full, random,
+# urandom, tty, console, ptmx and the pts namespace). A hot-attach must
+# preserve exactly this set when replacing the runtime's program, or the
+# container loses /dev/null et al.
+CONTAINER_DEFAULT_RULES: tuple[DeviceRule, ...] = (
+    DeviceRule("c", ACC_MKNOD, None, None),
+    DeviceRule("b", ACC_MKNOD, None, None),
+    DeviceRule("c", ACC_RWM, 1, 3),    # /dev/null
+    DeviceRule("c", ACC_RWM, 1, 5),    # /dev/zero
+    DeviceRule("c", ACC_RWM, 1, 7),    # /dev/full
+    DeviceRule("c", ACC_RWM, 1, 8),    # /dev/random
+    DeviceRule("c", ACC_RWM, 1, 9),    # /dev/urandom
+    DeviceRule("c", ACC_RWM, 5, 0),    # /dev/tty
+    DeviceRule("c", ACC_RWM, 5, 1),    # /dev/console
+    DeviceRule("c", ACC_RWM, 5, 2),    # /dev/ptmx
+    DeviceRule("c", ACC_RWM, 136, None),  # /dev/pts/*
+)
+
+
+def rules_for_chips(chips: list[TPUChip]) -> list[DeviceRule]:
+    """Desired device-program allowlist: container defaults + chip nodes
+    (+ VFIO companions share the chip's major with distinct minors; companion
+    nodes are resolved by the caller who knows their majmin)."""
+    rules = list(CONTAINER_DEFAULT_RULES)
+    seen: set[tuple[int, int]] = set()
+    for chip in chips:
+        key = (chip.major, chip.minor)
+        if key not in seen:
+            seen.add(key)
+            rules.append(DeviceRule("c", ACC_RW | ACC_MKNOD,
+                                    chip.major, chip.minor))
+    return rules
+
+
+def _default_lib_path() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(here, "..", "native", "build", _LIB_NAME)
+
+
+class BpfGate:
+    """Binding to libbpfgate.so. ``sync`` is the only mutating entry point."""
+
+    SYNC_OK = 1
+    SYNC_NOOP = 2  # no program attached => access already unrestricted
+
+    def __init__(self, lib_path: str | None = None):
+        path = lib_path or _default_lib_path()
+        try:
+            self._lib = ctypes.CDLL(path)
+        except OSError:
+            self._lib = ctypes.CDLL(_LIB_NAME)  # system-installed fallback
+        self._lib.bpfgate_build_program.restype = ctypes.c_int
+        self._lib.bpfgate_build_program.argtypes = [
+            ctypes.POINTER(CDeviceRule), ctypes.c_int,
+            ctypes.POINTER(CBpfInsn), ctypes.c_int]
+        self._lib.bpfgate_supported.restype = ctypes.c_int
+        self._lib.bpfgate_supported.argtypes = []
+        self._lib.bpfgate_sync.restype = ctypes.c_int
+        self._lib.bpfgate_sync.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(CDeviceRule), ctypes.c_int]
+        self._lib.bpfgate_abi_version.restype = ctypes.c_int
+        if self._lib.bpfgate_abi_version() != _ABI_VERSION:
+            raise OSError("libbpfgate ABI mismatch")
+
+    def build_program(self, rules: list[DeviceRule]) -> list[CBpfInsn]:
+        """Pure codegen (no privileges) — exposed for tests/debugging."""
+        c_rules = (CDeviceRule * max(len(rules), 1))(
+            *[r.to_c() for r in rules])
+        max_insns = 16 + 8 * len(rules)
+        out = (CBpfInsn * max_insns)()
+        n = self._lib.bpfgate_build_program(c_rules, len(rules), out,
+                                            max_insns)
+        if n < 0:
+            raise OSError("bpfgate_build_program failed")
+        return list(out[:n])
+
+    def supported(self) -> bool:
+        return self._lib.bpfgate_supported() == 1
+
+    def sync(self, cgroup_path: str, rules: list[DeviceRule]) -> int:
+        c_rules = (CDeviceRule * max(len(rules), 1))(
+            *[r.to_c() for r in rules])
+        rc = self._lib.bpfgate_sync(cgroup_path.encode(), c_rules, len(rules))
+        if rc < 0:
+            raise OSError(f"bpfgate_sync({cgroup_path}) failed: errno {-rc}")
+        return rc

@@ -34,7 +34,6 @@ import re
 import stat as stat_mod
 
 from gpumounter_tpu.device.model import TPUChip
-from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.config import HostPaths
 from gpumounter_tpu.utils.log import get_logger
 
@@ -125,31 +124,34 @@ class PyEnumerator(Enumerator):
             chips = self._scan_vfio()
         return chips
 
+    def _make_chip(self, path: str, index: int,
+                   companions: tuple[str, ...] = (),
+                   pci_address: str = "") -> TPUChip | None:
+        majmin = _stat_majmin(path)
+        if majmin is None:
+            if not self.allow_fake or not os.path.isfile(path):
+                return None
+            majmin = self._fixture_majmin(path, index)
+        return TPUChip(
+            index=index, device_path=path, major=majmin[0], minor=majmin[1],
+            uuid=str(index), pci_address=pci_address,
+            companion_paths=companions)
+
     def _scan_accel(self) -> list[TPUChip]:
         chips: list[TPUChip] = []
         try:
-            entries = sorted(os.listdir(self.host.dev_root))
+            entries = os.listdir(self.host.dev_root)
         except OSError:
             return chips
-        for name in entries:
-            m = _ACCEL_RE.match(name)
-            if not m:
-                continue
-            index = int(m.group(1))
-            path = os.path.join(self.host.dev_root, name)
-            majmin = _stat_majmin(path)
-            if majmin is None:
-                if not self.allow_fake or not os.path.isfile(path):
-                    continue
-                majmin = self._fixture_majmin(path, index)
-            chips.append(TPUChip(
-                index=index,
-                device_path=path,
-                major=majmin[0],
-                minor=majmin[1],
-                uuid=str(index),
-                pci_address=_pci_address(self.host.sys_root, index),
-            ))
+        indices = sorted(int(m.group(1)) for name in entries
+                         if (m := _ACCEL_RE.match(name)))
+        for index in indices:
+            path = os.path.join(self.host.dev_root, f"accel{index}")
+            chip = self._make_chip(
+                path, index,
+                pci_address=_pci_address(self.host.sys_root, index))
+            if chip is not None:
+                chips.append(chip)
         return chips
 
     def _scan_vfio(self) -> list[TPUChip]:
@@ -158,32 +160,17 @@ class PyEnumerator(Enumerator):
         vfio_dir = os.path.join(self.host.dev_root, "vfio")
         chips: list[TPUChip] = []
         try:
-            entries = sorted(os.listdir(vfio_dir),
-                             key=lambda n: (not n.isdigit(),
-                                            int(n) if n.isdigit() else 0))
+            entries = os.listdir(vfio_dir)
         except OSError:
             return chips
         container = os.path.join(vfio_dir, "vfio")
         companions = (container,) if os.path.exists(container) else ()
-        index = 0
-        for name in entries:
-            if not _VFIO_GROUP_RE.match(name):
-                continue
-            path = os.path.join(vfio_dir, name)
-            majmin = _stat_majmin(path)
-            if majmin is None:
-                if not self.allow_fake or not os.path.isfile(path):
-                    continue
-                majmin = self._fixture_majmin(path, index)
-            chips.append(TPUChip(
-                index=index,
-                device_path=path,
-                major=majmin[0],
-                minor=majmin[1],
-                uuid=str(index),
-                companion_paths=companions,
-            ))
-            index += 1
+        groups = sorted(int(n) for n in entries if _VFIO_GROUP_RE.match(n))
+        for index, group in enumerate(groups):
+            chip = self._make_chip(os.path.join(vfio_dir, str(group)), index,
+                                   companions=companions)
+            if chip is not None:
+                chips.append(chip)
         return chips
 
     @staticmethod

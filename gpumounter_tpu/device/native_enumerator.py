@@ -1,0 +1,143 @@
+"""ctypes binding to the native C++ enumerator (``libtpuprobe.so``).
+
+Analog of the reference's cgo NVML binding layer
+(``pkg/util/gpu/collector/nvml/bindings.go`` + ``nvml_dl.go:30`` dlopen): the
+heavy lifting is native, the control plane talks to it through a narrow ABI.
+Falls back to :class:`~gpumounter_tpu.device.enumerator.PyEnumerator` when the
+shared library is absent (e.g. source checkout without ``make``), mirroring how
+the reference tolerates a missing driver only by failing fast — we degrade
+instead, because the pure-Python path is behavior-identical.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+from gpumounter_tpu.device.enumerator import Enumerator, PyEnumerator
+from gpumounter_tpu.device.model import TPUChip
+from gpumounter_tpu.utils.config import HostPaths
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("device.native")
+
+_LIB_NAME = "libtpuprobe.so"
+_MAX_CHIPS = 256
+_ABI_VERSION = 1
+
+
+class _ChipInfo(ctypes.Structure):
+    _fields_ = [
+        ("index", ctypes.c_int32),
+        ("major", ctypes.c_int32),
+        ("minor", ctypes.c_int32),
+        ("device_path", ctypes.c_char * 256),
+        ("pci_address", ctypes.c_char * 64),
+        ("is_vfio", ctypes.c_int32),
+    ]
+
+
+def _default_lib_path() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(here, "..", "native", "build", _LIB_NAME)
+
+
+def load_library(path: str | None = None) -> ctypes.CDLL | None:
+    candidates = [path] if path else [
+        _default_lib_path(),
+        os.path.join("/usr/local/lib", _LIB_NAME),
+        _LIB_NAME,
+    ]
+    for cand in candidates:
+        if cand is None:
+            continue
+        try:
+            lib = ctypes.CDLL(cand)
+        except OSError:
+            continue
+        lib.tpuprobe_enumerate.restype = ctypes.c_int
+        lib.tpuprobe_enumerate.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(_ChipInfo), ctypes.c_int]
+        lib.tpuprobe_driver_major.restype = ctypes.c_int
+        lib.tpuprobe_driver_major.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.tpuprobe_open_pids.restype = ctypes.c_int
+        lib.tpuprobe_open_pids.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+        lib.tpuprobe_abi_version.restype = ctypes.c_int
+        lib.tpuprobe_abi_version.argtypes = []
+        if lib.tpuprobe_abi_version() != _ABI_VERSION:
+            logger.warning("%s has ABI %d, want %d — ignoring", cand,
+                           lib.tpuprobe_abi_version(), _ABI_VERSION)
+            continue
+        return lib
+    return None
+
+
+class NativeEnumerator(Enumerator):
+    """Production enumerator backed by libtpuprobe.so."""
+
+    def __init__(self, host: HostPaths | None = None, allow_fake: bool = False,
+                 lib_path: str | None = None):
+        self.host = host or HostPaths()
+        self.allow_fake = allow_fake
+        self._lib = load_library(lib_path)
+        if self._lib is None:
+            raise OSError(f"{_LIB_NAME} not found; build gpumounter_tpu/native "
+                          "or use PyEnumerator")
+
+    def enumerate(self) -> list[TPUChip]:
+        buf = (_ChipInfo * _MAX_CHIPS)()
+        n = self._lib.tpuprobe_enumerate(
+            self.host.dev_root.encode(), self.host.sys_root.encode(),
+            1 if self.allow_fake else 0, buf, _MAX_CHIPS)
+        if n < 0:
+            raise OSError(f"tpuprobe_enumerate failed: {n}")
+        chips: list[TPUChip] = []
+        vfio_container = os.path.join(self.host.dev_root, "vfio", "vfio")
+        companions = ((vfio_container,)
+                      if os.path.exists(vfio_container) else ())
+        for i in range(n):
+            info = buf[i]
+            chips.append(TPUChip(
+                index=info.index,
+                device_path=info.device_path.decode(),
+                major=info.major,
+                minor=info.minor,
+                uuid=str(info.index),
+                pci_address=info.pci_address.decode(),
+                companion_paths=companions if info.is_vfio else (),
+            ))
+        return chips
+
+    def device_open_pids(self, pids: list[int],
+                         device_paths: list[str]) -> list[int]:
+        if not pids or not device_paths:
+            return []
+        pid_arr = (ctypes.c_int32 * len(pids))(*pids)
+        path_arr = (ctypes.c_char_p * len(device_paths))(
+            *[p.encode() for p in device_paths])
+        out = (ctypes.c_int32 * len(pids))()
+        n = self._lib.tpuprobe_open_pids(
+            self.host.proc_root.encode(), pid_arr, len(pids),
+            path_arr, len(device_paths), out, len(pids))
+        if n < 0:
+            raise OSError(f"tpuprobe_open_pids failed: {n}")
+        return [out[i] for i in range(n)]
+
+    def driver_major(self, name: str) -> int | None:
+        major = self._lib.tpuprobe_driver_major(
+            self.host.proc_root.encode(), name.encode())
+        return None if major < 0 else major
+
+
+def best_enumerator(host: HostPaths | None = None,
+                    allow_fake: bool = False) -> Enumerator:
+    """Native if built, Python otherwise — identical observable behavior."""
+    try:
+        return NativeEnumerator(host, allow_fake)
+    except OSError:
+        logger.info("native tpuprobe unavailable; using PyEnumerator")
+        return PyEnumerator(host, allow_fake)

@@ -1,0 +1,342 @@
+// bpf_gate: cgroup-v2 device-access gating via BPF_PROG_TYPE_CGROUP_DEVICE.
+//
+// The reference only supports cgroup v1, where granting device access is a
+// file write: `echo "c 195:0 rw" > .../devices.allow`
+// (pkg/util/cgroup/cgroup.go:143-155). On cgroup v2 (GKE >= 1.26) that file
+// does not exist; device access is decided by eBPF programs attached to the
+// cgroup. Kernel semantics: with multiple attached programs the verdict is the
+// AND of all of them — so permissions cannot be *extended* by attaching an
+// extra allow-program next to the container runtime's. The only sound way to
+// add a device is to REPLACE the runtime's program with one that allows
+// (previous set ∪ new devices). Since slave-pod allocation never modifies the
+// target pod's spec (that is the whole point of the design, SURVEY.md §0),
+// the runtime's program is the standard runc/crun default allowlist; the
+// Python layer (gpumounter_tpu/actuation/cgroup.py) passes
+// default-rules + currently-attached chips as one explicit rule list and this
+// layer makes the cgroup match it exactly ("sync", not "add"/"remove").
+//
+// Everything privileged is isolated here; program *codegen* is pure and
+// unit-testable without CAP_BPF (tests inspect the emitted instruction
+// stream).
+//
+// No libbpf dependency: the program is a short, hand-assembled instruction
+// sequence in the classic runc devcg shape, loaded with raw bpf(2) syscalls.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+#include <vector>
+
+// ---- minimal local uapi (kept self-contained; values are kernel ABI) --------
+
+struct bpf_insn {
+  uint8_t code;
+  uint8_t dst_reg : 4;
+  uint8_t src_reg : 4;
+  int16_t off;
+  int32_t imm;
+};
+
+// instruction classes
+#define BPF_LDX 0x01
+#define BPF_ALU 0x04
+#define BPF_JMP 0x05
+#define BPF_JMP32 0x06
+#define BPF_ALU64 0x07
+// size
+#define BPF_W 0x00
+// mode
+#define BPF_MEM 0x60
+// alu/jmp ops
+#define BPF_AND 0x50
+#define BPF_RSH 0x70
+#define BPF_MOV 0xb0
+#define BPF_JEQ 0x10
+#define BPF_JNE 0x50
+#define BPF_EXIT 0x90
+// source
+#define BPF_K 0x00
+#define BPF_X 0x08
+
+// prog/attach types
+#define BPF_PROG_TYPE_CGROUP_DEVICE 15
+#define BPF_CGROUP_DEVICE 6
+// bpf(2) commands
+#define BPF_CMD_PROG_LOAD 5
+#define BPF_CMD_PROG_ATTACH 8
+#define BPF_CMD_PROG_DETACH 9
+#define BPF_CMD_PROG_QUERY 16
+#define BPF_CMD_PROG_GET_FD_BY_ID 13
+// attach flags
+#define BPF_F_ALLOW_MULTI (1u << 1)
+#define BPF_F_REPLACE (1u << 2)
+
+// device types in bpf_cgroup_dev_ctx.access_type low 16 bits
+#define BPF_DEVCG_DEV_BLOCK 1
+#define BPF_DEVCG_DEV_CHAR 2
+// access bits in high 16 bits
+#define BPF_DEVCG_ACC_MKNOD 1
+#define BPF_DEVCG_ACC_READ 2
+#define BPF_DEVCG_ACC_WRITE 4
+
+// union bpf_attr fragments we need (zero-padded to kernel expectations)
+struct bpf_attr_prog_load {
+  uint32_t prog_type;
+  uint32_t insn_cnt;
+  uint64_t insns;
+  uint64_t license;
+  uint32_t log_level;
+  uint32_t log_size;
+  uint64_t log_buf;
+  uint32_t kern_version;
+  uint32_t prog_flags;
+  char prog_name[16];
+  uint32_t prog_ifindex;
+  uint32_t expected_attach_type;
+};
+
+struct bpf_attr_attach {
+  uint32_t target_fd;
+  uint32_t attach_bpf_fd;
+  uint32_t attach_type;
+  uint32_t attach_flags;
+  uint32_t replace_bpf_fd;
+};
+
+struct bpf_attr_query {
+  uint32_t target_fd;
+  uint32_t attach_type;
+  uint32_t query_flags;
+  uint32_t attach_flags;
+  uint64_t prog_ids;
+  uint32_t prog_cnt;
+};
+
+struct bpf_attr_get_fd_by_id {
+  uint32_t id;
+};
+
+static long sys_bpf(int cmd, void* attr, unsigned int size) {
+  return syscall(__NR_bpf, cmd, attr, size);
+}
+
+// ---- public rule ABI --------------------------------------------------------
+
+extern "C" {
+
+// One device rule, mirroring an OCI linux.resources.devices entry.
+// dev_type: 'c', 'b', or 'a' (all). access: OR of BPF_DEVCG_ACC_*.
+// has_major/has_minor 0 means wildcard (*).
+struct DeviceRule {
+  int32_t dev_type;
+  int32_t access;
+  int32_t major;
+  int32_t minor;
+  int32_t has_major;
+  int32_t has_minor;
+};
+
+}  // extern "C"
+
+// ---- codegen ---------------------------------------------------------------
+
+namespace {
+
+bpf_insn ldx_w(uint8_t dst, uint8_t src, int16_t off) {
+  return bpf_insn{BPF_LDX | BPF_MEM | BPF_W, dst, src, off, 0};
+}
+bpf_insn alu32_imm(uint8_t op, uint8_t dst, int32_t imm) {
+  return bpf_insn{static_cast<uint8_t>(BPF_ALU | op | BPF_K), dst, 0, 0, imm};
+}
+bpf_insn mov32_reg(uint8_t dst, uint8_t src) {
+  return bpf_insn{BPF_ALU | BPF_MOV | BPF_X, dst, src, 0, 0};
+}
+bpf_insn mov64_imm(uint8_t dst, int32_t imm) {
+  return bpf_insn{BPF_ALU64 | BPF_MOV | BPF_K, dst, 0, 0, imm};
+}
+bpf_insn jmp32_imm(uint8_t op, uint8_t dst, int32_t imm, int16_t off) {
+  return bpf_insn{static_cast<uint8_t>(BPF_JMP32 | op | BPF_K), dst, 0, off,
+                  imm};
+}
+bpf_insn jmp32_reg(uint8_t op, uint8_t dst, uint8_t src, int16_t off) {
+  return bpf_insn{static_cast<uint8_t>(BPF_JMP32 | op | BPF_X), dst, src, off,
+                  0};
+}
+bpf_insn exit_insn() { return bpf_insn{BPF_JMP | BPF_EXIT, 0, 0, 0, 0}; }
+
+// Emit the allowlist program. Register plan (ctx arrives in r1):
+//   r2 = device type, r3 = requested access, r4 = major, r5 = minor,
+//   r1 reused as scratch after the prologue.
+// Each rule is a fall-through chain of conditional skips ending in
+// `r0 = 1; exit`; the epilogue is `r0 = 0; exit` (deny).
+std::vector<bpf_insn> build_program(const DeviceRule* rules, int n_rules) {
+  std::vector<bpf_insn> p;
+  // prologue: unpack bpf_cgroup_dev_ctx {access_type, major, minor}
+  p.push_back(ldx_w(2, 1, 0));               // r2 = access_type
+  p.push_back(alu32_imm(BPF_AND, 2, 0xFFFF));  // r2 &= 0xFFFF (type)
+  p.push_back(ldx_w(3, 1, 0));               // r3 = access_type
+  p.push_back(alu32_imm(BPF_RSH, 3, 16));    // r3 >>= 16 (access bits)
+  p.push_back(ldx_w(4, 1, 4));               // r4 = major
+  p.push_back(ldx_w(5, 1, 8));               // r5 = minor
+
+  for (int i = 0; i < n_rules; i++) {
+    const DeviceRule& r = rules[i];
+    // Per rule: fall-through chain [type?, access, major?, minor?] ending in
+    // `r0 = 1; exit`. A failed check jumps past the allow pair, to the next
+    // rule (or the deny epilogue).
+    std::vector<bpf_insn> checks;
+    if (r.dev_type != 'a') {
+      int type_val =
+          (r.dev_type == 'b') ? BPF_DEVCG_DEV_BLOCK : BPF_DEVCG_DEV_CHAR;
+      checks.push_back(jmp32_imm(BPF_JNE, 2, type_val, 0));
+    }
+    // access: allowed iff (requested & rule.access) == requested
+    checks.push_back(mov32_reg(1, 3));                 // r1 = requested
+    checks.push_back(alu32_imm(BPF_AND, 1, r.access)); // r1 &= allowed
+    checks.push_back(jmp32_reg(BPF_JNE, 1, 3, 0));     // some bit missing
+    if (r.has_major)
+      checks.push_back(jmp32_imm(BPF_JNE, 4, r.major, 0));
+    if (r.has_minor)
+      checks.push_back(jmp32_imm(BPF_JNE, 5, r.minor, 0));
+
+    // A jump at index c with offset o lands at c + 1 + o; failures must land
+    // just past [allow, exit], i.e. at index n_checks + 2.
+    int n_checks = static_cast<int>(checks.size());
+    for (int c = 0; c < n_checks; c++) {
+      bool is_jump = (checks[c].code & 0x07) == BPF_JMP32;
+      if (is_jump)
+        checks[c].off = static_cast<int16_t>(n_checks + 2 - (c + 1));
+    }
+    for (auto& ins : checks) p.push_back(ins);
+    p.push_back(mov64_imm(0, 1));
+    p.push_back(exit_insn());
+  }
+  p.push_back(mov64_imm(0, 0));
+  p.push_back(exit_insn());
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pure codegen (no privileges): emit program into out (cap max_insns).
+// Returns instruction count, or -1 if out is too small / args invalid.
+int bpfgate_build_program(const DeviceRule* rules, int n_rules, bpf_insn* out,
+                          int max_insns) {
+  if ((!rules && n_rules > 0) || !out) return -1;
+  std::vector<bpf_insn> p = build_program(rules, n_rules);
+  if (static_cast<int>(p.size()) > max_insns) return -1;
+  memcpy(out, p.data(), p.size() * sizeof(bpf_insn));
+  return static_cast<int>(p.size());
+}
+
+// Probe whether this kernel+caller can load cgroup-device programs.
+// Returns 1 yes, 0 no-permission, negative errno on other failures.
+int bpfgate_supported(void) {
+  DeviceRule none{};
+  std::vector<bpf_insn> p = build_program(&none, 0);
+  bpf_attr_prog_load attr{};
+  attr.prog_type = BPF_PROG_TYPE_CGROUP_DEVICE;
+  attr.insn_cnt = static_cast<uint32_t>(p.size());
+  attr.insns = reinterpret_cast<uint64_t>(p.data());
+  static const char license[] = "Apache-2.0";
+  attr.license = reinterpret_cast<uint64_t>(license);
+  attr.expected_attach_type = BPF_CGROUP_DEVICE;
+  long fd = sys_bpf(BPF_CMD_PROG_LOAD, &attr, sizeof(attr));
+  if (fd >= 0) {
+    close(static_cast<int>(fd));
+    return 1;
+  }
+  if (errno == EPERM || errno == EACCES) return 0;
+  return -errno;
+}
+
+// Make `cgroup_path`'s device program match exactly `rules`:
+//  - 0 programs attached  -> nothing to do (access already unrestricted),
+//    returns 2 (NOOP).
+//  - >=1 attached         -> load new program and atomically BPF_F_REPLACE
+//    each attached program (in practice runc attaches exactly one).
+// Returns 1 on success, 2 NOOP, negative errno on failure.
+int bpfgate_sync(const char* cgroup_path, const DeviceRule* rules,
+                 int n_rules) {
+  if (!cgroup_path) return -EINVAL;
+  int cg_fd = open(cgroup_path, O_RDONLY | O_DIRECTORY);
+  if (cg_fd < 0) return -errno;
+
+  uint32_t prog_ids[16] = {0};
+  bpf_attr_query q{};
+  q.target_fd = static_cast<uint32_t>(cg_fd);
+  q.attach_type = BPF_CGROUP_DEVICE;
+  q.prog_ids = reinterpret_cast<uint64_t>(prog_ids);
+  q.prog_cnt = 16;
+  if (sys_bpf(BPF_CMD_PROG_QUERY, &q, sizeof(q)) < 0) {
+    int e = errno;
+    close(cg_fd);
+    return -e;
+  }
+  if (q.prog_cnt == 0) {
+    close(cg_fd);
+    return 2;  // no device gating in force; nothing to extend
+  }
+
+  std::vector<bpf_insn> p = build_program(rules, n_rules);
+  bpf_attr_prog_load load{};
+  load.prog_type = BPF_PROG_TYPE_CGROUP_DEVICE;
+  load.insn_cnt = static_cast<uint32_t>(p.size());
+  load.insns = reinterpret_cast<uint64_t>(p.data());
+  static const char license[] = "Apache-2.0";
+  load.license = reinterpret_cast<uint64_t>(license);
+  load.expected_attach_type = BPF_CGROUP_DEVICE;
+  snprintf(load.prog_name, sizeof(load.prog_name), "tpumounter_dev");
+  long new_fd = sys_bpf(BPF_CMD_PROG_LOAD, &load, sizeof(load));
+  if (new_fd < 0) {
+    int e = errno;
+    close(cg_fd);
+    return -e;
+  }
+
+  int rc = 1;
+  for (uint32_t i = 0; i < q.prog_cnt; i++) {
+    bpf_attr_get_fd_by_id get{};
+    get.id = prog_ids[i];
+    long old_fd = sys_bpf(BPF_CMD_PROG_GET_FD_BY_ID, &get, sizeof(get));
+    if (old_fd < 0) {
+      rc = -errno;
+      break;
+    }
+    bpf_attr_attach att{};
+    att.target_fd = static_cast<uint32_t>(cg_fd);
+    att.attach_bpf_fd = static_cast<uint32_t>(new_fd);
+    att.attach_type = BPF_CGROUP_DEVICE;
+    att.attach_flags = q.attach_flags | BPF_F_REPLACE;
+    att.replace_bpf_fd = static_cast<uint32_t>(old_fd);
+    if (sys_bpf(BPF_CMD_PROG_ATTACH, &att, sizeof(att)) < 0) {
+      // kernels without BPF_F_REPLACE for this type: detach+attach fallback
+      bpf_attr_attach det{};
+      det.target_fd = static_cast<uint32_t>(cg_fd);
+      det.attach_bpf_fd = static_cast<uint32_t>(old_fd);
+      det.attach_type = BPF_CGROUP_DEVICE;
+      sys_bpf(BPF_CMD_PROG_DETACH, &det, sizeof(det));
+      bpf_attr_attach att2{};
+      att2.target_fd = static_cast<uint32_t>(cg_fd);
+      att2.attach_bpf_fd = static_cast<uint32_t>(new_fd);
+      att2.attach_type = BPF_CGROUP_DEVICE;
+      att2.attach_flags = q.attach_flags & ~BPF_F_REPLACE;
+      if (sys_bpf(BPF_CMD_PROG_ATTACH, &att2, sizeof(att2)) < 0) rc = -errno;
+    }
+    close(static_cast<int>(old_fd));
+    if (rc < 0) break;
+  }
+  close(static_cast<int>(new_fd));
+  close(cg_fd);
+  return rc;
+}
+
+int bpfgate_abi_version(void) { return 1; }
+
+}  // extern "C"

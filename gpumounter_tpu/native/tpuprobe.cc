@@ -1,0 +1,241 @@
+// tpuprobe: native TPU device enumerator + busy prober.
+//
+// TPU analog of the reference's native NVML layer
+// (pkg/util/gpu/collector/nvml/{nvml.go,nvml_dl.go,bindings.go}: dlopen of
+// libnvidia-ml.so.1, device count, handle by index/UUID, minor number,
+// running-process queries). No NVML-like userspace library exists for TPU, so
+// this probes the kernel directly:
+//   - scandir(/dev) for accelN char nodes; /dev/vfio/<group> fallback
+//   - stat(2) for the dynamic major:minor (NVIDIA's was fixed at 195,
+//     ref pkg/device/nvidia.go:37; TPU majors are dynamic)
+//   - readlink(/sys/class/accel/accelN/device) for the PCI address
+//   - /proc/devices for the accel/vfio driver majors
+//   - /proc/<pid>/fd scan for busy detection (replaces NVML
+//     GetComputeRunningProcesses, ref nvml.go:33-73)
+//
+// Exposed as a flat C ABI consumed from Python via ctypes
+// (gpumounter_tpu/device/native_enumerator.py). All functions take explicit
+// root paths so tests can point them at fixture trees.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <dirent.h>
+#include <string>
+#include <sys/stat.h>
+#include <sys/sysmacros.h>
+#include <sys/types.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct ChipInfo {
+  int32_t index;
+  int32_t major;
+  int32_t minor;
+  char device_path[256];
+  char pci_address[64];
+  int32_t is_vfio;
+};
+
+bool stat_chardev(const std::string& path, int32_t* major_out,
+                  int32_t* minor_out) {
+  struct stat st;
+  if (stat(path.c_str(), &st) != 0) return false;
+  if (!S_ISCHR(st.st_mode)) return false;
+  *major_out = static_cast<int32_t>(major(st.st_rdev));
+  *minor_out = static_cast<int32_t>(minor(st.st_rdev));
+  return true;
+}
+
+// Fixture fallback: a regular file `accelN` with sidecar `accelN.majmin`
+// ("major:minor") counts as a fake chip. Mirrors PyEnumerator.allow_fake so
+// the native path is exercisable on CPU-only test nodes (BASELINE config 1).
+bool fixture_majmin(const std::string& path, int32_t fallback_minor,
+                    int32_t* major_out, int32_t* minor_out) {
+  struct stat st;
+  if (stat(path.c_str(), &st) != 0 || !S_ISREG(st.st_mode)) return false;
+  std::string sidecar = path + ".majmin";
+  FILE* f = fopen(sidecar.c_str(), "r");
+  if (f) {
+    int maj = 0, min = 0;
+    int n = fscanf(f, "%d:%d", &maj, &min);
+    fclose(f);
+    if (n == 2) {
+      *major_out = maj;
+      *minor_out = min;
+      return true;
+    }
+  }
+  *major_out = 0;
+  *minor_out = fallback_minor;
+  return true;
+}
+
+void read_pci_address(const std::string& sys_root, int index, char* out,
+                      size_t out_len) {
+  out[0] = '\0';
+  std::string link = sys_root + "/class/accel/accel" + std::to_string(index) +
+                     "/device";
+  char buf[512];
+  ssize_t n = readlink(link.c_str(), buf, sizeof(buf) - 1);
+  if (n <= 0) return;
+  buf[n] = '\0';
+  const char* base = strrchr(buf, '/');
+  base = base ? base + 1 : buf;
+  snprintf(out, out_len, "%s", base);
+}
+
+int scan_accel(const std::string& dev_root, const std::string& sys_root,
+               bool allow_fake, std::vector<ChipInfo>* chips) {
+  DIR* d = opendir(dev_root.c_str());
+  if (!d) return 0;
+  std::vector<int> indices;
+  struct dirent* ent;
+  while ((ent = readdir(d)) != nullptr) {
+    int idx;
+    char trailing;
+    if (sscanf(ent->d_name, "accel%d%c", &idx, &trailing) == 1 && idx >= 0)
+      indices.push_back(idx);
+  }
+  closedir(d);
+  std::sort(indices.begin(), indices.end());
+  for (int idx : indices) {
+    std::string path = dev_root + "/accel" + std::to_string(idx);
+    ChipInfo info{};
+    info.index = idx;
+    info.is_vfio = 0;
+    if (!stat_chardev(path, &info.major, &info.minor)) {
+      if (!allow_fake || !fixture_majmin(path, idx, &info.major, &info.minor))
+        continue;
+    }
+    snprintf(info.device_path, sizeof(info.device_path), "%s", path.c_str());
+    read_pci_address(sys_root, idx, info.pci_address,
+                     sizeof(info.pci_address));
+    chips->push_back(info);
+  }
+  return static_cast<int>(chips->size());
+}
+
+int scan_vfio(const std::string& dev_root, bool allow_fake,
+              std::vector<ChipInfo>* chips) {
+  std::string vfio_dir = dev_root + "/vfio";
+  DIR* d = opendir(vfio_dir.c_str());
+  if (!d) return 0;
+  std::vector<int> groups;
+  struct dirent* ent;
+  while ((ent = readdir(d)) != nullptr) {
+    char* end = nullptr;
+    long g = strtol(ent->d_name, &end, 10);
+    if (end && *end == '\0' && end != ent->d_name && g >= 0)
+      groups.push_back(static_cast<int>(g));
+  }
+  closedir(d);
+  std::sort(groups.begin(), groups.end());
+  int index = 0;
+  for (int g : groups) {
+    std::string path = vfio_dir + "/" + std::to_string(g);
+    ChipInfo info{};
+    info.index = index;
+    info.is_vfio = 1;
+    if (!stat_chardev(path, &info.major, &info.minor)) {
+      if (!allow_fake || !fixture_majmin(path, index, &info.major, &info.minor))
+        continue;
+    }
+    snprintf(info.device_path, sizeof(info.device_path), "%s", path.c_str());
+    chips->push_back(info);
+    index++;
+  }
+  return static_cast<int>(chips->size());
+}
+
+}  // namespace
+
+extern "C" {
+
+// Enumerate chips under dev_root. Fills up to max_chips entries of `out`.
+// Returns the number found (accel nodes preferred; vfio groups as fallback,
+// mirroring PyEnumerator.enumerate()). Negative on error.
+int tpuprobe_enumerate(const char* dev_root, const char* sys_root,
+                       int allow_fake, ChipInfo* out, int max_chips) {
+  if (!dev_root || !sys_root || !out || max_chips <= 0) return -1;
+  std::vector<ChipInfo> chips;
+  scan_accel(dev_root, sys_root, allow_fake != 0, &chips);
+  if (chips.empty()) scan_vfio(dev_root, allow_fake != 0, &chips);
+  int n = static_cast<int>(chips.size());
+  if (n > max_chips) n = max_chips;
+  for (int i = 0; i < n; i++) out[i] = chips[i];
+  return n;
+}
+
+// Resolve a char-device major by driver name from <proc_root>/devices.
+// Returns the major, or -1 if the name is not registered.
+int tpuprobe_driver_major(const char* proc_root, const char* driver_name) {
+  if (!proc_root || !driver_name) return -1;
+  std::string path = std::string(proc_root) + "/devices";
+  FILE* f = fopen(path.c_str(), "r");
+  if (!f) return -1;
+  char line[256];
+  bool in_char = false;
+  int result = -1;
+  while (fgets(line, sizeof(line), f)) {
+    if (strstr(line, "Character devices")) {
+      in_char = true;
+      continue;
+    }
+    if (strstr(line, "Block devices")) break;
+    if (!in_char) continue;
+    int maj;
+    char name[128];
+    if (sscanf(line, "%d %127s", &maj, name) == 2 &&
+        strcmp(name, driver_name) == 0) {
+      result = maj;
+      break;
+    }
+  }
+  fclose(f);
+  return result;
+}
+
+// Busy probe: which of `pids` hold an open fd on any of `device_paths`?
+// Scans <proc_root>/<pid>/fd symlinks (replaces NVML per-GPU process lists,
+// ref pkg/device/nvidia.go:58-87). Writes matching pids to out_pids; returns
+// the count.
+int tpuprobe_open_pids(const char* proc_root, const int32_t* pids, int n_pids,
+                       const char* const* device_paths, int n_paths,
+                       int32_t* out_pids, int max_out) {
+  if (!proc_root || !pids || !device_paths || !out_pids) return -1;
+  int found = 0;
+  char fd_dir[512], fd_path[1024], target[1024];
+  for (int i = 0; i < n_pids && found < max_out; i++) {
+    snprintf(fd_dir, sizeof(fd_dir), "%s/%d/fd", proc_root, pids[i]);
+    DIR* d = opendir(fd_dir);
+    if (!d) continue;  // process gone or unreadable; not busy by this probe
+    struct dirent* ent;
+    bool busy = false;
+    while (!busy && (ent = readdir(d)) != nullptr) {
+      if (ent->d_name[0] == '.') continue;
+      snprintf(fd_path, sizeof(fd_path), "%s/%s", fd_dir, ent->d_name);
+      ssize_t n = readlink(fd_path, target, sizeof(target) - 1);
+      if (n <= 0) continue;
+      target[n] = '\0';
+      for (int p = 0; p < n_paths; p++) {
+        if (strcmp(target, device_paths[p]) == 0) {
+          busy = true;
+          break;
+        }
+      }
+    }
+    closedir(d);
+    if (busy) out_pids[found++] = pids[i];
+  }
+  return found;
+}
+
+// ABI version so the Python binding can detect stale .so builds.
+int tpuprobe_abi_version(void) { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,145 @@
+"""cgroup-v2 device-gate codegen tests.
+
+The emitted BPF program can't be loaded without CAP_BPF, so we pin its
+*semantics* with a tiny interpreter for the instruction subset the codegen
+uses (LDX W, ALU32 AND/RSH/MOV, JMP32 JNE, MOV64, EXIT) and run device-access
+queries through it — the same checks the kernel would make.
+"""
+
+import pytest
+
+from gpumounter_tpu.actuation.bpf import (ACC_MKNOD, ACC_READ, ACC_RW,
+                                          ACC_RWM, ACC_WRITE, BpfGate,
+                                          CONTAINER_DEFAULT_RULES, DeviceRule,
+                                          rules_for_chips)
+from gpumounter_tpu.device.fake import make_chips
+
+# ctx access_type encoding: low 16 = dev type (1=block, 2=char),
+# high 16 = access bits
+DEV_CHAR, DEV_BLOCK = 2, 1
+
+
+def interpret(insns, dev_type, access, major, minor):
+    """Execute the program over bpf_cgroup_dev_ctx fields; return r0."""
+    ctx = {0: (access << 16) | dev_type, 4: major, 8: minor}
+    regs = {1: "ctx"}
+    pc = 0
+    for _ in range(10_000):
+        ins = insns[pc]
+        code, off, imm = ins.code, ins.off, ins.imm
+        dst = ins.regs & 0x0F
+        src = (ins.regs >> 4) & 0x0F
+        cls = code & 0x07
+        if cls == 0x01:  # LDX MEM W
+            assert regs.get(src) == "ctx"
+            regs[dst] = ctx[off]
+        elif cls == 0x04:  # ALU32
+            op = code & 0xF0
+            if op == 0x50:  # AND
+                regs[dst] = (regs[dst] & imm) & 0xFFFFFFFF
+            elif op == 0x70:  # RSH
+                regs[dst] = (regs[dst] >> imm) & 0xFFFFFFFF
+            elif op == 0xB0:  # MOV
+                regs[dst] = regs[src] if code & 0x08 else imm
+            else:
+                raise AssertionError(f"alu op {op:#x}")
+        elif cls == 0x06:  # JMP32
+            op = code & 0xF0
+            other = regs[src] if code & 0x08 else imm
+            if op == 0x50:  # JNE
+                if regs[dst] != other:
+                    pc += off
+            else:
+                raise AssertionError(f"jmp op {op:#x}")
+        elif cls == 0x07:  # ALU64 MOV imm
+            regs[dst] = imm
+        elif cls == 0x05 and (code & 0xF0) == 0x90:  # EXIT
+            return regs[0]
+        else:
+            raise AssertionError(f"unknown insn code {code:#x}")
+        pc += 1
+    raise AssertionError("program did not terminate")
+
+
+@pytest.fixture(scope="module")
+def gate():
+    return BpfGate()
+
+
+def test_empty_ruleset_denies_everything(gate):
+    prog = gate.build_program([])
+    assert interpret(prog, DEV_CHAR, ACC_READ, 1, 3) == 0
+
+
+def test_single_chip_rule(gate):
+    prog = gate.build_program(
+        [DeviceRule("c", ACC_RW | ACC_MKNOD, 120, 0)])
+    assert interpret(prog, DEV_CHAR, ACC_RW, 120, 0) == 1
+    assert interpret(prog, DEV_CHAR, ACC_READ, 120, 0) == 1
+    assert interpret(prog, DEV_CHAR, ACC_RW, 120, 1) == 0       # wrong minor
+    assert interpret(prog, DEV_CHAR, ACC_RW, 121, 0) == 0       # wrong major
+    assert interpret(prog, DEV_BLOCK, ACC_RW, 120, 0) == 0      # wrong type
+
+
+def test_access_subset_semantics(gate):
+    prog = gate.build_program([DeviceRule("c", ACC_READ, 10, 1)])
+    assert interpret(prog, DEV_CHAR, ACC_READ, 10, 1) == 1
+    # requesting write when only read allowed must be denied
+    assert interpret(prog, DEV_CHAR, ACC_RW, 10, 1) == 0
+    assert interpret(prog, DEV_CHAR, ACC_WRITE, 10, 1) == 0
+
+
+def test_wildcard_minor(gate):
+    prog = gate.build_program([DeviceRule("c", ACC_RWM, 136, None)])
+    assert interpret(prog, DEV_CHAR, ACC_RW, 136, 0) == 1
+    assert interpret(prog, DEV_CHAR, ACC_RW, 136, 999) == 1
+    assert interpret(prog, DEV_CHAR, ACC_RW, 137, 0) == 0
+
+
+def test_type_all_wildcard(gate):
+    prog = gate.build_program([DeviceRule("a", ACC_MKNOD, None, None)])
+    assert interpret(prog, DEV_CHAR, ACC_MKNOD, 5, 5) == 1
+    assert interpret(prog, DEV_BLOCK, ACC_MKNOD, 5, 5) == 1
+    assert interpret(prog, DEV_BLOCK, ACC_READ, 5, 5) == 0
+
+
+def test_container_default_rules_semantics(gate):
+    prog = gate.build_program(list(CONTAINER_DEFAULT_RULES))
+    # /dev/null rw allowed
+    assert interpret(prog, DEV_CHAR, ACC_RW, 1, 3) == 1
+    # mknod of anything allowed (runc default)
+    assert interpret(prog, DEV_CHAR, ACC_MKNOD, 120, 0) == 1
+    assert interpret(prog, DEV_BLOCK, ACC_MKNOD, 8, 0) == 1
+    # read of a TPU chip NOT allowed before attach
+    assert interpret(prog, DEV_CHAR, ACC_READ, 120, 0) == 0
+    # pts wildcard
+    assert interpret(prog, DEV_CHAR, ACC_RW, 136, 42) == 1
+
+
+def test_rules_for_chips_compose_defaults_plus_chips(gate):
+    chips = make_chips(4, major=120)
+    rules = rules_for_chips(chips)
+    assert len(rules) == len(CONTAINER_DEFAULT_RULES) + 4
+    prog = gate.build_program(rules)
+    # defaults preserved
+    assert interpret(prog, DEV_CHAR, ACC_RW, 1, 3) == 1
+    # all four chips rw-able
+    for minor in range(4):
+        assert interpret(prog, DEV_CHAR, ACC_RW, 120, minor) == 1
+    # a fifth chip not attached stays denied
+    assert interpret(prog, DEV_CHAR, ACC_RW, 120, 4) == 0
+
+
+def test_rules_for_chips_dedupes():
+    chips = make_chips(2) + make_chips(2)
+    assert len(rules_for_chips(chips)) == len(CONTAINER_DEFAULT_RULES) + 2
+
+
+def test_supported_probe_does_not_crash(gate):
+    # In an unprivileged container this is False; on a privileged host True.
+    assert gate.supported() in (True, False)
+
+
+def test_sync_missing_cgroup_raises(gate):
+    with pytest.raises(OSError):
+        gate.sync("/nonexistent/cgroup/path", [])

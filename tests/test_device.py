@@ -101,3 +101,12 @@ def test_fake_enumerator_busy():
     assert fake.device_open_pids([41, 42], ["/dev/accel1"]) == [42]
     assert fake.device_open_pids([41, 42], ["/dev/accel0"]) == []
     assert len(fake.enumerate()) == 4
+
+
+def test_py_enumerator_numeric_order_10_plus(fake_host):
+    # lexicographic sort would yield [0, 1, 10, 11, 2, ...]
+    for i in range(12):
+        path = os.path.join(fake_host.dev_root, f"accel{i}")
+        open(path, "w").close()
+    chips = PyEnumerator(fake_host, allow_fake=True).enumerate()
+    assert [c.index for c in chips] == list(range(12))

@@ -1,0 +1,95 @@
+"""Native libtpuprobe.so tests — same scenarios as the Python enumerator so
+the two implementations are pinned to identical behavior."""
+
+import os
+import subprocess
+
+import pytest
+
+from gpumounter_tpu.device.enumerator import PyEnumerator
+from gpumounter_tpu.device.native_enumerator import (NativeEnumerator,
+                                                     best_enumerator,
+                                                     load_library)
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "gpumounter_tpu", "native")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def build_native():
+    subprocess.run(["make", "-C", NATIVE_DIR], check=True,
+                   capture_output=True)
+
+
+def _mk_fake_accel(dev_root, n, major=120):
+    for i in range(n):
+        path = os.path.join(dev_root, f"accel{i}")
+        open(path, "w").close()
+        with open(path + ".majmin", "w") as f:
+            f.write(f"{major}:{i}")
+
+
+def test_library_loads():
+    assert load_library() is not None
+
+
+def test_native_enumerate_matches_python(fake_host):
+    _mk_fake_accel(fake_host.dev_root, 4)
+    native = NativeEnumerator(fake_host, allow_fake=True).enumerate()
+    py = PyEnumerator(fake_host, allow_fake=True).enumerate()
+    assert [(c.index, c.major, c.minor, c.device_path) for c in native] == \
+           [(c.index, c.major, c.minor, c.device_path) for c in py]
+    assert len(native) == 4
+
+
+def test_native_ignores_fake_without_flag(fake_host):
+    _mk_fake_accel(fake_host.dev_root, 2)
+    assert NativeEnumerator(fake_host, allow_fake=False).enumerate() == []
+
+
+def test_native_vfio_fallback(fake_host):
+    vfio = os.path.join(fake_host.dev_root, "vfio")
+    os.mkdir(vfio)
+    for name in ("0", "1", "vfio"):
+        open(os.path.join(vfio, name), "w").close()
+    chips = NativeEnumerator(fake_host, allow_fake=True).enumerate()
+    assert len(chips) == 2
+    assert chips[0].device_path.endswith("/vfio/0")
+    assert chips[0].companion_paths and \
+        chips[0].companion_paths[0].endswith("/vfio/vfio")
+
+
+def test_native_pci_address(fake_host):
+    accel_cls = os.path.join(fake_host.sys_root, "class", "accel", "accel0")
+    os.makedirs(accel_cls)
+    pci_dir = os.path.join(fake_host.sys_root, "devices", "pci0",
+                           "0000:07:00.0")
+    os.makedirs(pci_dir)
+    os.symlink(pci_dir, os.path.join(accel_cls, "device"))
+    _mk_fake_accel(fake_host.dev_root, 1)
+    chips = NativeEnumerator(fake_host, allow_fake=True).enumerate()
+    assert chips[0].pci_address == "0000:07:00.0"
+
+
+def test_native_driver_major(fake_host):
+    with open(os.path.join(fake_host.proc_root, "devices"), "w") as f:
+        f.write("Character devices:\n120 accel\n\nBlock devices:\n")
+    enum = NativeEnumerator(fake_host, allow_fake=True)
+    assert enum.driver_major("accel") == 120
+    assert enum.driver_major("nosuch") is None
+
+
+def test_native_busy_detection(fake_host):
+    dev = os.path.join(fake_host.dev_root, "accel0")
+    open(dev, "w").close()
+    fd_dir = os.path.join(fake_host.proc_root, "100", "fd")
+    os.makedirs(fd_dir)
+    os.symlink(dev, os.path.join(fd_dir, "7"))
+    os.makedirs(os.path.join(fake_host.proc_root, "200", "fd"))
+    enum = NativeEnumerator(fake_host, allow_fake=True)
+    assert enum.device_open_pids([100, 200, 300], [dev]) == [100]
+    assert enum.device_open_pids([], [dev]) == []
+
+
+def test_best_enumerator_prefers_native(fake_host):
+    assert isinstance(best_enumerator(fake_host), NativeEnumerator)

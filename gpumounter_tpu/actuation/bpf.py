@@ -90,17 +90,17 @@ CONTAINER_DEFAULT_RULES: tuple[DeviceRule, ...] = (
 
 
 def rules_for_chips(chips: list[TPUChip]) -> list[DeviceRule]:
-    """Desired device-program allowlist: container defaults + chip nodes
-    (+ VFIO companions share the chip's major with distinct minors; companion
-    nodes are resolved by the caller who knows their majmin)."""
+    """Desired device-program allowlist: container defaults + chip nodes +
+    their companion nodes (VFIO group + container nodes carry their own
+    majmin — without these rules the chip node is visible but unusable)."""
     rules = list(CONTAINER_DEFAULT_RULES)
     seen: set[tuple[int, int]] = set()
     for chip in chips:
-        key = (chip.major, chip.minor)
-        if key not in seen:
-            seen.add(key)
-            rules.append(DeviceRule("c", ACC_RW | ACC_MKNOD,
-                                    chip.major, chip.minor))
+        for major, minor in [(chip.major, chip.minor),
+                             *((c.major, c.minor) for c in chip.companions)]:
+            if (major, minor) not in seen:
+                seen.add((major, minor))
+                rules.append(DeviceRule("c", ACC_RW | ACC_MKNOD, major, minor))
     return rules
 
 

@@ -1,0 +1,174 @@
+"""Mount/unmount façade: the one place that composes cgroup permissioning,
+device-node lifecycle, busy detection, and mount policy.
+
+Ref ``pkg/util/util.go``: ``MountGPU`` (:17-71), ``UnmountGPU`` (:73-150),
+``GetPodGPUProcesses`` (:152-196), ``CanMount`` (:207-226). Deliberate fixes:
+
+- The reference blindly uses ``pids[0]`` as the representative container PID
+  (util.go:50,118); we pick the first PID that still exists in /proc.
+- Busy state is a typed :class:`DeviceBusyError` carrying the PIDs, not the
+  string ``"GPUBusy"`` (util.go:108).
+- Device access + node creation cover VFIO companion nodes, which must ride
+  along for the chip to be usable.
+"""
+
+from __future__ import annotations
+
+import os
+
+from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
+from gpumounter_tpu.actuation.nsenter import ContainerNsActuator
+from gpumounter_tpu.device.enumerator import Enumerator
+from gpumounter_tpu.device.model import TPUChip
+from gpumounter_tpu.k8s import objects
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.config import HostPaths
+from gpumounter_tpu.utils.errors import (ActuationError, CgroupError,
+                                         DeviceBusyError, MountPolicyError)
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("actuation.mount")
+
+
+def can_mount(current: consts.MountType, requested_entire: bool) -> bool:
+    """Mount policy, ref util.go:207-226 CanMount:
+    Unknown => deny; already mounted + entire request => deny;
+    already entire-mounted => deny (only repeated single-mounts compose)."""
+    if current is consts.MountType.UNKNOWN:
+        return False
+    if current is consts.MountType.NONE:
+        return True
+    if requested_entire:
+        return False          # pod already has chips; entire must be atomic
+    return current is consts.MountType.SINGLE
+
+
+class TPUMounter:
+    """Actuates attach/detach of chips for one target container."""
+
+    def __init__(self, cgroups: CgroupDeviceController,
+                 actuator: ContainerNsActuator, enumerator: Enumerator,
+                 host: HostPaths | None = None):
+        self.cgroups = cgroups
+        self.actuator = actuator
+        self.enumerator = enumerator
+        self.host = host or HostPaths()
+
+    # -- helpers ---------------------------------------------------------------
+
+    def _target_container_id(self, pod: objects.Pod) -> str:
+        ids = objects.container_ids(pod)
+        if not ids:
+            raise ActuationError(
+                f"pod {objects.name(pod)} has no running containers")
+        return ids[0]
+
+    def _live_pid(self, pod: objects.Pod, container_id: str) -> int:
+        """First PID of the container cgroup that is still alive
+        (fixes util.go:50 pids[0] assumption)."""
+        pids = self.cgroups.get_pids(pod, container_id)
+        for pid in pids:
+            if os.path.isdir(os.path.join(self.host.proc_root, str(pid))):
+                return pid
+        raise ActuationError(
+            f"no live process in container {container_id} of pod "
+            f"{objects.name(pod)}")
+
+    @staticmethod
+    def _node_paths(chip: TPUChip) -> list[str]:
+        """Paths a holder's fd may resolve to: host-side and container-side
+        names of the chip and its companions."""
+        paths = [chip.device_path, chip.container_path]
+        for companion in chip.companions:
+            paths.append(companion.host_path)
+            paths.append(companion.container_path)
+        return list(dict.fromkeys(paths))
+
+    def pod_device_processes(self, pod: objects.Pod,
+                             chip: TPUChip) -> list[int]:
+        """PIDs inside the pod's container holding this chip open
+        (ref util.go:152-196: cgroup PIDs ∩ device holders)."""
+        container_id = self._target_container_id(pod)
+        try:
+            pids = self.cgroups.get_pids(pod, container_id)
+        except CgroupError:
+            return []
+        return self.enumerator.device_open_pids(pids,
+                                                self._node_paths(chip))
+
+    def _busy_map(self, pod: objects.Pod,
+                  chips: list[TPUChip]) -> dict[str, list[int]]:
+        """uuid -> holder PIDs, reading the container's cgroup.procs once."""
+        container_id = self._target_container_id(pod)
+        try:
+            pids = self.cgroups.get_pids(pod, container_id)
+        except CgroupError:
+            return {}
+        busy: dict[str, list[int]] = {}
+        for chip in chips:
+            holders = self.enumerator.device_open_pids(
+                pids, self._node_paths(chip))
+            if holders:
+                busy[chip.uuid] = holders
+        return busy
+
+    # -- attach ----------------------------------------------------------------
+
+    def mount_chips(self, pod: objects.Pod, new_chips: list[TPUChip],
+                    all_chips_after: list[TPUChip]) -> None:
+        """Expose ``new_chips`` inside the pod's first container.
+
+        ``all_chips_after`` is the pod's complete chip set including the new
+        ones — required because cgroup-v2 device programs are replaced whole
+        (defaults ∪ all chips), not incremented.
+
+        Ref util.go:17-71 MountGPU, per chip: cgroup allow -> pick PID ->
+        mknod. Companion nodes (VFIO) ride along.
+        """
+        container_id = self._target_container_id(pod)
+        self.cgroups.sync_device_access(pod, container_id, all_chips_after)
+        pid = self._live_pid(pod, container_id)
+        for chip in new_chips:
+            self.actuator.create_device_node(
+                pid, chip.container_path, chip.major, chip.minor)
+            for companion in chip.companions:
+                self.actuator.create_device_node(
+                    pid, companion.container_path, companion.major,
+                    companion.minor)
+        logger.info("mounted %d chips into %s/%s",
+                    len(new_chips), objects.namespace(pod), objects.name(pod))
+
+    # -- detach ----------------------------------------------------------------
+
+    def unmount_chips(self, pod: objects.Pod, chips: list[TPUChip],
+                      remaining_chips: list[TPUChip],
+                      force: bool = False) -> None:
+        """Remove ``chips`` from the pod's first container.
+
+        Ref util.go:73-150 UnmountGPU: busy re-check -> cgroup deny ->
+        rm device file -> (force) kill holders. Busy without force raises
+        :class:`DeviceBusyError` with the holder PIDs.
+        """
+        container_id = self._target_container_id(pod)
+        busy = self._busy_map(pod, chips)
+        if busy and not force:
+            uuid, pids = next(iter(busy.items()))
+            raise DeviceBusyError(uuid, pids)
+
+        self.cgroups.revoke_device_access(pod, container_id, chips,
+                                          remaining_chips)
+        pid = self._live_pid(pod, container_id)
+        remaining_companions = {c.host_path for chip in remaining_chips
+                                for c in chip.companions}
+        for chip in chips:
+            self.actuator.remove_device_node(pid, chip.container_path)
+            for companion in chip.companions:
+                if companion.host_path not in remaining_companions:
+                    self.actuator.remove_device_node(
+                        pid, companion.container_path)
+        if force and busy:
+            all_pids = sorted({p for pids in busy.values() for p in pids})
+            self.actuator.kill_processes(all_pids)
+            logger.warning("force-killed device holders: %s", all_pids)
+        logger.info("unmounted %d chips from %s/%s",
+                    len(chips), objects.namespace(pod), objects.name(pod))

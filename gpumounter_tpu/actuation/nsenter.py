@@ -1,0 +1,181 @@
+"""Device-node lifecycle inside the target container's mount namespace.
+
+Ref ``pkg/util/namespace/namespace.go``: the reference builds
+``nsenter --target <pid> --mount sh -c "mknod -m 666 /dev/nvidiaN c 195 M"``
+(:70-177), which requires the *target image* to ship ``sh`` and ``mknod``
+(their FAQ documents this limitation, ``docs/guide/FAQ.md:3-4``).
+
+We default to a stronger mechanism: with ``hostPID`` the container's root
+filesystem is addressable from the worker as ``/proc/<pid>/root/``, so the
+worker can ``mknod(2)``/``unlink(2)`` the device node *directly* — no binary
+inside the target image is needed, and no shell is spawned. The nsenter
+variant is retained as a fallback for kernels/configs where proc-root
+traversal is restricted.
+
+Signals cross PID namespaces fine from a hostPID root process, so force-kill
+is a plain ``kill(2)`` (ref namespace.go:191-201 execs ``kill`` in-namespace
+instead).
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import signal
+import stat as stat_mod
+import subprocess
+
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.config import HostPaths
+from gpumounter_tpu.utils.errors import ActuationError
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("actuation.nsenter")
+
+
+class ContainerNsActuator(abc.ABC):
+    """Create/remove device nodes in a container and signal its processes."""
+
+    @abc.abstractmethod
+    def create_device_node(self, pid: int, device_path: str, major: int,
+                           minor: int,
+                           mode: int = consts.DEVICE_FILE_MODE) -> None:
+        ...
+
+    @abc.abstractmethod
+    def remove_device_node(self, pid: int, device_path: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    def kill_processes(self, pids: list[int],
+                       sig: int = signal.SIGKILL) -> None:
+        ...
+
+
+class ProcRootActuator(ContainerNsActuator):
+    """Default: direct syscalls through ``/proc/<pid>/root``.
+
+    ``fake_nodes=True`` creates regular files with ``.majmin`` sidecars
+    instead of real char nodes — the same fixture format the enumerators
+    accept with ``allow_fake`` — so the full attach path runs unprivileged
+    in tests (BASELINE config 1).
+    """
+
+    def __init__(self, host: HostPaths | None = None,
+                 fake_nodes: bool = False):
+        self.host = host or HostPaths()
+        self.fake_nodes = fake_nodes
+
+    def _container_path(self, pid: int, device_path: str) -> str:
+        root = os.path.join(self.host.proc_root, str(pid), "root")
+        return root + device_path
+
+    def create_device_node(self, pid: int, device_path: str, major: int,
+                           minor: int,
+                           mode: int = consts.DEVICE_FILE_MODE) -> None:
+        target = self._container_path(pid, device_path)
+        parent = os.path.dirname(target)
+        try:
+            os.makedirs(parent, exist_ok=True)
+            if os.path.exists(target):
+                logger.debug("device node already present: %s", target)
+                return
+            if self.fake_nodes:
+                with open(target, "w"):
+                    pass
+                with open(target + ".majmin", "w") as f:
+                    f.write(f"{major}:{minor}")
+            else:
+                os.mknod(target, mode | stat_mod.S_IFCHR,
+                         os.makedev(major, minor))
+                os.chmod(target, mode)  # mknod mode is masked by umask
+        except OSError as e:
+            raise ActuationError(
+                f"mknod {device_path} (c {major}:{minor}) in pid {pid} "
+                f"mount ns failed: {e}") from e
+        logger.info("created %s (c %d:%d) via pid %d", device_path, major,
+                    minor, pid)
+
+    def remove_device_node(self, pid: int, device_path: str) -> None:
+        target = self._container_path(pid, device_path)
+        try:
+            if os.path.exists(target):
+                os.unlink(target)
+            sidecar = target + ".majmin"
+            if self.fake_nodes and os.path.exists(sidecar):
+                os.unlink(sidecar)
+        except OSError as e:
+            raise ActuationError(
+                f"unlink {device_path} in pid {pid} mount ns failed: {e}"
+            ) from e
+        logger.info("removed %s via pid %d", device_path, pid)
+
+    def kill_processes(self, pids: list[int],
+                       sig: int = signal.SIGKILL) -> None:
+        for pid in pids:
+            try:
+                os.kill(pid, sig)
+                logger.info("sent signal %d to pid %d", sig, pid)
+            except ProcessLookupError:
+                pass  # already gone — that's the goal
+            except OSError as e:
+                raise ActuationError(f"kill {pid} failed: {e}") from e
+
+
+class NsenterActuator(ContainerNsActuator):
+    """Parity fallback: shell out to nsenter(1) like the reference
+    (namespace.go:70-201). Requires sh + mknod in the target image."""
+
+    def __init__(self, nsenter_bin: str = "nsenter"):
+        self.nsenter_bin = nsenter_bin
+
+    def _run_in_mount_ns(self, pid: int, script: str) -> None:
+        cmd = [self.nsenter_bin, "--target", str(pid), "--mount", "--",
+               "sh", "-c", script]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise ActuationError(f"nsenter failed: {e}") from e
+        if proc.returncode != 0:
+            raise ActuationError(
+                f"nsenter script {script!r} in pid {pid} failed "
+                f"rc={proc.returncode}: {proc.stderr.strip()}")
+
+    def create_device_node(self, pid: int, device_path: str, major: int,
+                           minor: int,
+                           mode: int = consts.DEVICE_FILE_MODE) -> None:
+        # ref namespace.go:167-177 AddGPUDeviceFile
+        self._run_in_mount_ns(
+            pid, f"mknod -m {mode:o} {device_path} c {major} {minor}")
+
+    def remove_device_node(self, pid: int, device_path: str) -> None:
+        # ref namespace.go:179-189 RemoveGPUDeviceFile
+        self._run_in_mount_ns(pid, f"rm -f {device_path}")
+
+    def kill_processes(self, pids: list[int],
+                       sig: int = signal.SIGKILL) -> None:
+        # host-side kill works under hostPID; no need to enter the ns
+        ProcRootActuator().kill_processes(pids, sig)
+
+
+class RecordingActuator(ContainerNsActuator):
+    """Test double recording every call."""
+
+    def __init__(self):
+        self.created: list[tuple[int, str, int, int]] = []
+        self.removed: list[tuple[int, str]] = []
+        self.killed: list[tuple[int, int]] = []
+        self.fail_on_create: bool = False
+
+    def create_device_node(self, pid, device_path, major, minor,
+                           mode=consts.DEVICE_FILE_MODE):
+        if self.fail_on_create:
+            raise ActuationError("injected create failure")
+        self.created.append((pid, device_path, major, minor))
+
+    def remove_device_node(self, pid, device_path):
+        self.removed.append((pid, device_path))
+
+    def kill_processes(self, pids, sig=signal.SIGKILL):
+        self.killed.extend((pid, sig) for pid in pids)

@@ -1,0 +1,3 @@
+"""Wire contracts: worker mount RPC (ref ``pkg/api/gpu-mount/api.proto``) and
+the kubelet PodResources v1alpha1 client contract. Generated ``*_pb2.py``
+modules are vendored; regenerate with ``make -C gpumounter_tpu/api``."""

@@ -1,0 +1,6 @@
+"""Device discovery and cluster-state reconciliation (ref
+``pkg/util/gpu/collector``)."""
+
+from gpumounter_tpu.collector.collector import TPUCollector
+
+__all__ = ["TPUCollector"]

@@ -1,0 +1,129 @@
+"""TPUCollector: node chip inventory + allocation map.
+
+Ref ``pkg/util/gpu/collector/collector.go``: enumerate devices at startup
+(``GetGPUInfo``, :23-38), refresh the allocation map from the kubelet
+PodResources API before every decision (``UpdateGPUStatus``, :90-138), and
+aggregate a pod's chips *including its slave pods* (``GetPodGPUResources``,
+:149-163).
+
+Deliberate fixes over the reference (SURVEY.md §8 "bugs to NOT replicate"):
+
+- **Re-enumeration**: the reference reads the NVML device list once at startup
+  and never again (collector.go:23-38); we re-enumerate on every
+  ``update_status`` so physically hot-plugged chips appear (enumeration is a
+  directory scan — cheap).
+- **Locking**: the reference mutates shared ``GPUList`` from a concurrent gRPC
+  server with no mutex (collector.go:19-21,113-135); all state here is guarded
+  by an RLock.
+- Slave pods are matched by the owner *label* set at creation
+  (consts.OWNER_POD_LABEL_KEY) when pod objects are available, with the
+  name-prefix convention (``<pod>-slave-pod-``, ref collector.go:155-159) kept
+  as the PodResources-level fallback since that API reports names only.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from gpumounter_tpu.collector.podresources import PodResourcesClient
+from gpumounter_tpu.device.enumerator import Enumerator
+from gpumounter_tpu.device.model import DeviceState, TPUChip
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("collector")
+
+
+class TPUCollector:
+    def __init__(self, enumerator: Enumerator,
+                 podresources: PodResourcesClient,
+                 resource_name: str = consts.TPU_RESOURCE_NAME,
+                 pool_namespace: str = consts.DEFAULT_POOL_NAMESPACE):
+        self.enumerator = enumerator
+        self.podresources = podresources
+        self.resource_name = resource_name
+        self.pool_namespace = pool_namespace
+        self._lock = threading.RLock()
+        self._chips: dict[str, TPUChip] = {}       # uuid -> chip
+        self.update_status()
+        logger.info("collector initialised with %d chips", len(self._chips))
+
+    # -- inventory -------------------------------------------------------------
+
+    @property
+    def chips(self) -> list[TPUChip]:
+        with self._lock:
+            return list(self._chips.values())
+
+    def get_chip_by_uuid(self, uuid: str) -> TPUChip | None:
+        """Ref collector.go:81-88 GetGPUByUUID."""
+        with self._lock:
+            return self._chips.get(uuid)
+
+    # -- reconciliation --------------------------------------------------------
+
+    def update_status(self) -> None:
+        """Refresh inventory + allocation map (ref UpdateGPUStatus,
+        collector.go:90-138): re-enumerate chips, reset all to FREE, then mark
+        chips listed by the kubelet as ALLOCATED with their pod binding."""
+        listing = self.podresources.list_pods()
+        with self._lock:
+            # freshly enumerated chips start FREE; allocation state is fully
+            # re-derived from the kubelet listing every refresh
+            self._chips = {c.uuid: c for c in self.enumerator.enumerate()}
+            for pod in listing.pod_resources:
+                for container in pod.containers:
+                    for dev in container.devices:
+                        if dev.resource_name != self.resource_name:
+                            continue
+                        for device_id in dev.device_ids:
+                            chip = self._chips.get(device_id)
+                            if chip is None:
+                                logger.warning(
+                                    "kubelet reports unknown device %s for "
+                                    "pod %s/%s", device_id, pod.namespace,
+                                    pod.name)
+                                continue
+                            chip.state = DeviceState.ALLOCATED
+                            chip.pod_name = pod.name
+                            chip.namespace = pod.namespace
+
+    # -- aggregation -----------------------------------------------------------
+
+    def get_pod_chips(self, pod_name: str, namespace: str) -> list[TPUChip]:
+        """Chips allocated to exactly this pod (after a fresh update)."""
+        self.update_status()
+        with self._lock:
+            return [c for c in self._chips.values()
+                    if c.state is DeviceState.ALLOCATED
+                    and c.pod_name == pod_name and c.namespace == namespace]
+
+    def get_pod_tpu_resources(self, pod_name: str,
+                              namespace: str) -> list[TPUChip]:
+        """Chips of the pod PLUS its slave pods (ref GetPodGPUResources,
+        collector.go:149-163: slave pods matched by the
+        ``<pod>-slave-pod-`` name prefix in the pool namespace)."""
+        self.update_status()
+        prefix = pod_name + consts.SLAVE_POD_INFIX
+        with self._lock:
+            out = []
+            for c in self._chips.values():
+                if c.state is not DeviceState.ALLOCATED:
+                    continue
+                if c.pod_name == pod_name and c.namespace == namespace:
+                    out.append(c)
+                elif (c.namespace == self.pool_namespace
+                      and c.pod_name.startswith(prefix)):
+                    out.append(c)
+            return out
+
+    def get_slave_pod_names(self, pod_name: str) -> list[str]:
+        """Distinct slave-pod names currently holding chips for this pod."""
+        self.update_status()
+        prefix = pod_name + consts.SLAVE_POD_INFIX
+        with self._lock:
+            names = {c.pod_name for c in self._chips.values()
+                     if c.state is DeviceState.ALLOCATED
+                     and c.namespace == self.pool_namespace
+                     and c.pod_name.startswith(prefix)}
+            return sorted(names)

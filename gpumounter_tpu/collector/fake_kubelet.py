@@ -1,0 +1,69 @@
+"""A real gRPC server speaking the PodResources protocol on a unix socket.
+
+Test double for the kubelet itself (SURVEY.md §4: "e2e harness ... fake
+kubelet socket server"): lets the production
+:class:`~gpumounter_tpu.collector.podresources.KubeletPodResourcesClient` be
+exercised over an actual socket, wire format and all.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+
+import grpc
+
+from gpumounter_tpu.api import podresources_pb2 as pb
+from gpumounter_tpu.collector.podresources import FakePodResourcesClient
+
+_LIST_METHOD = "List"
+_SERVICE = "v1alpha1.PodResourcesLister"
+
+
+class FakeKubeletServer:
+    """Serves List on ``unix://<socket_path>`` from a FakePodResourcesClient's
+    assignment table (mutable while running)."""
+
+    def __init__(self, socket_path: str,
+                 state: FakePodResourcesClient | None = None):
+        self.socket_path = socket_path
+        self.state = state or FakePodResourcesClient()
+        self._server: grpc.Server | None = None
+
+    def start(self) -> "FakeKubeletServer":
+        os.makedirs(os.path.dirname(self.socket_path), exist_ok=True)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=2))
+
+        def list_handler(request: pb.ListPodResourcesRequest,
+                         context: grpc.ServicerContext
+                         ) -> pb.ListPodResourcesResponse:
+            return self.state.list_pods()
+
+        handler = grpc.method_handlers_generic_handler(_SERVICE, {
+            _LIST_METHOD: grpc.unary_unary_rpc_method_handler(
+                list_handler,
+                request_deserializer=pb.ListPodResourcesRequest.FromString,
+                response_serializer=(
+                    pb.ListPodResourcesResponse.SerializeToString),
+            ),
+        })
+        self._server.add_generic_rpc_handlers((handler,))
+        self._server.add_insecure_port(f"unix://{self.socket_path}")
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=0)
+            self._server = None
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+
+    def __enter__(self) -> "FakeKubeletServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -33,7 +33,7 @@ import os
 import re
 import stat as stat_mod
 
-from gpumounter_tpu.device.model import TPUChip
+from gpumounter_tpu.device.model import CompanionNode, TPUChip
 from gpumounter_tpu.utils.config import HostPaths
 from gpumounter_tpu.utils.log import get_logger
 
@@ -93,6 +93,24 @@ def _stat_majmin(path: str) -> tuple[int, int] | None:
     return None
 
 
+def resolve_majmin(path: str, allow_fake: bool = False,
+                   fallback_minor: int = 0) -> tuple[int, int] | None:
+    """major:minor of a device node — stat(2) for real char devices, the
+    ``<path>.majmin`` sidecar convention for fixture files when
+    ``allow_fake``. Single source of truth for the fixture format."""
+    majmin = _stat_majmin(path)
+    if majmin is not None:
+        return majmin
+    if not allow_fake or not os.path.isfile(path):
+        return None
+    try:
+        with open(path + ".majmin") as f:
+            major_s, minor_s = f.read().strip().split(":")
+            return int(major_s), int(minor_s)
+    except (OSError, ValueError):
+        return 0, fallback_minor
+
+
 def _pci_address(sys_root: str, index: int) -> str:
     """Resolve the chip's PCI address from /sys/class/accel/accelN/device."""
     link = os.path.join(sys_root, "class", "accel", f"accel{index}", "device")
@@ -101,6 +119,17 @@ def _pci_address(sys_root: str, index: int) -> str:
     except OSError:
         return ""
     return os.path.basename(target)
+
+
+def vfio_container_companions(vfio_dir: str,
+                              allow_fake: bool) -> tuple[CompanionNode, ...]:
+    """The shared /dev/vfio/vfio container node as a CompanionNode (with its
+    own majmin so cgroup permissioning can cover it), or () if absent."""
+    container = os.path.join(vfio_dir, "vfio")
+    majmin = resolve_majmin(container, allow_fake)
+    if majmin is None:
+        return ()
+    return (CompanionNode(container, majmin[0], majmin[1]),)
 
 
 class PyEnumerator(Enumerator):
@@ -125,17 +154,15 @@ class PyEnumerator(Enumerator):
         return chips
 
     def _make_chip(self, path: str, index: int,
-                   companions: tuple[str, ...] = (),
+                   companions: tuple[CompanionNode, ...] = (),
                    pci_address: str = "") -> TPUChip | None:
-        majmin = _stat_majmin(path)
+        majmin = resolve_majmin(path, self.allow_fake, fallback_minor=index)
         if majmin is None:
-            if not self.allow_fake or not os.path.isfile(path):
-                return None
-            majmin = self._fixture_majmin(path, index)
+            return None
         return TPUChip(
             index=index, device_path=path, major=majmin[0], minor=majmin[1],
             uuid=str(index), pci_address=pci_address,
-            companion_paths=companions)
+            companions=companions)
 
     def _scan_accel(self) -> list[TPUChip]:
         chips: list[TPUChip] = []
@@ -163,8 +190,7 @@ class PyEnumerator(Enumerator):
             entries = os.listdir(vfio_dir)
         except OSError:
             return chips
-        container = os.path.join(vfio_dir, "vfio")
-        companions = (container,) if os.path.exists(container) else ()
+        companions = vfio_container_companions(vfio_dir, self.allow_fake)
         groups = sorted(int(n) for n in entries if _VFIO_GROUP_RE.match(n))
         for index, group in enumerate(groups):
             chip = self._make_chip(os.path.join(vfio_dir, str(group)), index,
@@ -172,16 +198,6 @@ class PyEnumerator(Enumerator):
             if chip is not None:
                 chips.append(chip)
         return chips
-
-    @staticmethod
-    def _fixture_majmin(path: str, index: int) -> tuple[int, int]:
-        sidecar = path + ".majmin"
-        try:
-            with open(sidecar) as f:
-                major_s, minor_s = f.read().strip().split(":")
-                return int(major_s), int(minor_s)
-        except (OSError, ValueError):
-            return 0, index
 
     # -- busy detection --------------------------------------------------------
 

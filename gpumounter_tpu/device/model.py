@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import json
+import os
 
 
 class DeviceState(str, enum.Enum):
@@ -28,6 +29,32 @@ class DeviceState(str, enum.Enum):
 
     FREE = "FREE"
     ALLOCATED = "ALLOCATED"
+
+
+def container_device_path(host_path: str) -> str:
+    """Canonical in-container node path for a host device path: vfio nodes
+    live at ``/dev/vfio/<name>``, everything else at ``/dev/<name>``. The one
+    place the host→container path rule is encoded."""
+    base = os.path.basename(host_path)
+    parent = os.path.basename(os.path.dirname(host_path))
+    if parent == "vfio":
+        return f"/dev/vfio/{base}"
+    return f"/dev/{base}"
+
+
+@dataclasses.dataclass(frozen=True)
+class CompanionNode:
+    """A device node that must be exposed alongside a chip for the runtime to
+    work (VFIO stacks need /dev/vfio/vfio + the group node). Carries its own
+    major:minor so cgroup permissioning can cover it."""
+
+    host_path: str
+    major: int
+    minor: int
+
+    @property
+    def container_path(self) -> str:
+        return container_device_path(self.host_path)
 
 
 @dataclasses.dataclass
@@ -42,10 +69,17 @@ class TPUChip:
     pci_address: str = ""       # e.g. 0000:05:00.0 (from sysfs), "" if unknown
     # Extra device nodes that must be exposed together with the chip node for
     # the runtime to work (VFIO stacks need /dev/vfio/vfio + the group node).
-    companion_paths: tuple[str, ...] = ()
+    companions: tuple[CompanionNode, ...] = ()
     state: DeviceState = DeviceState.FREE
     pod_name: str = ""          # set when ALLOCATED (ref nvidia.go:15-16)
     namespace: str = ""
+
+    @property
+    def container_path(self) -> str:
+        """Device-node path *inside* the target container — independent of
+        the host ``dev_root`` the chip was enumerated under (they coincide in
+        production, diverge in fixture trees)."""
+        return container_device_path(self.device_path)
 
     def reset_state(self) -> None:
         """Ref nvidia.go ResetState: back to FREE with no pod binding."""

@@ -14,7 +14,8 @@ from __future__ import annotations
 import ctypes
 import os
 
-from gpumounter_tpu.device.enumerator import Enumerator, PyEnumerator
+from gpumounter_tpu.device.enumerator import (Enumerator, PyEnumerator,
+                                              vfio_container_companions)
 from gpumounter_tpu.device.model import TPUChip
 from gpumounter_tpu.utils.config import HostPaths
 from gpumounter_tpu.utils.log import get_logger
@@ -96,9 +97,8 @@ class NativeEnumerator(Enumerator):
         if n < 0:
             raise OSError(f"tpuprobe_enumerate failed: {n}")
         chips: list[TPUChip] = []
-        vfio_container = os.path.join(self.host.dev_root, "vfio", "vfio")
-        companions = ((vfio_container,)
-                      if os.path.exists(vfio_container) else ())
+        companions = vfio_container_companions(
+            os.path.join(self.host.dev_root, "vfio"), self.allow_fake)
         for i in range(n):
             info = buf[i]
             chips.append(TPUChip(
@@ -108,7 +108,7 @@ class NativeEnumerator(Enumerator):
                 minor=info.minor,
                 uuid=str(info.index),
                 pci_address=info.pci_address.decode(),
-                companion_paths=companions if info.is_vfio else (),
+                companions=companions if info.is_vfio else (),
             ))
         return chips
 

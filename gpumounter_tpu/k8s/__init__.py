@@ -1,0 +1,2 @@
+"""Minimal Kubernetes API layer: pod-object helpers, REST client, fakes
+(ref ``pkg/config/config.go`` + client-go usage throughout)."""

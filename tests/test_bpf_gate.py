@@ -143,3 +143,16 @@ def test_supported_probe_does_not_crash(gate):
 def test_sync_missing_cgroup_raises(gate):
     with pytest.raises(OSError):
         gate.sync("/nonexistent/cgroup/path", [])
+
+
+def test_rules_cover_vfio_companions(gate):
+    # Regression: companion nodes (e.g. /dev/vfio/vfio) must get their own
+    # allow rules or the chip node is visible but unusable (EPERM on open).
+    from gpumounter_tpu.device.model import CompanionNode, TPUChip
+    comp = CompanionNode("/dev/vfio/vfio", 10, 196)
+    chip = TPUChip(index=0, device_path="/dev/vfio/0", major=511, minor=0,
+                   uuid="0", companions=(comp,))
+    prog = gate.build_program(rules_for_chips([chip]))
+    assert interpret(prog, DEV_CHAR, ACC_RW, 511, 0) == 1    # group node
+    assert interpret(prog, DEV_CHAR, ACC_RW, 10, 196) == 1   # companion
+    assert interpret(prog, DEV_CHAR, ACC_RW, 10, 197) == 0

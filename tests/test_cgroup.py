@@ -1,0 +1,220 @@
+"""cgroup layer tests (ref analog: cgroup_test.go, but against tmp fixture
+trees instead of a live node)."""
+
+import os
+
+import pytest
+
+from gpumounter_tpu.actuation.cgroup import (CgroupDeviceController,
+                                             CgroupResolver,
+                                             detect_cgroup_version)
+from gpumounter_tpu.device.fake import make_chips
+from gpumounter_tpu.k8s import objects
+from gpumounter_tpu.utils.errors import CgroupError
+
+UID = "a1b2c3d4-1111-2222-3333-444455556666"
+
+
+def mk_pod(qos_reported=None, qos_spec="guaranteed"):
+    pod = {
+        "metadata": {"name": "train-pod", "namespace": "default", "uid": UID},
+        "spec": {"containers": [{"name": "main", "resources": {}}]},
+        "status": {"containerStatuses": [
+            {"name": "main",
+             "containerID": "containerd://" + "ab" * 32}]},
+    }
+    if qos_reported:
+        pod["status"]["qosClass"] = qos_reported
+    if qos_spec == "guaranteed":
+        pod["spec"]["containers"][0]["resources"] = {
+            "limits": {"cpu": "1", "memory": "1Gi"},
+            "requests": {"cpu": "1", "memory": "1Gi"}}
+    elif qos_spec == "burstable":
+        pod["spec"]["containers"][0]["resources"] = {
+            "requests": {"cpu": "1"}}
+    return pod
+
+
+# -- QoS computation (ref cgroup.go:177-237) ----------------------------------
+
+def test_qos_guaranteed():
+    assert objects.compute_qos_class(mk_pod()) == objects.QOS_GUARANTEED
+
+
+def test_qos_burstable():
+    assert objects.compute_qos_class(mk_pod(qos_spec="burstable")) == \
+        objects.QOS_BURSTABLE
+
+
+def test_qos_best_effort():
+    assert objects.compute_qos_class(mk_pod(qos_spec="none")) == \
+        objects.QOS_BEST_EFFORT
+
+
+def test_qos_prefers_kubelet_reported():
+    pod = mk_pod(qos_reported="Burstable", qos_spec="guaranteed")
+    assert objects.qos_class(pod) == "Burstable"
+
+
+# -- path rendering (ref cgroup.go:52-113) ------------------------------------
+
+def test_cgroupfs_paths_per_qos():
+    r = CgroupResolver("cgroupfs")
+    assert r.pod_cgroup(mk_pod(qos_reported="Guaranteed")) == f"kubepods/pod{UID}"
+    assert r.pod_cgroup(mk_pod(qos_reported="Burstable")) == \
+        f"kubepods/burstable/pod{UID}"
+    assert r.pod_cgroup(mk_pod(qos_reported="BestEffort")) == \
+        f"kubepods/besteffort/pod{UID}"
+
+
+def test_cgroupfs_container_path():
+    r = CgroupResolver("cgroupfs")
+    cid = "docker://" + "cd" * 32
+    assert r.container_cgroup(mk_pod(qos_reported="Guaranteed"), cid) == \
+        f"kubepods/pod{UID}/{'cd' * 32}"
+
+
+def test_systemd_paths_per_qos():
+    r = CgroupResolver("systemd")
+    uid_r = UID.replace("-", "_")
+    assert r.pod_cgroup(mk_pod(qos_reported="Guaranteed")) == \
+        f"kubepods.slice/kubepods-pod{uid_r}.slice"
+    assert r.pod_cgroup(mk_pod(qos_reported="Burstable")) == \
+        (f"kubepods.slice/kubepods-burstable.slice/"
+         f"kubepods-burstable-pod{uid_r}.slice")
+
+
+def test_systemd_scope_prefixes_by_runtime():
+    r = CgroupResolver("systemd")
+    pod = mk_pod(qos_reported="Guaranteed")
+    base = r.pod_cgroup(pod)
+    hexid = "ef" * 32
+    assert r.container_cgroup(pod, f"containerd://{hexid}") == \
+        f"{base}/cri-containerd-{hexid}.scope"
+    assert r.container_cgroup(pod, f"docker://{hexid}") == \
+        f"{base}/docker-{hexid}.scope"
+    assert r.container_cgroup(pod, f"cri-o://{hexid}") == \
+        f"{base}/crio-{hexid}.scope"
+    # bare id assumes GKE containerd
+    assert r.container_cgroup(pod, hexid) == \
+        f"{base}/cri-containerd-{hexid}.scope"
+
+
+def test_bad_driver_rejected():
+    with pytest.raises(CgroupError):
+        CgroupResolver("bogus")
+
+
+# -- version detection ---------------------------------------------------------
+
+def test_detect_v2(tmp_path):
+    open(tmp_path / "cgroup.controllers", "w").close()
+    assert detect_cgroup_version(str(tmp_path)) == 2
+
+
+def test_detect_v1(tmp_path):
+    assert detect_cgroup_version(str(tmp_path)) == 1
+
+
+# -- v1 device permission writes (ref cgroup.go:143-169) -----------------------
+
+@pytest.fixture
+def v1_setup(fake_host):
+    pod = mk_pod(qos_reported="Guaranteed")
+    ctrl = CgroupDeviceController(fake_host, driver="cgroupfs", version=1)
+    cid = "containerd://" + "ab" * 32
+    cdir = ctrl.container_dir(pod, cid)
+    os.makedirs(cdir)
+    return pod, ctrl, cid, cdir
+
+
+def test_v1_allow_write(v1_setup):
+    pod, ctrl, cid, cdir = v1_setup
+    chips = make_chips(2, major=120)
+    ctrl.sync_device_access(pod, cid, chips)
+    # last write wins in the fixture file; the real kernel file is write-only
+    content = open(os.path.join(cdir, "devices.allow")).read()
+    assert content == "c 120:1 rw"
+
+
+def test_v1_deny_write(v1_setup):
+    pod, ctrl, cid, cdir = v1_setup
+    chips = make_chips(2, major=120)
+    ctrl.revoke_device_access(pod, cid, [chips[0]], [chips[1]])
+    assert open(os.path.join(cdir, "devices.deny")).read() == "c 120:0 rw"
+
+
+def test_v1_missing_cgroup_raises(fake_host):
+    ctrl = CgroupDeviceController(fake_host, driver="cgroupfs", version=1)
+    with pytest.raises(CgroupError):
+        ctrl.sync_device_access(mk_pod(qos_reported="Guaranteed"),
+                                "containerd://" + "ab" * 32, make_chips(1))
+
+
+def test_get_pids(v1_setup):
+    pod, ctrl, cid, cdir = v1_setup
+    with open(os.path.join(cdir, "cgroup.procs"), "w") as f:
+        f.write("100\n200\n300\n")
+    assert ctrl.get_pids(pod, cid) == [100, 200, 300]
+
+
+def test_get_pids_missing_raises(v1_setup):
+    pod, ctrl, cid, _ = v1_setup
+    with pytest.raises(CgroupError):
+        ctrl.get_pids(pod, "containerd://" + "00" * 32)
+
+
+# -- v2 path: BPF sync wiring (gate faked; kernel attach needs privileges) -----
+
+class RecordingGate:
+    def __init__(self):
+        self.calls = []
+
+    def sync(self, cgroup_dir, rules):
+        self.calls.append((cgroup_dir, len(rules)))
+        return 1
+
+
+def test_v2_sync_passes_full_ruleset(fake_host):
+    from gpumounter_tpu.actuation.bpf import CONTAINER_DEFAULT_RULES
+    pod = mk_pod(qos_reported="Guaranteed")
+    gate = RecordingGate()
+    ctrl = CgroupDeviceController(fake_host, driver="systemd", version=2,
+                                  bpf_gate=gate)
+    cid = "containerd://" + "ab" * 32
+    cdir = ctrl.container_dir(pod, cid)
+    os.makedirs(cdir)
+    chips = make_chips(4)
+    ctrl.sync_device_access(pod, cid, chips)
+    assert gate.calls == [(cdir, len(CONTAINER_DEFAULT_RULES) + 4)]
+    # detach back to 1 chip re-syncs with defaults+1
+    ctrl.revoke_device_access(pod, cid, chips[1:], chips[:1])
+    assert gate.calls[-1] == (cdir, len(CONTAINER_DEFAULT_RULES) + 1)
+
+
+def test_v2_missing_cgroup_raises(fake_host):
+    ctrl = CgroupDeviceController(fake_host, driver="systemd", version=2,
+                                  bpf_gate=RecordingGate())
+    with pytest.raises(CgroupError):
+        ctrl.sync_device_access(mk_pod(qos_reported="Guaranteed"),
+                                "containerd://" + "ab" * 32, make_chips(1))
+
+
+def test_v1_allow_covers_companions(fake_host):
+    from gpumounter_tpu.device.model import CompanionNode, TPUChip
+    pod = mk_pod(qos_reported="Guaranteed")
+    ctrl = CgroupDeviceController(fake_host, driver="cgroupfs", version=1)
+    cid = "containerd://" + "ab" * 32
+    cdir = ctrl.container_dir(pod, cid)
+    os.makedirs(cdir)
+    comp = CompanionNode("/dev/vfio/vfio", 10, 196)
+    chips = [TPUChip(index=i, device_path=f"/dev/vfio/{i}", major=511,
+                     minor=i, uuid=str(i), companions=(comp,))
+             for i in range(2)]
+    ctrl.sync_device_access(pod, cid, chips)
+    # fixture file holds the last write; companion written after chips? No —
+    # order is chip0, companion, chip1 (dedup keeps first companion)
+    assert open(os.path.join(cdir, "devices.allow")).read() == "c 511:1 rw"
+    # removing chip0 while chip1 remains must NOT deny the shared companion
+    ctrl.revoke_device_access(pod, cid, [chips[0]], [chips[1]])
+    assert open(os.path.join(cdir, "devices.deny")).read() == "c 511:0 rw"

@@ -1,0 +1,120 @@
+"""Collector tests (ref analog: collector_test.go, but hermetic: fake
+enumerator + real gRPC client against a fake kubelet unix-socket server)."""
+
+import pytest
+
+from gpumounter_tpu.collector.collector import TPUCollector
+from gpumounter_tpu.collector.fake_kubelet import FakeKubeletServer
+from gpumounter_tpu.collector.podresources import (FakePodResourcesClient,
+                                                   KubeletPodResourcesClient)
+from gpumounter_tpu.device.fake import FakeEnumerator, make_chips
+from gpumounter_tpu.device.model import DeviceState
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.errors import KubeletUnavailableError
+
+
+@pytest.fixture
+def fake_kubelet():
+    return FakePodResourcesClient()
+
+
+@pytest.fixture
+def collector(fake_kubelet):
+    return TPUCollector(FakeEnumerator(make_chips(4)), fake_kubelet,
+                        pool_namespace="tpu-pool")
+
+
+def test_initial_inventory_all_free(collector):
+    assert len(collector.chips) == 4
+    assert all(c.state is DeviceState.FREE for c in collector.chips)
+
+
+def test_update_status_marks_allocated(collector, fake_kubelet):
+    fake_kubelet.assign("default", "train-pod", ["1", "2"])
+    collector.update_status()
+    chip1 = collector.get_chip_by_uuid("1")
+    assert chip1.state is DeviceState.ALLOCATED
+    assert chip1.pod_name == "train-pod"
+    assert chip1.namespace == "default"
+    assert collector.get_chip_by_uuid("0").state is DeviceState.FREE
+
+
+def test_update_status_resets_stale_bindings(collector, fake_kubelet):
+    fake_kubelet.assign("default", "train-pod", ["1"])
+    collector.update_status()
+    fake_kubelet.unassign("default", "train-pod")
+    collector.update_status()
+    assert collector.get_chip_by_uuid("1").state is DeviceState.FREE
+    assert collector.get_chip_by_uuid("1").pod_name == ""
+
+
+def test_other_resources_ignored(collector, fake_kubelet):
+    fake_kubelet.assign("default", "gpu-pod", ["0"],
+                        resource=consts.GPU_RESOURCE_NAME)
+    collector.update_status()
+    assert collector.get_chip_by_uuid("0").state is DeviceState.FREE
+
+
+def test_unknown_device_id_warns_but_continues(collector, fake_kubelet):
+    fake_kubelet.assign("default", "p", ["99", "3"])
+    collector.update_status()
+    assert collector.get_chip_by_uuid("3").state is DeviceState.ALLOCATED
+
+
+def test_get_pod_tpu_resources_includes_slave_pods(collector, fake_kubelet):
+    fake_kubelet.assign("default", "train-pod", ["0"])
+    fake_kubelet.assign("tpu-pool", "train-pod-slave-pod-a1b2c3", ["1"])
+    fake_kubelet.assign("tpu-pool", "train-pod-slave-pod-d4e5f6", ["2"])
+    # a slave pod of a DIFFERENT owner must not match
+    fake_kubelet.assign("tpu-pool", "other-slave-pod-ffffff", ["3"])
+    chips = collector.get_pod_tpu_resources("train-pod", "default")
+    assert sorted(c.uuid for c in chips) == ["0", "1", "2"]
+    assert collector.get_slave_pod_names("train-pod") == [
+        "train-pod-slave-pod-a1b2c3", "train-pod-slave-pod-d4e5f6"]
+
+
+def test_slave_pod_in_wrong_namespace_ignored(collector, fake_kubelet):
+    fake_kubelet.assign("default", "train-pod-slave-pod-aaa", ["1"])
+    chips = collector.get_pod_tpu_resources("train-pod", "default")
+    assert [c.uuid for c in chips] == []
+
+
+def test_reenumeration_sees_hotplugged_chips(fake_kubelet):
+    enum = FakeEnumerator(make_chips(2))
+    coll = TPUCollector(enum, fake_kubelet)
+    assert len(coll.chips) == 2
+    enum.chips = make_chips(4)  # physical hot-plug
+    coll.update_status()
+    assert len(coll.chips) == 4  # reference could not do this (collector.go:23-38)
+
+
+def test_real_grpc_client_against_fake_kubelet(tmp_path):
+    socket_path = str(tmp_path / "pod-resources" / "kubelet.sock")
+    server = FakeKubeletServer(socket_path)
+    server.state.assign("default", "train-pod", ["0", "1"])
+    with server:
+        client = KubeletPodResourcesClient(socket_path, timeout_s=5)
+        resp = client.list_pods()
+        assert len(resp.pod_resources) == 1
+        pr = resp.pod_resources[0]
+        assert pr.name == "train-pod"
+        assert pr.containers[0].devices[0].resource_name == \
+            consts.TPU_RESOURCE_NAME
+        assert list(pr.containers[0].devices[0].device_ids) == ["0", "1"]
+
+
+def test_grpc_client_missing_socket_raises(tmp_path):
+    client = KubeletPodResourcesClient(str(tmp_path / "nope.sock"))
+    with pytest.raises(KubeletUnavailableError):
+        client.list_pods()
+
+
+def test_collector_over_real_socket(tmp_path):
+    socket_path = str(tmp_path / "kubelet.sock")
+    server = FakeKubeletServer(socket_path)
+    with server:
+        coll = TPUCollector(FakeEnumerator(make_chips(4)),
+                            KubeletPodResourcesClient(socket_path, timeout_s=5))
+        server.state.assign("default", "p", ["2"])
+        coll.update_status()
+        assert coll.get_chip_by_uuid("2").state is DeviceState.ALLOCATED

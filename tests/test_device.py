@@ -54,11 +54,17 @@ def test_py_enumerator_vfio_fallback(fake_host):
     os.mkdir(vfio)
     for name in ("0", "1", "vfio"):
         open(os.path.join(vfio, name), "w").close()
+    with open(os.path.join(vfio, "vfio.majmin"), "w") as f:
+        f.write("10:196")
     chips = PyEnumerator(fake_host, allow_fake=True).enumerate()
     assert len(chips) == 2
     assert chips[0].device_path.endswith("/vfio/0")
-    assert all(p.endswith("/vfio/vfio") for c in chips
-               for p in c.companion_paths)
+    assert chips[0].container_path == "/dev/vfio/0"
+    for c in chips:
+        (comp,) = c.companions
+        assert comp.host_path.endswith("/vfio/vfio")
+        assert comp.container_path == "/dev/vfio/vfio"
+        assert (comp.major, comp.minor) == (10, 196)
 
 
 def test_py_enumerator_pci_address_from_sysfs(fake_host):

@@ -1,0 +1,152 @@
+"""Mount façade + namespace actuation tests (ref analog: none — util.go had no
+tests; scenarios from SURVEY.md §3.2/3.3 call stacks)."""
+
+import os
+
+import pytest
+
+from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
+from gpumounter_tpu.actuation.mount import TPUMounter, can_mount
+from gpumounter_tpu.actuation.nsenter import (ProcRootActuator,
+                                              RecordingActuator)
+from gpumounter_tpu.device.enumerator import PyEnumerator
+from gpumounter_tpu.device.fake import FakeEnumerator, make_chips
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.errors import (ActuationError, DeviceBusyError)
+from tests.test_cgroup import UID, mk_pod
+
+
+# -- policy (ref util.go:207-226) ----------------------------------------------
+
+def test_can_mount_matrix():
+    MT = consts.MountType
+    assert can_mount(MT.NONE, False)
+    assert can_mount(MT.NONE, True)
+    assert can_mount(MT.SINGLE, False)
+    assert not can_mount(MT.SINGLE, True)
+    assert not can_mount(MT.ENTIRE, False)
+    assert not can_mount(MT.ENTIRE, True)
+    assert not can_mount(MT.UNKNOWN, False)
+    assert not can_mount(MT.UNKNOWN, True)
+
+
+# -- fixtures ------------------------------------------------------------------
+
+@pytest.fixture
+def rig(fake_host):
+    """Container cgroup + live pid + fake chips, wired through real
+    CgroupDeviceController(v1) and RecordingActuator."""
+    pod = mk_pod(qos_reported="Guaranteed")
+    cid = "containerd://" + "ab" * 32
+    ctrl = CgroupDeviceController(fake_host, driver="cgroupfs", version=1)
+    cdir = ctrl.container_dir(pod, cid)
+    os.makedirs(cdir)
+    with open(os.path.join(cdir, "cgroup.procs"), "w") as f:
+        f.write("4242\n4243\n")
+    os.makedirs(os.path.join(fake_host.proc_root, "4242"))
+    enum = FakeEnumerator(make_chips(4))
+    actuator = RecordingActuator()
+    mounter = TPUMounter(ctrl, actuator, enum, fake_host)
+    return pod, mounter, actuator, enum, cdir
+
+
+def test_mount_chips_full_path(rig):
+    pod, mounter, actuator, enum, cdir = rig
+    chips = make_chips(2)
+    mounter.mount_chips(pod, chips, chips)
+    # cgroup v1 allow written
+    assert open(os.path.join(cdir, "devices.allow")).read() == "c 120:1 rw"
+    # device nodes created via the first LIVE pid (4242; 4243 has no /proc dir)
+    assert actuator.created == [(4242, "/dev/accel0", 120, 0),
+                                (4242, "/dev/accel1", 120, 1)]
+
+
+def test_mount_no_containers_raises(rig):
+    pod, mounter, *_ = rig
+    pod["status"]["containerStatuses"] = []
+    with pytest.raises(ActuationError):
+        mounter.mount_chips(pod, make_chips(1), make_chips(1))
+
+
+def test_mount_no_live_pid_raises(rig, fake_host):
+    pod, mounter, actuator, enum, cdir = rig
+    os.rmdir(os.path.join(fake_host.proc_root, "4242"))
+    with pytest.raises(ActuationError):
+        mounter.mount_chips(pod, make_chips(1), make_chips(1))
+
+
+def test_unmount_clean(rig):
+    pod, mounter, actuator, enum, cdir = rig
+    chips = make_chips(2)
+    mounter.mount_chips(pod, chips, chips)
+    mounter.unmount_chips(pod, [chips[0]], [chips[1]])
+    assert open(os.path.join(cdir, "devices.deny")).read() == "c 120:0 rw"
+    assert actuator.removed == [(4242, "/dev/accel0")]
+    assert actuator.killed == []
+
+
+def test_unmount_busy_raises_with_pids(rig):
+    pod, mounter, actuator, enum, cdir = rig
+    chips = make_chips(1)
+    enum.busy_pids = {"/dev/accel0": [4242]}
+    with pytest.raises(DeviceBusyError) as exc:
+        mounter.unmount_chips(pod, chips, [])
+    assert exc.value.pids == [4242]
+    assert actuator.removed == []  # nothing touched on busy
+
+
+def test_unmount_force_kills_holders(rig):
+    pod, mounter, actuator, enum, cdir = rig
+    chips = make_chips(1)
+    enum.busy_pids = {"/dev/accel0": [4242]}
+    mounter.unmount_chips(pod, chips, [], force=True)
+    assert actuator.removed == [(4242, "/dev/accel0")]
+    assert actuator.killed == [(4242, 9)]
+
+
+def test_pod_device_processes_intersection(rig):
+    pod, mounter, actuator, enum, cdir = rig
+    # 9999 holds the device but is NOT in the container cgroup
+    enum.busy_pids = {"/dev/accel0": [4242, 9999]}
+    assert mounter.pod_device_processes(pod, make_chips(1)[0]) == [4242]
+
+
+# -- ProcRootActuator end-to-end on a fixture tree -----------------------------
+
+def test_proc_root_actuator_fake_nodes(fake_host):
+    actuator = ProcRootActuator(fake_host, fake_nodes=True)
+    container_root = os.path.join(fake_host.proc_root, "4242", "root")
+    os.makedirs(os.path.join(container_root, "dev"))
+    actuator.create_device_node(4242, "/dev/accel0", 120, 0)
+    node = os.path.join(container_root, "dev", "accel0")
+    assert os.path.exists(node)
+    assert open(node + ".majmin").read() == "120:0"
+    # the created node is visible to an enumerator scanning the container's /dev
+    from gpumounter_tpu.utils.config import HostPaths
+    inner = PyEnumerator(HostPaths(dev_root=os.path.join(container_root, "dev")),
+                         allow_fake=True)
+    assert [c.minor for c in inner.enumerate()] == [0]
+    # idempotent create
+    actuator.create_device_node(4242, "/dev/accel0", 120, 0)
+    actuator.remove_device_node(4242, "/dev/accel0")
+    assert not os.path.exists(node)
+    assert not os.path.exists(node + ".majmin")
+
+
+def test_proc_root_actuator_real_mknod_if_privileged(fake_host):
+    actuator = ProcRootActuator(fake_host, fake_nodes=False)
+    os.makedirs(os.path.join(fake_host.proc_root, "1", "root", "dev"))
+    try:
+        actuator.create_device_node(1, "/dev/accel0", 120, 0)
+    except ActuationError:
+        pytest.skip("no CAP_MKNOD in this environment")
+    import stat
+    st = os.stat(os.path.join(fake_host.proc_root, "1", "root", "dev",
+                              "accel0"))
+    assert stat.S_ISCHR(st.st_mode)
+    assert os.major(st.st_rdev) == 120 and os.minor(st.st_rdev) == 0
+    assert stat.S_IMODE(st.st_mode) == consts.DEVICE_FILE_MODE
+
+
+def test_kill_processes_tolerates_gone_pids(fake_host):
+    ProcRootActuator(fake_host).kill_processes([2 ** 22 + 12345])  # no raise

@@ -55,8 +55,8 @@ def test_native_vfio_fallback(fake_host):
     chips = NativeEnumerator(fake_host, allow_fake=True).enumerate()
     assert len(chips) == 2
     assert chips[0].device_path.endswith("/vfio/0")
-    assert chips[0].companion_paths and \
-        chips[0].companion_paths[0].endswith("/vfio/vfio")
+    assert chips[0].companions and \
+        chips[0].companions[0].host_path.endswith("/vfio/vfio")
 
 
 def test_native_pci_address(fake_host):

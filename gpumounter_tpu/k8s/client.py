@@ -1,0 +1,331 @@
+"""Minimal Kubernetes API client (pods only) + in-memory fake.
+
+The reference uses client-go with a ``sync.Once`` singleton clientset
+(``pkg/config/config.go:30-45``) and issues raw per-request LISTs with no
+informers (``cmd/GPUMounter-master/main.go:248``). This build has no
+Kubernetes client library available, so we speak the REST API directly — which
+is all the control plane needs: pod get/list/create/delete plus **watch**
+streams. Watches are what replace the reference's unbounded apiserver
+busy-polls (``allocator.go:247-282``) with event-driven waits.
+
+Two implementations of one interface:
+
+- :class:`InClusterKubeClient` — production; reads the serviceaccount token /
+  CA / namespace like client-go's ``rest.InClusterConfig`` and talks HTTPS to
+  ``$KUBERNETES_SERVICE_HOST``.
+- :class:`FakeKubeClient` — tests; an in-memory pod store with a pluggable
+  "scheduler" hook so tests can script kubelet/scheduler behaviour
+  (pod goes Running, goes Unschedulable, never schedules, ...).
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import os
+import ssl
+import threading
+import time
+import urllib.parse
+import urllib.request
+from collections.abc import Callable, Iterator
+from typing import Any
+
+from gpumounter_tpu.k8s import objects
+from gpumounter_tpu.utils.errors import K8sApiError, PodNotFoundError
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("k8s.client")
+
+SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# (event_type, pod) as delivered by a watch stream; event_type is one of
+# ADDED / MODIFIED / DELETED / BOOKMARK.
+WatchEvent = tuple[str, objects.Pod]
+
+
+class KubeClient(abc.ABC):
+    """The exact API surface the control plane needs — nothing more."""
+
+    @abc.abstractmethod
+    def get_pod(self, namespace: str, name: str) -> objects.Pod:
+        """Raises :class:`PodNotFoundError` on 404."""
+
+    @abc.abstractmethod
+    def list_pods(self, namespace: str,
+                  label_selector: str | None = None) -> list[objects.Pod]:
+        ...
+
+    @abc.abstractmethod
+    def create_pod(self, namespace: str, pod: objects.Pod) -> objects.Pod:
+        ...
+
+    @abc.abstractmethod
+    def delete_pod(self, namespace: str, name: str,
+                   grace_period_seconds: int = 0) -> None:
+        """404s are swallowed — deleting an already-gone pod is success."""
+
+    @abc.abstractmethod
+    def watch_pods(self, namespace: str, label_selector: str | None = None,
+                   field_selector: str | None = None,
+                   timeout_s: float = 60.0) -> Iterator[WatchEvent]:
+        """Stream events for up to ``timeout_s``; iterator ends at deadline."""
+
+
+# -- production client ---------------------------------------------------------
+
+
+class InClusterKubeClient(KubeClient):
+    """Talks to the apiserver with the pod's serviceaccount credentials.
+
+    Mirrors client-go in-cluster config: host/port from
+    ``KUBERNETES_SERVICE_HOST/PORT``, bearer token + CA from the mounted
+    serviceaccount volume (ref ``pkg/config/config.go:18-28``).
+    """
+
+    def __init__(self, host: str | None = None,
+                 sa_dir: str = SERVICEACCOUNT_DIR):
+        if host is None:
+            khost = os.environ.get("KUBERNETES_SERVICE_HOST")
+            kport = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not khost:
+                raise K8sApiError(
+                    0, "KUBERNETES_SERVICE_HOST unset: not running in-cluster")
+            host = f"https://{khost}:{kport}"
+        self.base = host.rstrip("/")
+        self._sa_dir = sa_dir
+        self._token_path = os.path.join(sa_dir, "token")
+        ca_path = os.path.join(sa_dir, "ca.crt")
+        if os.path.exists(ca_path):
+            self._ssl = ssl.create_default_context(cafile=ca_path)
+        else:  # e.g. test apiserver over plain http
+            self._ssl = None
+
+    def _token(self) -> str:
+        # Re-read every request: serviceaccount tokens are rotated by kubelet.
+        try:
+            with open(self._token_path) as f:
+                return f.read().strip()
+        except OSError:
+            return ""
+
+    def _request(self, method: str, path: str,
+                 query: dict[str, str] | None = None,
+                 body: dict[str, Any] | None = None,
+                 stream: bool = False, timeout: float = 30.0):
+        url = self.base + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        tok = self._token()
+        if tok:
+            req.add_header("Authorization", f"Bearer {tok}")
+        try:
+            resp = urllib.request.urlopen(req, context=self._ssl,
+                                          timeout=timeout)
+        except urllib.error.HTTPError as e:
+            msg = e.read().decode(errors="replace")[:512]
+            if e.code == 404:
+                raise PodNotFoundError("?", path) from e
+            raise K8sApiError(e.code, msg) from e
+        except urllib.error.URLError as e:
+            raise K8sApiError(0, f"apiserver unreachable: {e.reason}") from e
+        if stream:
+            return resp
+        with resp:
+            return json.loads(resp.read())
+
+    # -- KubeClient ------------------------------------------------------------
+
+    def get_pod(self, namespace: str, name: str) -> objects.Pod:
+        try:
+            return self._request(
+                "GET", f"/api/v1/namespaces/{namespace}/pods/{name}")
+        except PodNotFoundError:
+            raise PodNotFoundError(namespace, name) from None
+
+    def list_pods(self, namespace: str,
+                  label_selector: str | None = None) -> list[objects.Pod]:
+        query = {}
+        if label_selector:
+            query["labelSelector"] = label_selector
+        out = self._request("GET", f"/api/v1/namespaces/{namespace}/pods",
+                            query=query)
+        return out.get("items", [])
+
+    def create_pod(self, namespace: str, pod: objects.Pod) -> objects.Pod:
+        return self._request("POST", f"/api/v1/namespaces/{namespace}/pods",
+                             body=pod)
+
+    def delete_pod(self, namespace: str, name: str,
+                   grace_period_seconds: int = 0) -> None:
+        try:
+            self._request(
+                "DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}",
+                body={"gracePeriodSeconds": grace_period_seconds})
+        except PodNotFoundError:
+            pass
+
+    def watch_pods(self, namespace: str, label_selector: str | None = None,
+                   field_selector: str | None = None,
+                   timeout_s: float = 60.0) -> Iterator[WatchEvent]:
+        query = {"watch": "true",
+                 "timeoutSeconds": str(max(1, int(timeout_s)))}
+        if label_selector:
+            query["labelSelector"] = label_selector
+        if field_selector:
+            query["fieldSelector"] = field_selector
+        resp = self._request("GET", f"/api/v1/namespaces/{namespace}/pods",
+                             query=query, stream=True,
+                             timeout=timeout_s + 5.0)
+        with resp:
+            for line in resp:
+                if not line.strip():
+                    continue
+                try:
+                    event = json.loads(line)
+                except json.JSONDecodeError:
+                    logger.warning("unparseable watch line: %r", line[:200])
+                    continue
+                yield event.get("type", ""), event.get("object", {})
+
+
+# -- test fake -----------------------------------------------------------------
+
+
+def _match_label_selector(pod: objects.Pod, selector: str | None) -> bool:
+    if not selector:
+        return True
+    pod_labels = objects.labels(pod)
+    for clause in selector.split(","):
+        key, _, value = clause.partition("=")
+        if pod_labels.get(key.strip()) != value.strip():
+            return False
+    return True
+
+
+class FakeKubeClient(KubeClient):
+    """In-memory apiserver for tests.
+
+    ``on_create`` hooks play the scheduler/kubelet: each is called with the
+    stored pod dict right after creation (in a background thread, so watch
+    consumers see events asynchronously like the real thing) and may mutate it
+    via :meth:`set_pod_status`.
+    """
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._pods: dict[tuple[str, str], objects.Pod] = {}
+        self._events: list[tuple[str, objects.Pod]] = []
+        self.on_create: list[Callable[[objects.Pod], None]] = []
+        self.created: list[objects.Pod] = []
+        self.deleted: list[tuple[str, str]] = []
+        # When >0, delete_pod keeps the pod visible for this long (simulates
+        # graceful termination) before it disappears.
+        self.delete_latency_s: float = 0.0
+
+    # -- test scripting API ----------------------------------------------------
+
+    def put_pod(self, pod: objects.Pod) -> None:
+        """Insert/replace a pod without firing on_create hooks."""
+        key = (objects.namespace(pod), objects.name(pod))
+        with self._lock:
+            event = "MODIFIED" if key in self._pods else "ADDED"
+            self._pods[key] = pod
+            self._record(event, pod)
+
+    def set_pod_status(self, namespace: str, name: str,
+                       **status: Any) -> None:
+        """Merge fields into pod.status and emit MODIFIED."""
+        with self._lock:
+            pod = self._pods[(namespace, name)]
+            pod.setdefault("status", {}).update(status)
+            self._record("MODIFIED", pod)
+
+    def _record(self, event_type: str, pod: objects.Pod) -> None:
+        self._events.append((event_type, json.loads(json.dumps(pod))))
+        self._lock.notify_all()
+
+    # -- KubeClient ------------------------------------------------------------
+
+    def get_pod(self, namespace: str, name: str) -> objects.Pod:
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise PodNotFoundError(namespace, name)
+            return json.loads(json.dumps(pod))
+
+    def list_pods(self, namespace: str,
+                  label_selector: str | None = None) -> list[objects.Pod]:
+        with self._lock:
+            return [json.loads(json.dumps(p))
+                    for (ns, _), p in self._pods.items()
+                    if ns == namespace
+                    and _match_label_selector(p, label_selector)]
+
+    def create_pod(self, namespace: str, pod: objects.Pod) -> objects.Pod:
+        pod = json.loads(json.dumps(pod))
+        pod.setdefault("metadata", {}).setdefault("namespace", namespace)
+        pod["metadata"].setdefault(
+            "uid", f"uid-{objects.name(pod)}")
+        pod.setdefault("status", {}).setdefault("phase", "Pending")
+        key = (namespace, objects.name(pod))
+        with self._lock:
+            if key in self._pods:
+                raise K8sApiError(409, f"pod {key} already exists")
+            self._pods[key] = pod
+            self.created.append(pod)
+            self._record("ADDED", pod)
+        for hook in list(self.on_create):
+            threading.Thread(target=hook, args=(pod,), daemon=True).start()
+        return json.loads(json.dumps(pod))
+
+    def delete_pod(self, namespace: str, name: str,
+                   grace_period_seconds: int = 0) -> None:
+        def _remove():
+            with self._lock:
+                pod = self._pods.pop((namespace, name), None)
+                if pod is not None:
+                    self._record("DELETED", pod)
+        self.deleted.append((namespace, name))
+        if self.delete_latency_s > 0:
+            t = threading.Timer(self.delete_latency_s, _remove)
+            t.daemon = True
+            t.start()
+        else:
+            _remove()
+
+    def watch_pods(self, namespace: str, label_selector: str | None = None,
+                   field_selector: str | None = None,
+                   timeout_s: float = 60.0) -> Iterator[WatchEvent]:
+        # Replays the full event log then follows new events — equivalent to
+        # a real watch started from resourceVersion=0.
+        deadline = time.monotonic() + timeout_s
+        cursor = 0
+        field_name = None
+        if field_selector and field_selector.startswith("metadata.name="):
+            field_name = field_selector.split("=", 1)[1]
+        while True:
+            with self._lock:
+                while cursor >= len(self._events):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._lock.wait(
+                            timeout=min(remaining, 0.5)):
+                        if time.monotonic() >= deadline:
+                            return
+                batch = self._events[cursor:]
+                cursor = len(self._events)
+            for event_type, pod in batch:
+                if objects.namespace(pod) != namespace:
+                    continue
+                if not _match_label_selector(pod, label_selector):
+                    continue
+                if field_name and objects.name(pod) != field_name:
+                    continue
+                yield event_type, pod
+            if time.monotonic() >= deadline:
+                return

@@ -18,6 +18,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
 
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "gpumounter_tpu", "native")
+
+
+def pytest_configure(config):
+    """Build the native .so components once per session if missing, so the
+    suite is runnable from a clean checkout (`make -C gpumounter_tpu/native`
+    is what the worker Docker image runs)."""
+    del config
+    wanted = [os.path.join(_NATIVE_DIR, "build", n)
+              for n in ("libtpuprobe.so", "libbpfgate.so")]
+    if all(os.path.exists(p) for p in wanted):
+        return
+    import subprocess
+    subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                   capture_output=True)
+
 
 @pytest.fixture
 def fake_host(tmp_path):

@@ -1,0 +1,224 @@
+"""Tests for the minimal k8s client: FakeKubeClient semantics, and
+InClusterKubeClient wire behaviour against a stub apiserver speaking plain
+HTTP (list/get/create/delete/watch streaming)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from gpumounter_tpu.k8s.client import FakeKubeClient, InClusterKubeClient
+from gpumounter_tpu.utils.errors import K8sApiError, PodNotFoundError
+
+
+def make_pod(name, namespace="default", labels=None, phase="Pending"):
+    return {
+        "metadata": {"name": name, "namespace": namespace,
+                     "labels": labels or {}},
+        "spec": {},
+        "status": {"phase": phase},
+    }
+
+
+# -- FakeKubeClient ------------------------------------------------------------
+
+
+def test_fake_get_missing_raises():
+    c = FakeKubeClient()
+    with pytest.raises(PodNotFoundError):
+        c.get_pod("default", "nope")
+
+
+def test_fake_create_get_list_delete():
+    c = FakeKubeClient()
+    c.create_pod("default", make_pod("p1", labels={"app": "x"}))
+    c.create_pod("default", make_pod("p2", labels={"app": "y"}))
+    assert c.get_pod("default", "p1")["metadata"]["name"] == "p1"
+    assert len(c.list_pods("default")) == 2
+    assert [p["metadata"]["name"]
+            for p in c.list_pods("default", label_selector="app=x")] == ["p1"]
+    c.delete_pod("default", "p1")
+    with pytest.raises(PodNotFoundError):
+        c.get_pod("default", "p1")
+    c.delete_pod("default", "p1")  # idempotent
+
+
+def test_fake_duplicate_create_conflicts():
+    c = FakeKubeClient()
+    c.create_pod("default", make_pod("p1"))
+    with pytest.raises(K8sApiError):
+        c.create_pod("default", make_pod("p1"))
+
+
+def test_fake_on_create_hook_mutates_async():
+    c = FakeKubeClient()
+
+    def scheduler(pod):
+        time.sleep(0.02)
+        c.set_pod_status(pod["metadata"]["namespace"],
+                         pod["metadata"]["name"], phase="Running")
+
+    c.on_create.append(scheduler)
+    c.create_pod("default", make_pod("p1"))
+    assert c.get_pod("default", "p1")["status"]["phase"] == "Pending"
+    deadline = time.time() + 2
+    while time.time() < deadline:
+        if c.get_pod("default", "p1")["status"]["phase"] == "Running":
+            break
+        time.sleep(0.01)
+    assert c.get_pod("default", "p1")["status"]["phase"] == "Running"
+
+
+def test_fake_watch_sees_past_and_future_events():
+    c = FakeKubeClient()
+    c.create_pod("default", make_pod("p1"))
+
+    seen = []
+
+    def consume():
+        for event_type, pod in c.watch_pods("default", timeout_s=2.0):
+            seen.append((event_type, pod["metadata"]["name"],
+                         pod["status"]["phase"]))
+            if event_type == "MODIFIED":
+                return
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.05)
+    c.set_pod_status("default", "p1", phase="Running")
+    t.join(timeout=3)
+    assert not t.is_alive()
+    assert ("ADDED", "p1", "Pending") in seen
+    assert ("MODIFIED", "p1", "Running") in seen
+
+
+def test_fake_watch_times_out():
+    c = FakeKubeClient()
+    start = time.monotonic()
+    events = list(c.watch_pods("default", timeout_s=0.2))
+    assert events == []
+    assert time.monotonic() - start < 2.0
+
+
+def test_fake_watch_field_selector():
+    c = FakeKubeClient()
+    c.create_pod("default", make_pod("p1"))
+    c.create_pod("default", make_pod("p2"))
+    events = list(c.watch_pods("default",
+                               field_selector="metadata.name=p2",
+                               timeout_s=0.2))
+    assert [name for _, pod in events
+            for name in [pod["metadata"]["name"]]] == ["p2"]
+
+
+# -- InClusterKubeClient against a stub apiserver ------------------------------
+
+
+class _StubApiserver(BaseHTTPRequestHandler):
+    pods = {}          # (ns, name) -> pod
+    requests_log = []
+
+    def log_message(self, *args):
+        pass
+
+    def _send_json(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        type(self).requests_log.append(("GET", self.path,
+                                        self.headers.get("Authorization")))
+        parts = self.path.split("?")[0].strip("/").split("/")
+        # /api/v1/namespaces/<ns>/pods[/<name>]
+        if "watch=true" in self.path:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            for (ns, name), pod in type(self).pods.items():
+                if ns == parts[3]:
+                    line = json.dumps(
+                        {"type": "ADDED", "object": pod}) + "\n"
+                    self.wfile.write(line.encode())
+            return
+        if len(parts) == 6:
+            pod = type(self).pods.get((parts[3], parts[5]))
+            if pod is None:
+                self._send_json(404, {"message": "not found"})
+            else:
+                self._send_json(200, pod)
+        else:
+            items = [p for (ns, _), p in type(self).pods.items()
+                     if ns == parts[3]]
+            self._send_json(200, {"items": items})
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        pod = json.loads(self.rfile.read(length))
+        ns = self.path.strip("/").split("/")[3]
+        type(self).pods[(ns, pod["metadata"]["name"])] = pod
+        self._send_json(201, pod)
+
+    def do_DELETE(self):
+        parts = self.path.strip("/").split("/")
+        type(self).pods.pop((parts[3], parts[5]), None)
+        self._send_json(200, {"status": "Success"})
+
+
+@pytest.fixture
+def stub_apiserver(tmp_path):
+    _StubApiserver.pods = {}
+    _StubApiserver.requests_log = []
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _StubApiserver)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    sa = tmp_path / "sa"
+    sa.mkdir()
+    (sa / "token").write_text("test-token")
+    client = InClusterKubeClient(
+        host=f"http://127.0.0.1:{server.server_port}", sa_dir=str(sa))
+    yield client
+    server.shutdown()
+
+
+def test_incluster_crud_roundtrip(stub_apiserver):
+    c = stub_apiserver
+    c.create_pod("default", make_pod("p1"))
+    assert c.get_pod("default", "p1")["metadata"]["name"] == "p1"
+    assert len(c.list_pods("default")) == 1
+    c.delete_pod("default", "p1")
+    with pytest.raises(PodNotFoundError) as ei:
+        c.get_pod("default", "p1")
+    assert ei.value.namespace == "default"
+    c.delete_pod("default", "p1")  # 404 swallowed
+
+
+def test_incluster_sends_bearer_token(stub_apiserver):
+    c = stub_apiserver
+    c.list_pods("default")
+    auths = [a for (_, _, a) in _StubApiserver.requests_log]
+    assert "Bearer test-token" in auths
+
+
+def test_incluster_watch_stream(stub_apiserver):
+    c = stub_apiserver
+    c.create_pod("default", make_pod("p1", phase="Running"))
+    events = list(c.watch_pods("default", timeout_s=2))
+    assert events and events[0][0] == "ADDED"
+    assert events[0][1]["metadata"]["name"] == "p1"
+
+
+def test_incluster_requires_env_when_no_host():
+    import os
+    old = os.environ.pop("KUBERNETES_SERVICE_HOST", None)
+    try:
+        with pytest.raises(K8sApiError):
+            InClusterKubeClient()
+    finally:
+        if old is not None:
+            os.environ["KUBERNETES_SERVICE_HOST"] = old

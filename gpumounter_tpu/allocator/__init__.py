@@ -1,0 +1,5 @@
+"""Slave-pod allocation layer (scheduler integration)."""
+
+from gpumounter_tpu.allocator.allocator import TPUAllocator
+
+__all__ = ["TPUAllocator"]

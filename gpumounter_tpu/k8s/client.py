@@ -129,8 +129,6 @@ class InClusterKubeClient(KubeClient):
                                           timeout=timeout)
         except urllib.error.HTTPError as e:
             msg = e.read().decode(errors="replace")[:512]
-            if e.code == 404:
-                raise PodNotFoundError("?", path) from e
             raise K8sApiError(e.code, msg) from e
         except urllib.error.URLError as e:
             raise K8sApiError(0, f"apiserver unreachable: {e.reason}") from e
@@ -145,8 +143,10 @@ class InClusterKubeClient(KubeClient):
         try:
             return self._request(
                 "GET", f"/api/v1/namespaces/{namespace}/pods/{name}")
-        except PodNotFoundError:
-            raise PodNotFoundError(namespace, name) from None
+        except K8sApiError as e:
+            if e.status == 404:
+                raise PodNotFoundError(namespace, name) from None
+            raise
 
     def list_pods(self, namespace: str,
                   label_selector: str | None = None) -> list[objects.Pod]:
@@ -167,8 +167,9 @@ class InClusterKubeClient(KubeClient):
             self._request(
                 "DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}",
                 body={"gracePeriodSeconds": grace_period_seconds})
-        except PodNotFoundError:
-            pass
+        except K8sApiError as e:
+            if e.status != 404:
+                raise
 
     def watch_pods(self, namespace: str, label_selector: str | None = None,
                    field_selector: str | None = None,
@@ -182,16 +183,23 @@ class InClusterKubeClient(KubeClient):
         resp = self._request("GET", f"/api/v1/namespaces/{namespace}/pods",
                              query=query, stream=True,
                              timeout=timeout_s + 5.0)
-        with resp:
-            for line in resp:
-                if not line.strip():
-                    continue
-                try:
-                    event = json.loads(line)
-                except json.JSONDecodeError:
-                    logger.warning("unparseable watch line: %r", line[:200])
-                    continue
-                yield event.get("type", ""), event.get("object", {})
+        try:
+            with resp:
+                for line in resp:
+                    if not line.strip():
+                        continue
+                    try:
+                        event = json.loads(line)
+                    except json.JSONDecodeError:
+                        logger.warning("unparseable watch line: %r",
+                                       line[:200])
+                        continue
+                    yield event.get("type", ""), event.get("object", {})
+        except OSError as e:
+            # Mid-stream network failure: surface a typed error so callers'
+            # cleanup paths (allocator rollback) engage instead of a raw
+            # ConnectionResetError escaping the iterator.
+            raise K8sApiError(0, f"watch stream broken: {e}") from e
 
 
 # -- test fake -----------------------------------------------------------------
@@ -222,6 +230,7 @@ class FakeKubeClient(KubeClient):
         self._pods: dict[tuple[str, str], objects.Pod] = {}
         self._events: list[tuple[str, objects.Pod]] = []
         self.on_create: list[Callable[[objects.Pod], None]] = []
+        self.on_delete: list[Callable[[objects.Pod], None]] = []
         self.created: list[objects.Pod] = []
         self.deleted: list[tuple[str, str]] = []
         # When >0, delete_pod keeps the pod visible for this long (simulates
@@ -291,6 +300,9 @@ class FakeKubeClient(KubeClient):
                 pod = self._pods.pop((namespace, name), None)
                 if pod is not None:
                     self._record("DELETED", pod)
+            if pod is not None:
+                for hook in list(self.on_delete):
+                    hook(pod)
         self.deleted.append((namespace, name))
         if self.delete_latency_s > 0:
             t = threading.Timer(self.delete_latency_s, _remove)

@@ -1,0 +1,155 @@
+"""Minimal Prometheus-text-format metrics registry.
+
+The reference has no metrics at all (SURVEY.md §5: "No metrics endpoint, no
+health/readiness probes"). The north-star number for this framework is
+hot-attach latency (<3s p50, BASELINE.md), so it must be measured in
+production, not just in benchmarks: the worker exports an attach/detach
+latency histogram + result counters on its health port, text exposition
+format, scrapeable by any Prometheus.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from collections.abc import Iterator
+
+# Histogram bucket upper bounds (seconds) sized around the 3s p50 target.
+DEFAULT_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.0, 3.0, 5.0, 10.0, 30.0, 60.0)
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._lock = threading.Lock()
+        self._values: dict[tuple[tuple[str, str], ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def render(self) -> Iterator[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        with self._lock:
+            items = list(self._values.items())
+        for key, value in items:
+            yield f"{self.name}{_fmt_labels(dict(key))} {_fmt_num(value)}"
+
+
+class Histogram:
+    # Exact observations kept for percentile(); bounded so a long-lived
+    # worker daemon doesn't grow memory with every attach.
+    MAX_OBSERVATIONS = 4096
+
+    def __init__(self, name: str, help_text: str,
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_text
+        self.buckets = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._total = 0
+        self._observations: collections.deque[float] = collections.deque(
+            maxlen=self.MAX_OBSERVATIONS)
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._total += 1
+            self._observations.append(value)
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def time(self) -> "_Timer":
+        return _Timer(self)
+
+    def percentile(self, q: float) -> float:
+        """Exact percentile over all observations (for tests/bench; a real
+        Prometheus would estimate from buckets)."""
+        with self._lock:
+            if not self._observations:
+                return 0.0
+            ordered = sorted(self._observations)
+            idx = min(len(ordered) - 1, int(q / 100.0 * len(ordered)))
+            return ordered[idx]
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._total
+
+    def render(self) -> Iterator[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        with self._lock:
+            cumulative = 0
+            for i, bound in enumerate(self.buckets):
+                cumulative += self._counts[i]
+                yield (f'{self.name}_bucket{{le="{_fmt_num(bound)}"}} '
+                       f"{cumulative}")
+            cumulative += self._counts[-1]
+            yield f'{self.name}_bucket{{le="+Inf"}} {cumulative}'
+            yield f"{self.name}_sum {_fmt_num(self._sum)}"
+            yield f"{self.name}_count {cumulative}"
+
+
+class _Timer:
+    def __init__(self, hist: Histogram):
+        self._hist = hist
+
+    def __enter__(self) -> "_Timer":
+        self._start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._hist.observe(time.monotonic() - self._start)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_num(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+class Registry:
+    """Process-wide metric set for one binary (worker or master)."""
+
+    def __init__(self):
+        self.attach_latency = Histogram(
+            "tpumounter_attach_seconds",
+            "End-to-end AddTPU latency (allocation + actuation)")
+        self.detach_latency = Histogram(
+            "tpumounter_detach_seconds",
+            "End-to-end RemoveTPU latency")
+        self.attach_results = Counter(
+            "tpumounter_attach_total", "AddTPU calls by result")
+        self.detach_results = Counter(
+            "tpumounter_detach_total", "RemoveTPU calls by result")
+
+    def render_text(self) -> str:
+        lines: list[str] = []
+        for metric in (self.attach_latency, self.detach_latency,
+                       self.attach_results, self.detach_results):
+            lines.extend(metric.render())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()

@@ -1,0 +1,6 @@
+"""Per-node worker: mount orchestration service + gRPC server."""
+
+from gpumounter_tpu.worker.service import AddOutcome, RemoveOutcome, \
+    TPUMountService
+
+__all__ = ["TPUMountService", "AddOutcome", "RemoveOutcome"]

@@ -1,0 +1,122 @@
+"""gRPC wire adapter for the worker service.
+
+Ref ``cmd/GPUMounter-worker/main.go:24-33`` (insecure gRPC on :1200 with both
+services registered). One combined ``tpu_mount.TPUMountService`` here instead
+of the reference's two single-method services (``api.proto:21-23,43-45``) —
+same RPCs, one registration. Policy violations and actuation failures become
+gRPC status errors (FAILED_PRECONDITION / INTERNAL); expected domain outcomes
+ride in the response enum, exactly like the reference's result codes.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+
+import grpc
+
+from gpumounter_tpu.api import tpu_mount_pb2 as pb
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.errors import MountPolicyError, TPUMounterError
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.worker.service import TPUMountService
+
+logger = get_logger("worker.grpc")
+
+SERVICE_NAME = "tpu_mount.TPUMountService"
+
+
+def _add_handler(service: TPUMountService):
+    def handle(request: pb.AddTPURequest,
+               context: grpc.ServicerContext) -> pb.AddTPUResponse:
+        try:
+            outcome = service.add_tpu(request.pod_name, request.namespace,
+                                      request.tpu_num,
+                                      request.is_entire_mount)
+        except MountPolicyError as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        except TPUMounterError as e:
+            logger.exception("AddTPU internal failure")
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        resp = pb.AddTPUResponse(result=int(outcome.result))
+        resp.device_ids.extend(c.uuid for c in outcome.chips)
+        resp.device_paths.extend(c.container_path for c in outcome.chips)
+        return resp
+    return handle
+
+
+def _remove_handler(service: TPUMountService):
+    def handle(request: pb.RemoveTPURequest,
+               context: grpc.ServicerContext) -> pb.RemoveTPUResponse:
+        try:
+            outcome = service.remove_tpu(request.pod_name, request.namespace,
+                                         list(request.uuids), request.force)
+        except TPUMounterError as e:
+            logger.exception("RemoveTPU internal failure")
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        resp = pb.RemoveTPUResponse(result=int(outcome.result))
+        resp.busy_pids.extend(outcome.busy_pids)
+        return resp
+    return handle
+
+
+def build_server(service: TPUMountService,
+                 port: int = consts.WORKER_GRPC_PORT,
+                 address: str = "[::]",
+                 max_workers: int = 8) -> tuple[grpc.Server, int]:
+    """Returns (server, bound_port); port 0 picks a free port (tests)."""
+    server = grpc.server(
+        concurrent.futures.ThreadPoolExecutor(max_workers=max_workers))
+    handler = grpc.method_handlers_generic_handler(SERVICE_NAME, {
+        "AddTPU": grpc.unary_unary_rpc_method_handler(
+            _add_handler(service),
+            request_deserializer=pb.AddTPURequest.FromString,
+            response_serializer=pb.AddTPUResponse.SerializeToString),
+        "RemoveTPU": grpc.unary_unary_rpc_method_handler(
+            _remove_handler(service),
+            request_deserializer=pb.RemoveTPURequest.FromString,
+            response_serializer=pb.RemoveTPUResponse.SerializeToString),
+    })
+    server.add_generic_rpc_handlers((handler,))
+    bound = server.add_insecure_port(f"{address}:{port}")
+    return server, bound
+
+
+class WorkerClient:
+    """Typed client for the worker RPCs (used by the master and tests)."""
+
+    def __init__(self, target: str, timeout_s: float = 180.0):
+        self.target = target
+        self.timeout_s = timeout_s
+        self._channel = grpc.insecure_channel(target)
+        self._add = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/AddTPU",
+            request_serializer=pb.AddTPURequest.SerializeToString,
+            response_deserializer=pb.AddTPUResponse.FromString)
+        self._remove = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/RemoveTPU",
+            request_serializer=pb.RemoveTPURequest.SerializeToString,
+            response_deserializer=pb.RemoveTPUResponse.FromString)
+
+    def add_tpu(self, pod_name: str, namespace: str, tpu_num: int,
+                is_entire_mount: bool) -> pb.AddTPUResponse:
+        return self._add(
+            pb.AddTPURequest(pod_name=pod_name, namespace=namespace,
+                             tpu_num=tpu_num,
+                             is_entire_mount=is_entire_mount),
+            timeout=self.timeout_s)
+
+    def remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
+                   force: bool) -> pb.RemoveTPUResponse:
+        return self._remove(
+            pb.RemoveTPURequest(pod_name=pod_name, namespace=namespace,
+                                uuids=uuids, force=force),
+            timeout=self.timeout_s)
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self) -> "WorkerClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,106 @@
+"""Worker daemon entrypoint.
+
+Ref ``cmd/GPUMounter-worker/main.go``: boot logging, construct the mounter
+stack, serve gRPC on :1200. Additions the reference lacks (SURVEY.md §5):
+an HTTP health/metrics sidecar port (``/healthz``, ``/readyz``, ``/metrics``)
+so the DaemonSet can carry probes and Prometheus can scrape attach latency.
+
+Run as: ``python -m gpumounter_tpu.worker.main``.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
+from gpumounter_tpu.actuation.mount import TPUMounter
+from gpumounter_tpu.actuation.nsenter import ProcRootActuator
+from gpumounter_tpu.allocator import TPUAllocator
+from gpumounter_tpu.collector.collector import TPUCollector
+from gpumounter_tpu.collector.podresources import KubeletPodResourcesClient
+from gpumounter_tpu.device.native_enumerator import best_enumerator
+from gpumounter_tpu.k8s.client import InClusterKubeClient
+from gpumounter_tpu.utils.config import Settings
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+from gpumounter_tpu.worker.grpc_server import build_server
+from gpumounter_tpu.worker.service import TPUMountService
+
+logger = get_logger("worker.main")
+
+HEALTH_PORT_OFFSET = 1  # health on grpc_port + 1 (1201 by default)
+
+
+class _HealthHandler(BaseHTTPRequestHandler):
+    ready = False
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        if self.path == "/metrics":
+            body = REGISTRY.render_text().encode()
+            ctype = "text/plain; version=0.0.4"
+            code = 200
+        elif self.path in ("/healthz", "/readyz"):
+            ok = type(self).ready or self.path == "/healthz"
+            body = (b"ok" if ok else b"not ready")
+            ctype = "text/plain"
+            code = 200 if ok else 503
+        else:
+            body, ctype, code = b"not found", "text/plain", 404
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def start_health_server(port: int) -> ThreadingHTTPServer:
+    server = ThreadingHTTPServer(("0.0.0.0", port), _HealthHandler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+def build_stack(settings: Settings) -> TPUMountService:
+    """Wire the production object graph (ref server.go:22-33 NewGPUMounter →
+    NewGPUAllocator → NewGPUCollector; composition instead of embedding)."""
+    enumerator = best_enumerator(settings.host)
+    podresources = KubeletPodResourcesClient(settings.host.kubelet_socket)
+    collector = TPUCollector(enumerator, podresources,
+                             resource_name=settings.resource_name,
+                             pool_namespace=settings.pool_namespace)
+    kube = InClusterKubeClient()
+    allocator = TPUAllocator(collector, kube, settings)
+    cgroups = CgroupDeviceController(settings.host,
+                                     driver=settings.cgroup_driver)
+    actuator = ProcRootActuator(settings.host)
+    mounter = TPUMounter(cgroups, actuator, enumerator, settings.host)
+    return TPUMountService(allocator, mounter, kube, settings)
+
+
+def main() -> None:
+    settings = Settings.from_env()
+    logger.info("worker starting: node=%s pool_ns=%s driver=%s",
+                settings.node_name, settings.pool_namespace,
+                settings.cgroup_driver)
+    health = start_health_server(
+        settings.worker_grpc_port + HEALTH_PORT_OFFSET)
+    # Fail fast like the reference (SURVEY.md §3.1: worker exits if NVML or
+    # the kubelet socket is unavailable) — the nodeSelector guarantees TPU
+    # nodes, so a broken stack here is a deploy error worth crashing on.
+    service = build_stack(settings)
+    server, port = build_server(service, settings.worker_grpc_port)
+    server.start()
+    _HealthHandler.ready = True
+    logger.info("worker serving gRPC on :%d, health on :%d", port,
+                settings.worker_grpc_port + HEALTH_PORT_OFFSET)
+    try:
+        server.wait_for_termination()
+    finally:
+        health.shutdown()
+
+
+if __name__ == "__main__":
+    main()

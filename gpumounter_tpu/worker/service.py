@@ -1,0 +1,199 @@
+"""Mount orchestration: the worker's AddTPU / RemoveTPU business logic.
+
+Ref ``pkg/server/gpu-mount/server.go`` (``GPUMountImpl.AddGPU`` :35-100,
+``.RemoveGPU`` :102-180), decoupled from the wire: this module returns typed
+outcomes; the gRPC adapter maps them onto the proto enums. Deliberate deltas:
+
+- Rollback on mount failure deletes slave pods *and* reverts any partially
+  actuated chips (the reference only deleted slave pods, server.go:87-92,
+  leaving half-written cgroup rules behind).
+- Detach enforces **whole-slave-pod granularity**: a slave pod's chips must be
+  removed together, because the scheduler accounts chips per pod — deleting a
+  slave pod while keeping some of its chips mounted would desync allocatable
+  accounting. The reference sidestepped this with its exact-uuid-list quirk
+  (allocator.go:122-124); we give a precise error instead.
+- Busy pre-check returns the holder PIDs to the caller (new field on the
+  response) so operators know *what* to kill before forcing.
+- Attach/detach latencies are recorded in the metrics registry (the <3s p50
+  north star, BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from gpumounter_tpu.actuation.mount import TPUMounter, can_mount
+from gpumounter_tpu.allocator import TPUAllocator
+from gpumounter_tpu.device.model import TPUChip
+from gpumounter_tpu.k8s import objects
+from gpumounter_tpu.k8s.client import KubeClient
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.config import Settings
+from gpumounter_tpu.utils.errors import (AllocationTimeoutError,
+                                         DeviceBusyError,
+                                         DeviceNotFoundError,
+                                         InsufficientTPUError,
+                                         MountPolicyError, PodNotFoundError,
+                                         TPUMounterError)
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("worker.service")
+
+
+@dataclasses.dataclass
+class AddOutcome:
+    result: consts.AddResult
+    chips: list[TPUChip] = dataclasses.field(default_factory=list)
+    message: str = ""
+
+
+@dataclasses.dataclass
+class RemoveOutcome:
+    result: consts.RemoveResult
+    busy_pids: list[int] = dataclasses.field(default_factory=list)
+    message: str = ""
+
+
+class TPUMountService:
+    """One per worker; owns the node-local orchestration."""
+
+    def __init__(self, allocator: TPUAllocator, mounter: TPUMounter,
+                 kube: KubeClient, settings: Settings | None = None):
+        self.allocator = allocator
+        self.mounter = mounter
+        self.kube = kube
+        self.settings = settings or Settings()
+
+    # -- AddTPU (ref server.go:35-100) -----------------------------------------
+
+    def add_tpu(self, pod_name: str, namespace: str, tpu_num: int,
+                is_entire_mount: bool) -> AddOutcome:
+        with REGISTRY.attach_latency.time():
+            outcome = self._add_tpu(pod_name, namespace, tpu_num,
+                                    is_entire_mount)
+        REGISTRY.attach_results.inc(result=outcome.result.name)
+        return outcome
+
+    def _add_tpu(self, pod_name: str, namespace: str, tpu_num: int,
+                 is_entire_mount: bool) -> AddOutcome:
+        if tpu_num <= 0:
+            raise MountPolicyError(f"tpu_num must be >= 1, got {tpu_num}")
+        try:
+            pod = self.kube.get_pod(namespace, pod_name)
+        except PodNotFoundError:
+            return AddOutcome(consts.AddResult.POD_NOT_FOUND,
+                              message=f"pod {namespace}/{pod_name} not found")
+        if not objects.is_running(pod):
+            # ref server.go:44-56: only Running pods are mountable
+            return AddOutcome(
+                consts.AddResult.POD_NOT_FOUND,
+                message=f"pod {namespace}/{pod_name} is "
+                        f"{objects.phase(pod) or 'unknown'}, not Running")
+
+        current = self.allocator.get_mount_type(pod_name)
+        if not can_mount(current, is_entire_mount):
+            raise MountPolicyError(
+                f"pod {namespace}/{pod_name} has mount type {current.value}; "
+                f"{'entire' if is_entire_mount else 'single'}-mount denied "
+                "(ref util.go:207-226)")
+
+        # entire ⇒ one slave pod holding all N chips (atomic, topology-aligned
+        # on GKE whole-host granularity); single ⇒ N one-chip slave pods
+        # (ref server.go:62-66).
+        per_pod = tpu_num if is_entire_mount else 1
+        try:
+            chips, slaves = self.allocator.get_available_tpus(
+                pod, tpu_num, per_pod)
+        except InsufficientTPUError as e:
+            return AddOutcome(consts.AddResult.INSUFFICIENT_TPU,
+                              message=str(e))
+        except AllocationTimeoutError as e:
+            return AddOutcome(consts.AddResult.INSUFFICIENT_TPU,
+                              message=f"allocation timed out: {e}")
+
+        all_after = self.allocator.collector.get_pod_tpu_resources(
+            pod_name, namespace)
+        try:
+            self.mounter.mount_chips(pod, chips, all_after)
+        except TPUMounterError as e:
+            # rollback (ref server.go:87-92) + revert partial actuation
+            logger.error("mount failed, rolling back %d slave pods: %s",
+                         len(slaves), e)
+            remaining = [c for c in all_after
+                         if c.uuid not in {x.uuid for x in chips}]
+            try:
+                self.mounter.unmount_chips(pod, chips, remaining, force=False)
+            except TPUMounterError as cleanup_err:
+                logger.warning("rollback unmount incomplete: %s", cleanup_err)
+            self.allocator.delete_slave_pods(slaves, wait=False)
+            raise
+        logger.info("AddTPU ok: %d chips -> %s/%s (%s)", len(chips),
+                    namespace, pod_name,
+                    "entire" if is_entire_mount else "single")
+        return AddOutcome(consts.AddResult.SUCCESS, chips=chips)
+
+    # -- RemoveTPU (ref server.go:102-180) -------------------------------------
+
+    def remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
+                   force: bool) -> RemoveOutcome:
+        with REGISTRY.detach_latency.time():
+            outcome = self._remove_tpu(pod_name, namespace, uuids, force)
+        REGISTRY.detach_results.inc(result=outcome.result.name)
+        return outcome
+
+    def _remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
+                    force: bool) -> RemoveOutcome:
+        try:
+            pod = self.kube.get_pod(namespace, pod_name)
+        except PodNotFoundError:
+            return RemoveOutcome(
+                consts.RemoveResult.POD_NOT_FOUND,
+                message=f"pod {namespace}/{pod_name} not found")
+
+        try:
+            chips, holders = self.allocator.get_removable_tpus(pod_name,
+                                                               uuids)
+        except DeviceNotFoundError as e:
+            return RemoveOutcome(consts.RemoveResult.TPU_NOT_FOUND,
+                                 message=str(e))
+        if not chips:
+            return RemoveOutcome(
+                consts.RemoveResult.TPU_NOT_FOUND,
+                message=f"no removable chips on {namespace}/{pod_name}")
+
+        all_chips = self.allocator.collector.get_pod_tpu_resources(
+            pod_name, namespace)
+
+        # Whole-slave-pod granularity: removing part of a slave pod's chips
+        # would desync scheduler accounting (see module docstring).
+        partial = self._partially_covered_holders(chips, holders, all_chips)
+        if partial:
+            return RemoveOutcome(
+                consts.RemoveResult.TPU_NOT_FOUND,
+                message="refusing partial removal from slave pod(s) "
+                        f"{partial}: include all of their chip ids or none")
+
+        remaining = [c for c in all_chips
+                     if c.uuid not in {x.uuid for x in chips}]
+        try:
+            self.mounter.unmount_chips(pod, chips, remaining, force=force)
+        except DeviceBusyError as e:
+            # ref server.go:148-153 GPUBusy; holder PIDs surfaced to caller
+            return RemoveOutcome(consts.RemoveResult.TPU_BUSY,
+                                 busy_pids=e.pids, message=str(e))
+        self.allocator.delete_slave_pods(holders)
+        logger.info("RemoveTPU ok: %d chips off %s/%s (force=%s)",
+                    len(chips), namespace, pod_name, force)
+        return RemoveOutcome(consts.RemoveResult.SUCCESS)
+
+    @staticmethod
+    def _partially_covered_holders(chips: list[TPUChip], holders: list[str],
+                                   all_chips: list[TPUChip]) -> list[str]:
+        """Holder slave pods whose chip set is not fully covered by the
+        requested removal (derived from the already-fetched chip listing —
+        no extra kubelet round-trips)."""
+        requested = {c.uuid for c in chips}
+        return [holder for holder in holders
+                if any(c.pod_name == holder and c.uuid not in requested
+                       for c in all_chips)]

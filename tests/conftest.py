@@ -33,8 +33,12 @@ def pytest_configure(config):
     if all(os.path.exists(p) for p in wanted):
         return
     import subprocess
-    subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                   capture_output=True)
+    proc = subprocess.run(["make", "-C", _NATIVE_DIR],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed (rc={proc.returncode}):\n"
+            f"{proc.stdout}\n{proc.stderr}")
 
 
 @pytest.fixture

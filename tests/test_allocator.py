@@ -1,0 +1,144 @@
+"""Allocator tests over the ClusterSim scripted scheduler.
+
+Covers the reference flows (allocator.go): allocation fan-out, unschedulable
+cleanup, removal resolution, slave pod deletion, mount-type resolution — plus
+the deliberate fixes (timeouts, subset removal, labelled mount type).
+"""
+
+import pytest
+
+from gpumounter_tpu.allocator import TPUAllocator
+from gpumounter_tpu.k8s import objects
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.config import Settings
+from gpumounter_tpu.utils.errors import (AllocationTimeoutError,
+                                         DeviceNotFoundError,
+                                         InsufficientTPUError)
+
+from tests.helpers import ClusterSim
+
+
+@pytest.fixture
+def sim():
+    return ClusterSim(n_chips=4)
+
+
+@pytest.fixture
+def allocator(sim):
+    return TPUAllocator(sim.collector, sim.kube, sim.settings)
+
+
+def test_single_mount_allocates_n_slave_pods(sim, allocator):
+    owner = sim.add_target_pod()
+    chips, slaves = allocator.get_available_tpus(owner, 2, 1)
+    assert len(chips) == 2
+    assert len(slaves) == 2
+    assert len(sim.slave_pods()) == 2
+    for pod in sim.slave_pods():
+        labels = objects.labels(pod)
+        assert labels[consts.OWNER_POD_LABEL_KEY] == "workload"
+        assert labels[consts.MOUNT_TYPE_LABEL_KEY] == \
+            consts.MountType.SINGLE.value
+        assert pod["spec"]["nodeSelector"]["kubernetes.io/hostname"] == \
+            "node-a"
+
+
+def test_entire_mount_is_one_slave_pod(sim, allocator):
+    owner = sim.add_target_pod()
+    chips, slaves = allocator.get_available_tpus(owner, 4, 4)
+    assert len(chips) == 4
+    assert len(slaves) == 1
+    pod = sim.slave_pods()[0]
+    limits = pod["spec"]["containers"][0]["resources"]["limits"]
+    assert limits[consts.TPU_RESOURCE_NAME] == "4"
+    assert objects.labels(pod)[consts.MOUNT_TYPE_LABEL_KEY] == \
+        consts.MountType.ENTIRE.value
+
+
+def test_insufficient_chips_cleans_up(sim, allocator):
+    owner = sim.add_target_pod()
+    with pytest.raises(InsufficientTPUError):
+        allocator.get_available_tpus(owner, 5, 1)
+    # every created slave pod must be deleted again
+    assert sim.slave_pods() == []
+    assert sim.podresources.assignments == {}
+
+
+def test_allocation_times_out_when_scheduler_never_acts(sim):
+    sim.kube.on_create.clear()        # scheduler goes dark
+    settings = Settings()
+    settings.allocation_timeout_s = 0.3
+    allocator = TPUAllocator(sim.collector, sim.kube, settings)
+    owner = sim.add_target_pod()
+    with pytest.raises(AllocationTimeoutError):
+        allocator.get_available_tpus(owner, 1, 1)
+    assert sim.slave_pods() == []
+
+
+def test_slow_scheduler_still_succeeds(sim):
+    sim.schedule_delay_s = 0.15
+    allocator = TPUAllocator(sim.collector, sim.kube, sim.settings)
+    owner = sim.add_target_pod()
+    chips, _ = allocator.get_available_tpus(owner, 2, 2)
+    assert len(chips) == 2
+
+
+def test_removable_resolution_subset_and_unknown(sim, allocator):
+    owner = sim.add_target_pod()
+    chips, slaves = allocator.get_available_tpus(owner, 2, 1)
+    uuids = [c.uuid for c in chips]
+
+    got, holders = allocator.get_removable_tpus("workload", [uuids[0]])
+    assert [c.uuid for c in got] == [uuids[0]]
+    assert len(holders) == 1
+
+    got, holders = allocator.get_removable_tpus("workload", [])
+    assert sorted(c.uuid for c in got) == sorted(uuids)
+    assert holders == sorted(slaves)
+
+    with pytest.raises(DeviceNotFoundError):
+        allocator.get_removable_tpus("workload", ["no-such-chip"])
+
+
+def test_chips_from_pod_own_spec_are_not_removable(sim, allocator):
+    # The target pod got chip "0" through its own spec (kubelet-assigned).
+    sim.podresources.assign("default", "workload", ["0"])
+    sim.add_target_pod()
+    with pytest.raises(DeviceNotFoundError):
+        allocator.get_removable_tpus("workload", ["0"])
+
+
+def test_delete_slave_pods_waits_for_termination(sim, allocator):
+    owner = sim.add_target_pod()
+    _, slaves = allocator.get_available_tpus(owner, 2, 1)
+    sim.kube.delete_latency_s = 0.1       # graceful termination
+    allocator.delete_slave_pods(slaves)
+    assert sim.slave_pods() == []
+
+
+def test_mount_type_from_labels(sim, allocator):
+    owner = sim.add_target_pod()
+    assert allocator.get_mount_type("workload") is consts.MountType.NONE
+    allocator.get_available_tpus(owner, 2, 2)
+    assert allocator.get_mount_type("workload") is consts.MountType.ENTIRE
+
+
+def test_mount_type_single(sim, allocator):
+    owner = sim.add_target_pod()
+    allocator.get_available_tpus(owner, 1, 1)
+    assert allocator.get_mount_type("workload") is consts.MountType.SINGLE
+
+
+def test_slave_pod_spec_conventions(sim, allocator):
+    owner = sim.add_target_pod()
+    spec = allocator.new_slave_pod(owner, 1, entire=False)
+    assert spec["metadata"]["name"].startswith(
+        "workload" + consts.SLAVE_POD_INFIX)
+    assert spec["metadata"]["namespace"] == sim.settings.pool_namespace
+    container = spec["spec"]["containers"][0]
+    assert container["image"] == consts.SLAVE_POD_IMAGE
+    assert spec["spec"]["tolerations"][0]["key"] == consts.TPU_RESOURCE_NAME
+    # distinct random suffixes
+    names = {allocator.new_slave_pod(owner, 1, False)["metadata"]["name"]
+             for _ in range(8)}
+    assert len(names) == 8

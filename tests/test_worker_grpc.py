@@ -1,0 +1,68 @@
+"""Wire-level worker tests: real gRPC server + client over localhost, the
+contract of ``api.proto`` / ``cmd/GPUMounter-worker/main.go:24-33``."""
+
+import grpc
+import pytest
+
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.worker.grpc_server import WorkerClient, build_server
+
+from tests.helpers import WorkerRig
+
+
+@pytest.fixture
+def live_worker(fake_host):
+    rig = WorkerRig(fake_host)
+    server, port = build_server(rig.service, port=0, address="127.0.0.1")
+    server.start()
+    client = WorkerClient(f"127.0.0.1:{port}", timeout_s=30)
+    yield rig, client
+    client.close()
+    server.stop(grace=0)
+
+
+def test_add_and_remove_over_wire(live_worker):
+    rig, client = live_worker
+    resp = client.add_tpu("workload", "default", 2, False)
+    assert resp.result == int(consts.AddResult.SUCCESS)
+    assert len(resp.device_ids) == 2
+    assert list(resp.device_paths) == ["/dev/accel0", "/dev/accel1"]
+
+    out = client.remove_tpu("workload", "default", list(resp.device_ids),
+                            False)
+    assert out.result == int(consts.RemoveResult.SUCCESS)
+
+
+def test_add_pod_not_found_over_wire(live_worker):
+    _, client = live_worker
+    resp = client.add_tpu("ghost", "default", 1, False)
+    assert resp.result == int(consts.AddResult.POD_NOT_FOUND)
+
+
+def test_busy_pids_cross_the_wire(live_worker):
+    rig, client = live_worker
+    resp = client.add_tpu("workload", "default", 1, False)
+    chip_path = resp.device_paths[0]
+    rig.sim.enumerator.busy_pids = {chip_path: [rig.pid]}
+    out = client.remove_tpu("workload", "default", list(resp.device_ids),
+                            False)
+    assert out.result == int(consts.RemoveResult.TPU_BUSY)
+    assert list(out.busy_pids) == [rig.pid]
+
+
+def test_policy_violation_is_failed_precondition(live_worker):
+    rig, client = live_worker
+    client.add_tpu("workload", "default", 4, True)
+    with pytest.raises(grpc.RpcError) as exc:
+        client.add_tpu("workload", "default", 1, False)
+    assert exc.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+
+
+def test_actuation_failure_is_internal(live_worker):
+    rig, client = live_worker
+    rig.actuator.fail_on_create = True
+    with pytest.raises(grpc.RpcError) as exc:
+        client.add_tpu("workload", "default", 1, False)
+    assert exc.value.code() == grpc.StatusCode.INTERNAL
+    # rollback happened server-side
+    assert rig.sim.slave_pods() == []

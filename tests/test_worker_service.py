@@ -1,0 +1,170 @@
+"""Worker orchestration tests: the AddTPU/RemoveTPU flows of
+``pkg/server/gpu-mount/server.go`` over the WorkerRig (real allocator, real
+cgroup v1 controller on a fixture tree, recording mknod layer)."""
+
+import os
+
+import pytest
+
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.errors import ActuationError, MountPolicyError
+
+from tests.helpers import WorkerRig
+
+
+@pytest.fixture
+def rig(fake_host):
+    return WorkerRig(fake_host)
+
+
+def test_add_single_mount_success(rig):
+    out = rig.service.add_tpu("workload", "default", 2, False)
+    assert out.result is consts.AddResult.SUCCESS
+    assert len(out.chips) == 2
+    # two one-chip slave pods
+    assert len(rig.sim.slave_pods()) == 2
+    # cgroup allow written + device nodes created through the live pid
+    assert os.path.exists(os.path.join(rig.cgroup_dir, "devices.allow"))
+    assert [c[1] for c in rig.actuator.created] == ["/dev/accel0",
+                                                    "/dev/accel1"]
+
+
+def test_add_entire_mount_one_slave_pod(rig):
+    out = rig.service.add_tpu("workload", "default", 4, True)
+    assert out.result is consts.AddResult.SUCCESS
+    assert len(out.chips) == 4
+    assert len(rig.sim.slave_pods()) == 1
+
+
+def test_add_pod_not_found(rig):
+    out = rig.service.add_tpu("ghost", "default", 1, False)
+    assert out.result is consts.AddResult.POD_NOT_FOUND
+
+
+def test_add_pod_not_running(rig):
+    rig.sim.kube.set_pod_status("default", "workload", phase="Pending")
+    out = rig.service.add_tpu("workload", "default", 1, False)
+    assert out.result is consts.AddResult.POD_NOT_FOUND
+    assert "Pending" in out.message
+
+
+def test_add_insufficient(rig):
+    out = rig.service.add_tpu("workload", "default", 99, False)
+    assert out.result is consts.AddResult.INSUFFICIENT_TPU
+    assert rig.sim.slave_pods() == []          # cleanup happened
+
+
+def test_add_policy_rejections(rig):
+    assert rig.service.add_tpu("workload", "default", 4, True).result is \
+        consts.AddResult.SUCCESS
+    # entire-mounted pod refuses anything further (ref util.go:207-226)
+    with pytest.raises(MountPolicyError):
+        rig.service.add_tpu("workload", "default", 1, False)
+    with pytest.raises(MountPolicyError):
+        rig.service.add_tpu("workload", "default", 1, True)
+
+
+def test_add_single_then_single_composes(rig):
+    assert rig.service.add_tpu("workload", "default", 1, False).result is \
+        consts.AddResult.SUCCESS
+    assert rig.service.add_tpu("workload", "default", 1, False).result is \
+        consts.AddResult.SUCCESS
+    assert len(rig.sim.slave_pods()) == 2
+    # but an entire-mount on top is denied
+    with pytest.raises(MountPolicyError):
+        rig.service.add_tpu("workload", "default", 2, True)
+
+
+def test_add_zero_chips_rejected(rig):
+    with pytest.raises(MountPolicyError):
+        rig.service.add_tpu("workload", "default", 0, False)
+
+
+def test_add_rollback_on_actuation_failure(rig):
+    rig.actuator.fail_on_create = True
+    with pytest.raises(ActuationError):
+        rig.service.add_tpu("workload", "default", 2, False)
+    # slave pods rolled back (ref server.go:87-92), chips free again
+    assert rig.sim.slave_pods() == []
+    assert rig.sim.podresources.assignments == {}
+    rig.actuator.fail_on_create = False
+    out = rig.service.add_tpu("workload", "default", 4, True)
+    assert out.result is consts.AddResult.SUCCESS
+
+
+def test_remove_full_roundtrip(rig):
+    added = rig.service.add_tpu("workload", "default", 2, False)
+    uuids = [c.uuid for c in added.chips]
+    out = rig.service.remove_tpu("workload", "default", uuids, False)
+    assert out.result is consts.RemoveResult.SUCCESS
+    assert rig.sim.slave_pods() == []
+    assert [r[1] for r in rig.actuator.removed] == ["/dev/accel0",
+                                                    "/dev/accel1"]
+    # devices.deny written for both chips
+    assert os.path.exists(os.path.join(rig.cgroup_dir, "devices.deny"))
+    # pod is mountable again
+    assert rig.service.add_tpu("workload", "default", 1, True).result is \
+        consts.AddResult.SUCCESS
+
+
+def test_remove_empty_uuids_removes_all(rig):
+    rig.service.add_tpu("workload", "default", 2, False)
+    out = rig.service.remove_tpu("workload", "default", [], False)
+    assert out.result is consts.RemoveResult.SUCCESS
+    assert rig.sim.slave_pods() == []
+
+
+def test_remove_pod_not_found(rig):
+    out = rig.service.remove_tpu("ghost", "default", [], False)
+    assert out.result is consts.RemoveResult.POD_NOT_FOUND
+
+
+def test_remove_nothing_mounted(rig):
+    out = rig.service.remove_tpu("workload", "default", [], False)
+    assert out.result is consts.RemoveResult.TPU_NOT_FOUND
+
+
+def test_remove_unknown_uuid(rig):
+    rig.service.add_tpu("workload", "default", 1, False)
+    out = rig.service.remove_tpu("workload", "default", ["bogus"], False)
+    assert out.result is consts.RemoveResult.TPU_NOT_FOUND
+
+
+def test_remove_busy_reports_pids(rig):
+    added = rig.service.add_tpu("workload", "default", 1, False)
+    chip = added.chips[0]
+    rig.sim.enumerator.busy_pids = {chip.device_path: [rig.pid]}
+    out = rig.service.remove_tpu("workload", "default", [chip.uuid], False)
+    assert out.result is consts.RemoveResult.TPU_BUSY
+    assert out.busy_pids == [rig.pid]
+    assert rig.sim.slave_pods() != []          # nothing deleted
+
+
+def test_remove_busy_force_kills(rig):
+    added = rig.service.add_tpu("workload", "default", 1, False)
+    chip = added.chips[0]
+    rig.sim.enumerator.busy_pids = {chip.device_path: [rig.pid]}
+    out = rig.service.remove_tpu("workload", "default", [chip.uuid], True)
+    assert out.result is consts.RemoveResult.SUCCESS
+    assert rig.actuator.killed == [(rig.pid, 9)]
+    assert rig.sim.slave_pods() == []
+
+
+def test_remove_partial_entire_mount_refused(rig):
+    added = rig.service.add_tpu("workload", "default", 4, True)
+    one = added.chips[0].uuid
+    out = rig.service.remove_tpu("workload", "default", [one], False)
+    assert out.result is consts.RemoveResult.TPU_NOT_FOUND
+    assert "partial" in out.message
+    # whole set works
+    out = rig.service.remove_tpu(
+        "workload", "default", [c.uuid for c in added.chips], False)
+    assert out.result is consts.RemoveResult.SUCCESS
+
+
+def test_metrics_recorded(rig):
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    before = REGISTRY.attach_latency.count
+    rig.service.add_tpu("workload", "default", 1, False)
+    assert REGISTRY.attach_latency.count == before + 1
+    assert REGISTRY.attach_results.value(result="SUCCESS") >= 1

@@ -1,0 +1,12 @@
+"""JAX-side validation harness.
+
+The reference's only acceptance check is a human running ``nvidia-smi -L``
+inside the pod (``docs/guide/QuickStart.md:42-97``). For TPU the analog must
+be programmatic and must prove the *ICI mesh* works, not just that device
+nodes exist: after an attach, a JAX process inside the pod should see the
+chips (``jax.device_count()``) and be able to run sharded computation over
+them (BASELINE configs 2-5). This package is that in-pod probe plus the
+sharded workloads it runs: a ring-attention sequence-parallel transformer
+train step — collectives over every mesh axis, so a broken chip/ICI link
+surfaces as a numerical or compile failure.
+"""

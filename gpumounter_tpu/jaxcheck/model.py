@@ -1,0 +1,158 @@
+"""Flagship validation model: a decoder-only transformer LM, pure JAX,
+sharded over a (data, seq, model) mesh.
+
+This is the workload the in-pod probe trains for one step after a hot-attach
+to prove the chips + ICI mesh are genuinely usable (BASELINE configs 3/5) —
+not a production LM. Design is TPU-first:
+
+- Tensor parallelism ("model" axis) follows the Megatron split — QKV/MLP
+  column-sharded, output projections row-sharded — expressed as
+  ``NamedSharding`` hints under ``jit`` so XLA places the collectives on ICI.
+- Sequence parallelism ("seq" axis) uses the ring-attention kernel
+  (:mod:`gpumounter_tpu.jaxcheck.ring_attention`) via ``shard_map`` — exact
+  causal attention with K/V blocks rotating over ``lax.ppermute``.
+- Static shapes, ``lax``-only control flow, bf16-friendly accumulation: one
+  compile, MXU-shaped einsums.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gpumounter_tpu.jaxcheck.ring_attention import (
+    full_attention, make_sharded_ring_attention)
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 8
+    n_layers: int = 2
+    d_ff: int = 512
+    dtype: Any = jnp.float32      # bfloat16 on real TPU
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def init_params(key: jax.Array, cfg: ModelConfig) -> Params:
+    keys = iter(jax.random.split(key, 4 + 6 * cfg.n_layers))
+
+    def dense(shape, scale=None):
+        scale = scale or 1.0 / math.sqrt(shape[0])
+        return (jax.random.normal(next(keys), shape, jnp.float32)
+                * scale).astype(cfg.dtype)
+
+    params: Params = {
+        "embed": dense((cfg.vocab, cfg.d_model), scale=0.02),
+        "lm_head": dense((cfg.d_model, cfg.vocab)),
+        "ln_f": {"g": jnp.ones((cfg.d_model,), cfg.dtype)},
+        "layers": [],
+    }
+    for _ in range(cfg.n_layers):
+        params["layers"].append({
+            "ln1": {"g": jnp.ones((cfg.d_model,), cfg.dtype)},
+            "wqkv": dense((cfg.d_model, 3, cfg.n_heads, cfg.head_dim)),
+            "wo": dense((cfg.n_heads, cfg.head_dim, cfg.d_model),
+                        scale=1.0 / math.sqrt(cfg.d_model)),
+            "ln2": {"g": jnp.ones((cfg.d_model,), cfg.dtype)},
+            "w1": dense((cfg.d_model, cfg.d_ff)),
+            "w2": dense((cfg.d_ff, cfg.d_model)),
+        })
+    return params
+
+
+def param_shardings(mesh: Mesh, cfg: ModelConfig) -> Params:
+    """Megatron-style partition specs as a pytree matching init_params."""
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    layer = {
+        "ln1": {"g": ns()},
+        "wqkv": ns(None, None, "model", None),   # column-parallel
+        "wo": ns("model", None, None),           # row-parallel
+        "ln2": {"g": ns()},
+        "w1": ns(None, "model"),                 # column-parallel
+        "w2": ns("model", None),                 # row-parallel
+    }
+    return {
+        "embed": ns(None, None),
+        "lm_head": ns(None, "model"),            # vocab-sharded logits
+        "ln_f": {"g": ns()},
+        "layers": [layer] * cfg.n_layers,
+    }
+
+
+def _rmsnorm(x, g):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * g
+
+
+def _positions(t: int, d: int, dtype) -> jax.Array:
+    """Fixed sinusoidal positions — parameter-free, static-shape."""
+    pos = jnp.arange(t)[:, None].astype(jnp.float32)
+    dim = jnp.arange(d // 2)[None, :].astype(jnp.float32)
+    angle = pos / jnp.power(10000.0, 2 * dim / d)
+    return jnp.concatenate(
+        [jnp.sin(angle), jnp.cos(angle)], axis=-1).astype(dtype)
+
+
+def forward(params: Params, tokens: jax.Array, cfg: ModelConfig,
+            attn_fn: Callable | None = None) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab]. ``attn_fn`` is
+    ``full_attention``-shaped; pass a sharded ring kernel for seq parallelism.
+    """
+    attn = attn_fn or full_attention
+    x = params["embed"][tokens] + _positions(
+        tokens.shape[1], cfg.d_model, cfg.dtype)[None]
+    for layer in params["layers"]:
+        h = _rmsnorm(x, layer["ln1"]["g"])
+        qkv = jnp.einsum("btd,dchk->cbthk", h, layer["wqkv"])
+        out = attn(qkv[0], qkv[1], qkv[2])
+        x = x + jnp.einsum("bthk,hkd->btd", out, layer["wo"])
+        h = _rmsnorm(x, layer["ln2"]["g"])
+        h = jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
+        x = x + h
+    x = _rmsnorm(x, params["ln_f"]["g"])
+    return x @ params["lm_head"]
+
+
+def make_mesh(devices=None, data: int | None = None, seq: int | None = None,
+              model: int | None = None) -> Mesh:
+    """A (data, seq, model) mesh over the given devices. Unspecified axes
+    default to 1 except ``seq``, which absorbs the remainder — sequence
+    parallelism is the long-context headline, and ring attention rides
+    neighbour ICI links."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    data = data or 1
+    model = model or 1
+    if seq is None:
+        seq, rem = divmod(n, data * model)
+        if rem:
+            raise ValueError(f"{n} devices not divisible by "
+                             f"data*model={data * model}")
+    import numpy as np
+    grid = np.array(devices).reshape(data, seq, model)
+    return Mesh(grid, ("data", "seq", "model"))
+
+
+def make_attention(mesh: Mesh | None, cfg: ModelConfig) -> Callable:
+    """Ring attention over the mesh's seq axis, or full attention when
+    unsharded (single chip / seq axis of 1)."""
+    if mesh is None or mesh.shape["seq"] == 1:
+        return full_attention
+    del cfg
+    return make_sharded_ring_attention(
+        mesh, "seq", spec=P("data", "seq", "model", None))

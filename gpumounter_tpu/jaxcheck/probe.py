@@ -1,0 +1,171 @@
+"""In-pod post-attach probe.
+
+The acceptance criteria for a TPU hot-attach are JAX-level, not device-node
+level (BASELINE configs 2-5): after AddTPU the workload pod must (1) see the
+chips — ``jax.device_count() == expected`` — and (2) be able to run sharded
+compute over the ICI mesh. This module is the programmatic replacement for
+the reference's "run ``nvidia-smi -L`` and eyeball it" verification
+(``docs/guide/QuickStart.md:42-97``).
+
+Hot-visibility: libtpu enumerates chips when the JAX backend initialises. A
+process that imported jax *before* the attach holds a stale device list;
+:func:`wait_for_devices` re-initialises the backend between polls
+(``jax.extend.backend.clear_backends``) so new chips become visible without
+re-exec — the SURVEY.md §7 "hard part 2" answer. Processes with live arrays
+on the old backend should checkpoint first (detach drain, config 4).
+
+CLI:  python -m gpumounter_tpu.jaxcheck.probe --expect 4 [--timeout 60]
+      exits 0 iff the device count is reached and the mesh validates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("jaxcheck.probe")
+
+
+def device_summary() -> dict[str, Any]:
+    devices = jax.devices()
+    return {
+        "backend": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "local_device_count": jax.local_device_count(),
+        "devices": [str(d) for d in devices],
+        "process_index": jax.process_index(),
+    }
+
+
+def reinitialize_backend() -> None:
+    """Drop all live backends so the next jax call re-enumerates devices.
+    Any arrays still referencing the old backend become invalid — callers
+    own that tradeoff (checkpoint before detach; attach-then-init is free).
+    """
+    import jax.extend.backend
+    jax.clear_caches()
+    jax.extend.backend.clear_backends()
+
+
+def wait_for_devices(expected: int, timeout_s: float = 60.0,
+                     poll_s: float = 2.0) -> dict[str, Any]:
+    """Poll until ``jax.device_count() >= expected``, re-initialising the
+    backend between polls so hot-attached chips appear. Returns the final
+    device summary; raises TimeoutError at the deadline."""
+    deadline = time.monotonic() + timeout_s
+    first = True
+    while True:
+        if not first:
+            reinitialize_backend()
+        first = False
+        summary = device_summary()
+        if summary["device_count"] >= expected:
+            return summary
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"expected {expected} devices, have "
+                f"{summary['device_count']} after {timeout_s}s: "
+                f"{summary['devices']}")
+        logger.info("waiting for devices: %d/%d", summary["device_count"],
+                    expected)
+        time.sleep(poll_s)
+
+
+def validate_collectives(n_devices: int | None = None) -> dict[str, Any]:
+    """Prove every device participates in collectives: an all-reduce and a
+    ring permute over an n-device mesh, checked for exact integer results.
+    (The pjit-allreduce acceptance check of BASELINE config 3.)"""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    mesh = Mesh(np.array(devices[:n]), ("x",))
+    data = jnp.arange(n, dtype=jnp.int32)
+    sharded = jax.device_put(data, NamedSharding(mesh, P("x")))
+
+    @jax.jit
+    def allreduce(v):
+        return jnp.sum(v) * jnp.ones_like(v)
+
+    total = int(allreduce(sharded)[0])
+    expected_total = n * (n - 1) // 2
+
+    @jax.shard_map(mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+                   check_vma=False)
+    def rotate(v):
+        return jax.lax.ppermute(v, "x",
+                                perm=[(i, (i + 1) % n) for i in range(n)])
+
+    rotated = np.asarray(rotate(sharded))
+    expected_rot = np.roll(np.arange(n), 1)
+    allreduce_ok = bool(total == expected_total)
+    ppermute_ok = bool((rotated == expected_rot).all())
+    return {"n_devices": n, "allreduce_ok": allreduce_ok,
+            "ppermute_ok": ppermute_ok, "ok": allreduce_ok and ppermute_ok}
+
+
+def validate_training(n_steps: int = 4) -> dict[str, Any]:
+    """Run the flagship sharded train step over all devices; loss must be
+    finite and decreasing — compute is real, not just enumerable."""
+    from gpumounter_tpu.jaxcheck import model as model_lib
+    from gpumounter_tpu.jaxcheck import train as train_lib
+
+    cfg = model_lib.ModelConfig()
+    n = jax.device_count()
+    mesh = model_lib.make_mesh() if n > 1 else None
+    state = train_lib.init_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = train_lib.make_train_step(cfg, mesh)
+    tokens = train_lib.make_batch(jax.random.PRNGKey(1), 8, 64)
+    t0 = time.monotonic()
+    first_loss = final_loss = float("nan")
+    for i in range(n_steps):
+        state, loss = step(state, tokens)
+        if i == 0:
+            first_loss = float(loss)
+    final_loss = float(loss)
+    elapsed = time.monotonic() - t0
+    ok = (np.isfinite(final_loss) and final_loss < first_loss)
+    return {"mesh": dict(mesh.shape) if mesh else None,
+            "first_loss": first_loss, "final_loss": final_loss,
+            "steps": n_steps, "elapsed_s": round(elapsed, 3), "ok": bool(ok)}
+
+
+def run_probe(expected: int | None = None,
+              timeout_s: float = 60.0) -> dict[str, Any]:
+    report: dict[str, Any] = {"ok": False}
+    if expected:
+        report["devices"] = wait_for_devices(expected, timeout_s)
+    else:
+        report["devices"] = device_summary()
+    report["collectives"] = validate_collectives()
+    report["training"] = validate_training()
+    report["ok"] = report["collectives"]["ok"] and report["training"]["ok"]
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--expect", type=int, default=None,
+                        help="wait until this many devices are visible")
+    parser.add_argument("--timeout", type=float, default=60.0)
+    args = parser.parse_args(argv)
+    try:
+        report = run_probe(args.expect, args.timeout)
+    except TimeoutError as e:
+        print(json.dumps({"ok": False, "error": str(e)}))
+        return 2
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""Ring attention: causal attention with the sequence axis sharded across a
+mesh axis, K/V blocks rotating over the ring via ``lax.ppermute``.
+
+TPU-first design notes (not in the reference — it has no tensor compute):
+
+- The rotation is a neighbour exchange, so on a TPU torus every hop rides a
+  single ICI link; bandwidth cost is O(S·D) per step regardless of ring size.
+- Online-softmax accumulation (the flash-attention recurrence) keeps memory
+  at one [B, T_local, T_local] score block per step and stays numerically
+  stable in bfloat16.
+- Everything is ``lax.fori_loop`` + static shapes: one XLA compilation, no
+  per-step retrace, MXU-friendly einsums.
+
+This is the sequence-parallel validation workload for post-attach ICI checks
+(SURVEY.md §5 "Long-context / sequence parallelism": the TPU analog of
+entire-mount is topology-aligned attach, and this kernel is how we prove the
+resulting mesh actually moves data on every link).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30  # large-negative instead of -inf: avoids NaNs in bf16 exp
+
+
+def _block_attend(q, k, q_offset, k_offset):
+    """One block-pair score computation with causal masking in *global*
+    coordinates. q: [B, Tq, H, D]; k: [B, Tk, H, D]. Returns the masked
+    score matrix [B, H, Tq, Tk] (softmax/accumulation happen in the ring
+    body, which owns the online-softmax state)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    q_pos = q_offset + jnp.arange(q.shape[1])
+    k_pos = k_offset + jnp.arange(k.shape[1])
+    mask = q_pos[:, None] >= k_pos[None, :]          # causal, global coords
+    s = jnp.where(mask[None, None, :, :], s, NEG_INF)
+    return s
+
+
+def ring_attention(q, k, v, axis_name: str):
+    """Causal multi-head attention with q/k/v sharded on sequence dim over
+    ``axis_name``. Shapes (per shard): [B, T_local, H, D] -> [B, T_local, H, D].
+
+    Must be called inside ``shard_map`` (or pmap) over ``axis_name``.
+    """
+    n = lax.psum(1, axis_name)
+    my_index = lax.axis_index(axis_name)
+    batch, t_local, heads, dim = q.shape
+    q_offset = my_index * t_local
+
+    acc0 = jnp.zeros((batch, t_local, heads, dim), jnp.float32)
+    m0 = jnp.full((batch, heads, t_local), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((batch, heads, t_local), jnp.float32)
+
+    def body(i, carry):
+        acc, m, l, k_blk, v_blk = carry
+        # Which global block do we hold after i rotations? Blocks move to the
+        # next-higher rank each step, so we now hold block (my - i) mod n.
+        src = (my_index - i) % n
+        s = _block_attend(q, k_blk, q_offset, src * t_local)
+        s = s.astype(jnp.float32)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # renormalise the running accumulator to the new max
+        scale = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])            # [B, H, Tq, Tk]
+        l_new = l * scale + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype),
+                        v_blk).astype(jnp.float32)
+        acc_new = acc * scale.transpose(0, 2, 1)[..., None] + pv
+        k_next, v_next = lax.ppermute(
+            (k_blk, v_blk), axis_name,
+            perm=[(j, (j + 1) % n) for j in range(n)])
+        return acc_new, m_new, l_new, k_next, v_next
+
+    acc, m, l, _, _ = lax.fori_loop(0, n, body, (acc0, m0, l0, k, v))
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def full_attention(q, k, v):
+    """Unsharded reference implementation (same math, no ring) for
+    correctness checks and the single-device path."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    t = q.shape[1]
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    s = jnp.where(mask[None, None], s.astype(jnp.float32), NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def make_sharded_ring_attention(mesh: Mesh, seq_axis: str = "seq",
+                                spec: P | None = None):
+    """shard_map-wrapped ring attention: takes globally-shaped [B, T, H, D]
+    arrays sharded on T over ``seq_axis`` and runs the ring kernel. ``spec``
+    may also shard batch/head dims (data/tensor parallelism compose with the
+    ring — those axes are embarrassingly parallel inside the kernel)."""
+    spec = spec if spec is not None else P(None, seq_axis, None, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    def sharded(q, k, v):
+        return ring_attention(q, k, v, seq_axis)
+
+    return sharded
+
+
+def sequence_sharding(mesh: Mesh, seq_axis: str = "seq") -> NamedSharding:
+    return NamedSharding(mesh, P(None, seq_axis, None, None))

@@ -1,0 +1,96 @@
+"""Sharded training step for the validation model.
+
+One jitted step: forward (ring attention over "seq"), next-token
+cross-entropy, grads, AdamW update — with every array's placement declared
+via ``NamedSharding`` so XLA lays the collectives on ICI (psum for
+row-parallel matmuls and the data axis, ppermute inside the ring). This is
+the step the driver's ``dryrun_multichip`` compiles over an N-device mesh
+and the in-pod probe runs after a hot-attach.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gpumounter_tpu.jaxcheck import model as model_lib
+from gpumounter_tpu.jaxcheck.model import ModelConfig, Params
+
+
+class TrainState(NamedTuple):
+    params: Params
+    opt_state: Any
+    step: jax.Array
+
+
+def cross_entropy(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Next-token CE in f32 (stable in bf16 models)."""
+    logits = logits[:, :-1].astype(jnp.float32)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return nll.mean()
+
+
+def make_optimizer(lr: float = 3e-4) -> optax.GradientTransformation:
+    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01)
+
+
+def init_state(key: jax.Array, cfg: ModelConfig, mesh: Mesh | None = None,
+               optimizer: optax.GradientTransformation | None = None
+               ) -> TrainState:
+    optimizer = optimizer or make_optimizer()
+    params = model_lib.init_params(key, cfg)
+    if mesh is not None:
+        shardings = model_lib.param_shardings(mesh, cfg)
+        params = jax.device_put(params, shardings)
+    opt_state = optimizer.init(params)
+    return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+
+def make_train_step(cfg: ModelConfig, mesh: Mesh | None = None,
+                    optimizer: optax.GradientTransformation | None = None
+                    ) -> Callable:
+    """Returns jitted ``step(state, tokens) -> (state, loss)``.
+
+    With a mesh: tokens come in sharded P("data", "seq"); parameters carry
+    Megatron specs; the attention runs the ring kernel. Without: plain jit,
+    full attention (the single-chip ``entry()`` path).
+    """
+    optimizer = optimizer or make_optimizer()
+    attn = model_lib.make_attention(mesh, cfg)
+
+    def loss_fn(params, tokens):
+        logits = model_lib.forward(params, tokens, cfg, attn_fn=attn)
+        return cross_entropy(logits, tokens)
+
+    def step(state: TrainState, tokens: jax.Array):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=0)
+
+    token_sharding = NamedSharding(mesh, P("data", "seq"))
+    return jax.jit(step, donate_argnums=0,
+                   in_shardings=(None, token_sharding))
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def make_batch(key: jax.Array, batch: int, seq: int,
+               vocab: int = 256) -> jax.Array:
+    """Synthetic next-token-predictable data: arithmetic sequences mod
+    ``vocab``, so a few steps of training measurably reduce loss (the
+    probe's signal that compute is real, not just that compile succeeded)."""
+    start = jax.random.randint(key, (batch, 1), 0, min(64, vocab))
+    stride = jax.random.randint(jax.random.fold_in(key, 1), (batch, 1), 1, 4)
+    seq_ids = (start + stride * jnp.arange(seq)[None, :]) % vocab
+    return seq_ids.astype(jnp.int32)

@@ -1,0 +1,6 @@
+"""Cluster-facing master: REST gateway + worker discovery."""
+
+from gpumounter_tpu.master.discovery import WorkerDirectory
+from gpumounter_tpu.master.gateway import MasterGateway
+
+__all__ = ["MasterGateway", "WorkerDirectory"]

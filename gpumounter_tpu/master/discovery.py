@@ -1,0 +1,78 @@
+"""Worker discovery: which per-node worker daemon serves a given node.
+
+Ref ``cmd/GPUMounter-master/main.go:248-268`` ``findAllWorker``: LIST pods in
+kube-system labelled ``app=gpu-mounter-worker`` and map ``spec.nodeName`` →
+pod. The reference issues that LIST **per request** with no caching
+(SURVEY.md §3.5 "No caching/informers"); we keep a TTL cache so steady-state
+mount requests cost zero apiserver round-trips, with a forced refresh on miss
+(covers freshly scheduled workers)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from gpumounter_tpu.k8s import objects
+from gpumounter_tpu.k8s.client import KubeClient
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.errors import TPUMounterError
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("master.discovery")
+
+
+class WorkerNotFoundError(TPUMounterError):
+    def __init__(self, node: str):
+        super().__init__(
+            f"no ready tpu-mounter worker on node {node!r} — is the "
+            "DaemonSet running and the node labelled for it?")
+        self.node = node
+
+
+class WorkerDirectory:
+    def __init__(self, kube: KubeClient,
+                 namespace: str = consts.WORKER_NAMESPACE,
+                 label_selector: str = consts.WORKER_LABEL_SELECTOR,
+                 grpc_port: int = consts.WORKER_GRPC_PORT,
+                 ttl_s: float = 15.0):
+        self.kube = kube
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self.grpc_port = grpc_port
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        self._by_node: dict[str, str] = {}     # node -> worker pod IP
+        self._fetched_at = 0.0
+
+    def _refresh(self) -> None:
+        pods = self.kube.list_pods(self.namespace, self.label_selector)
+        by_node: dict[str, str] = {}
+        for pod in pods:
+            ip = pod.get("status", {}).get("podIP", "")
+            if objects.is_running(pod) and ip and objects.node_name(pod):
+                by_node[objects.node_name(pod)] = ip
+        self._by_node = by_node
+        self._fetched_at = time.monotonic()
+        logger.debug("worker directory refreshed: %d nodes", len(by_node))
+
+    # Floor between miss-triggered refreshes so clients hammering a node
+    # whose worker is down can't turn every request into an apiserver LIST.
+    MISS_REFRESH_INTERVAL_S = 1.0
+
+    def worker_target(self, node: str) -> str:
+        """gRPC target ``ip:port`` of the worker on ``node``."""
+        with self._lock:
+            refreshed = False
+            if time.monotonic() - self._fetched_at > self.ttl_s:
+                self._refresh()
+                refreshed = True
+            if (node not in self._by_node and not refreshed
+                    and time.monotonic() - self._fetched_at
+                    > self.MISS_REFRESH_INTERVAL_S):
+                # Miss on a stale-ish cache: the worker may have just
+                # started; one forced refresh, rate-limited.
+                self._refresh()
+            ip = self._by_node.get(node)
+        if not ip:
+            raise WorkerNotFoundError(node)
+        return f"{ip}:{self.grpc_port}"

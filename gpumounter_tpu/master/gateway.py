@@ -1,0 +1,205 @@
+"""Master REST gateway.
+
+Ref ``cmd/GPUMounter-master/main.go``: HTTP server on :8080 (:235-238) with
+routes (:233-234)
+
+    GET  /addtpu/namespace/:ns/pod/:pod/tpu/:n/isEntireMount/:bool
+    POST /removetpu/namespace/:ns/pod/:pod/force/:bool   (form/JSON: uuids)
+
+mirroring ``/addgpu/...``/``/removegpu/...`` semantics: resolve the Pod's
+node via the apiserver (:52-66), find that node's worker (:248-268, here TTL
+cached), dial its gRPC (:82-96), translate result enums to HTTP (:103-116,
+:206-224). Responses are JSON (the reference returned bare strings).
+
+Status mapping: Success→200; PodNotFound/TPUNotFound→404;
+InsufficientTPU→503; TPUBusy→409 (busy_pids in the body); mount-policy
+violations (gRPC FAILED_PRECONDITION)→412; worker unreachable/internal→502.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import grpc
+
+from gpumounter_tpu.k8s import objects
+from gpumounter_tpu.k8s.client import KubeClient
+from gpumounter_tpu.master.discovery import (WorkerDirectory,
+                                             WorkerNotFoundError)
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.errors import K8sApiError, PodNotFoundError
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+from gpumounter_tpu.worker.grpc_server import WorkerClient
+
+logger = get_logger("master.gateway")
+
+_ADD_RE = re.compile(
+    r"^/addtpu/namespace/(?P<ns>[^/]+)/pod/(?P<pod>[^/]+)"
+    r"/tpu/(?P<num>\d+)/isEntireMount/(?P<entire>true|false)$")
+_REMOVE_RE = re.compile(
+    r"^/removetpu/namespace/(?P<ns>[^/]+)/pod/(?P<pod>[^/]+)"
+    r"/force/(?P<force>true|false)$")
+
+_ADD_HTTP = {
+    consts.AddResult.SUCCESS: 200,
+    consts.AddResult.INSUFFICIENT_TPU: 503,
+    consts.AddResult.POD_NOT_FOUND: 404,
+}
+_REMOVE_HTTP = {
+    consts.RemoveResult.SUCCESS: 200,
+    consts.RemoveResult.TPU_BUSY: 409,
+    consts.RemoveResult.POD_NOT_FOUND: 404,
+    consts.RemoveResult.TPU_NOT_FOUND: 404,
+}
+_GRPC_HTTP = {
+    grpc.StatusCode.FAILED_PRECONDITION: 412,
+    grpc.StatusCode.INTERNAL: 502,
+    grpc.StatusCode.UNAVAILABLE: 502,
+    grpc.StatusCode.DEADLINE_EXCEEDED: 504,
+}
+
+
+class MasterGateway:
+    """Route handling decoupled from the HTTP server so it is unit-testable;
+    ``serve()`` wraps it in a ThreadingHTTPServer."""
+
+    def __init__(self, kube: KubeClient, directory: WorkerDirectory,
+                 worker_client_factory=WorkerClient):
+        self.kube = kube
+        self.directory = directory
+        self._worker_client_factory = worker_client_factory
+
+    # -- request handling ------------------------------------------------------
+
+    def handle(self, method: str, path: str,
+               body: bytes = b"") -> tuple[int, dict]:
+        """Returns (http_status, json_payload)."""
+        try:
+            return self._route(method, path, body)
+        except PodNotFoundError as e:
+            return 404, {"result": "PodNotFound", "message": str(e)}
+        except WorkerNotFoundError as e:
+            return 502, {"result": "WorkerNotFound", "message": str(e)}
+        except K8sApiError as e:
+            return 502, {"result": "ApiserverError", "message": str(e)}
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            return (_GRPC_HTTP.get(code, 502),
+                    {"result": str(code and code.name),
+                     "message": e.details() if hasattr(e, "details")
+                     else str(e)})
+        except ValueError as e:
+            # e.g. a version-skewed worker returning a result enum value we
+            # don't know — answer with JSON instead of dropping the socket
+            return 502, {"result": "UnknownWorkerResult", "message": str(e)}
+
+    def _route(self, method: str, path: str, body: bytes) -> tuple[int, dict]:
+        parsed = urllib.parse.urlparse(path)
+        if parsed.path == "/healthz":
+            return 200, {"status": "ok"}
+        match = _ADD_RE.match(parsed.path)
+        if match and method == "GET":
+            return self._add(match["ns"], match["pod"], int(match["num"]),
+                             match["entire"] == "true")
+        match = _REMOVE_RE.match(parsed.path)
+        if match and method == "POST":
+            uuids = _parse_uuids(body, parsed.query)
+            return self._remove(match["ns"], match["pod"], uuids,
+                                match["force"] == "true")
+        return 404, {"result": "NoSuchRoute", "message": path}
+
+    def _dial(self, namespace: str, pod_name: str
+              ) -> tuple[objects.Pod, WorkerClient]:
+        pod = self.kube.get_pod(namespace, pod_name)   # ref main.go:52-66
+        node = objects.node_name(pod)
+        if not node:
+            raise PodNotFoundError(namespace, pod_name)
+        target = self.directory.worker_target(node)
+        return pod, self._worker_client_factory(target)
+
+    def _add(self, namespace: str, pod_name: str, tpu_num: int,
+             entire: bool) -> tuple[int, dict]:
+        _, worker = self._dial(namespace, pod_name)
+        with worker:
+            resp = worker.add_tpu(pod_name, namespace, tpu_num, entire)
+        result = consts.AddResult(resp.result)
+        REGISTRY.attach_results.inc(result=f"master_{result.name}")
+        return _ADD_HTTP[result], {
+            "result": result.name,
+            "device_ids": list(resp.device_ids),
+            "device_paths": list(resp.device_paths),
+        }
+
+    def _remove(self, namespace: str, pod_name: str, uuids: list[str],
+                force: bool) -> tuple[int, dict]:
+        _, worker = self._dial(namespace, pod_name)
+        with worker:
+            resp = worker.remove_tpu(pod_name, namespace, uuids, force)
+        result = consts.RemoveResult(resp.result)
+        REGISTRY.detach_results.inc(result=f"master_{result.name}")
+        payload: dict = {"result": result.name}
+        if resp.busy_pids:
+            payload["busy_pids"] = list(resp.busy_pids)
+        return _REMOVE_HTTP[result], payload
+
+    # -- HTTP server -----------------------------------------------------------
+
+    def serve(self, port: int = consts.MASTER_HTTP_PORT,
+              address: str = "0.0.0.0") -> ThreadingHTTPServer:
+        gateway = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _respond(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                if self.path == "/metrics":
+                    payload = REGISTRY.render_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                status, obj = gateway.handle(self.command, self.path, body)
+                payload = (json.dumps(obj) + "\n").encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST = _respond
+
+        server = ThreadingHTTPServer((address, port), Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        logger.info("master gateway serving on %s:%d", address,
+                    server.server_port)
+        return server
+
+
+def _parse_uuids(body: bytes, query: str) -> list[str]:
+    """uuids from JSON body {"uuids": [...]}, form field (repeated or
+    comma-separated — the reference took repeated form values,
+    main.go:121-128), or query string."""
+    text = body.decode(errors="replace").strip()
+    if text.startswith("{"):
+        try:
+            return [str(u) for u in json.loads(text).get("uuids", [])]
+        except json.JSONDecodeError:
+            return []
+    merged: list[str] = []
+    for source in (text, query):
+        if not source:
+            continue
+        for value in urllib.parse.parse_qs(source).get("uuids", []):
+            merged.extend(u for u in value.split(",") if u)
+    return merged

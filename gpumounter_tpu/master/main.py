@@ -1,0 +1,37 @@
+"""Master entrypoint (ref ``cmd/GPUMounter-master/main.go:227-241``).
+
+Run as: ``python -m gpumounter_tpu.master.main``.
+"""
+
+from __future__ import annotations
+
+import time
+
+from gpumounter_tpu.k8s.client import InClusterKubeClient
+from gpumounter_tpu.master.discovery import WorkerDirectory
+from gpumounter_tpu.master.gateway import MasterGateway
+from gpumounter_tpu.utils.config import Settings
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("master.main")
+
+
+def main() -> None:
+    settings = Settings.from_env()
+    kube = InClusterKubeClient()
+    directory = WorkerDirectory(kube,
+                                namespace=settings.worker_namespace,
+                                label_selector=settings.worker_label_selector,
+                                grpc_port=settings.worker_grpc_port)
+    gateway = MasterGateway(kube, directory)
+    server = gateway.serve(settings.master_http_port)
+    logger.info("master ready on :%d", settings.master_http_port)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

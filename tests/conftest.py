@@ -8,11 +8,22 @@ don't touch JAX at all.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# A sitecustomize may have force-registered a TPU plugin and pinned
+# jax_platforms ahead of the env var (this is how the dev image exposes its
+# tunnelled chip); pin it back so the suite runs on the virtual CPU mesh.
+# Only when jax is already imported — the pin is only needed then, and
+# control-plane-only test runs shouldn't pay the jax import.
+if "jax" in sys.modules:
+    try:
+        sys.modules["jax"].config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

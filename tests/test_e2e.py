@@ -1,0 +1,111 @@
+"""End-to-end harness (BASELINE config 1): a curl-equivalent HTTP request to
+the real master gateway, through real gRPC to the worker service, through the
+real allocator against a scripted scheduler, down to real cgroup-v1 file
+writes and device-node creation in a fixture container root.
+
+This exercises every layer of SURVEY.md §3.2/§3.3's call stacks except the
+kube-apiserver (FakeKubeClient) and real mknod privileges (fake device
+nodes); the fake-kubelet gRPC unix socket variant lives in
+tests/test_collector.py.
+"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.k8s.client import FakeKubeClient
+from gpumounter_tpu.master.discovery import WorkerDirectory
+from gpumounter_tpu.master.gateway import MasterGateway
+from gpumounter_tpu.worker.grpc_server import build_server
+
+from tests.helpers import WorkerRig, make_target_pod
+from tests.test_master import worker_pod
+
+
+@pytest.fixture
+def live_stack(fake_host):
+    """Everything live on localhost: HTTP master + gRPC worker."""
+    rig = WorkerRig(fake_host)
+    grpc_server, grpc_port = build_server(rig.service, port=0,
+                                          address="127.0.0.1")
+    grpc_server.start()
+
+    master_kube = FakeKubeClient()
+    master_kube.put_pod(worker_pod("node-a", "127.0.0.1"))
+    master_kube.put_pod(make_target_pod())
+    gateway = MasterGateway(
+        master_kube, WorkerDirectory(master_kube, grpc_port=grpc_port))
+    http_server = gateway.serve(port=0, address="127.0.0.1")
+    base = f"http://127.0.0.1:{http_server.server_port}"
+    yield rig, base
+    http_server.shutdown()
+    grpc_server.stop(grace=0)
+
+
+def _get(url):
+    try:
+        resp = urllib.request.urlopen(url)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post(url, data: bytes):
+    req = urllib.request.Request(url, data=data, method="POST")
+    try:
+        resp = urllib.request.urlopen(req)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_full_attach_detach_over_http(live_stack):
+    rig, base = live_stack
+
+    # attach 4 chips as an entire mount — the QuickStart flow
+    status, body = _get(
+        f"{base}/addtpu/namespace/default/pod/workload/tpu/4"
+        "/isEntireMount/true")
+    assert status == 200
+    assert body["result"] == "SUCCESS"
+    assert sorted(body["device_paths"]) == [
+        "/dev/accel0", "/dev/accel1", "/dev/accel2", "/dev/accel3"]
+
+    # observable side effects on the "node"
+    assert len(rig.sim.slave_pods()) == 1
+    assert os.path.exists(os.path.join(rig.cgroup_dir, "devices.allow"))
+    assert len(rig.actuator.created) == 4
+
+    # detach everything
+    status, body = _post(
+        f"{base}/removetpu/namespace/default/pod/workload/force/false",
+        json.dumps({"uuids": body["device_ids"]}).encode())
+    assert status == 200
+    assert body["result"] == "SUCCESS"
+    assert rig.sim.slave_pods() == []
+    assert rig.sim.podresources.assignments == {}
+    assert len(rig.actuator.removed) == 4
+
+    # node is reusable immediately
+    status, body = _get(
+        f"{base}/addtpu/namespace/default/pod/workload/tpu/1"
+        "/isEntireMount/false")
+    assert status == 200
+
+
+def test_metrics_exposed_over_http(live_stack):
+    rig, base = live_stack
+    _get(f"{base}/addtpu/namespace/default/pod/workload/tpu/1"
+         "/isEntireMount/false")
+    resp = urllib.request.urlopen(f"{base}/metrics")
+    text = resp.read().decode()
+    assert "tpumounter_attach_seconds_bucket" in text
+    assert "tpumounter_attach_total" in text
+
+
+def test_healthz(live_stack):
+    _, base = live_stack
+    status, body = _get(f"{base}/healthz")
+    assert status == 200 and body["status"] == "ok"

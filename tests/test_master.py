@@ -1,0 +1,179 @@
+"""Master gateway tests: route parsing, worker discovery caching, and
+HTTP-status translation of worker results (ref cmd/GPUMounter-master)."""
+
+import json
+
+import pytest
+
+from gpumounter_tpu.k8s.client import FakeKubeClient
+from gpumounter_tpu.master.discovery import (WorkerDirectory,
+                                             WorkerNotFoundError)
+from gpumounter_tpu.master.gateway import MasterGateway, _parse_uuids
+from gpumounter_tpu.worker.grpc_server import WorkerClient, build_server
+
+from tests.helpers import WorkerRig, make_target_pod
+
+
+def worker_pod(node, ip, name="w1"):
+    return {
+        "metadata": {"name": name, "namespace": "kube-system",
+                     "labels": {"app": "tpu-mounter-worker"}},
+        "spec": {"nodeName": node},
+        "status": {"phase": "Running", "podIP": ip},
+    }
+
+
+# -- discovery -----------------------------------------------------------------
+
+def test_directory_resolves_and_caches():
+    kube = FakeKubeClient()
+    kube.put_pod(worker_pod("node-a", "10.0.0.5"))
+    directory = WorkerDirectory(kube, ttl_s=60)
+    directory.MISS_REFRESH_INTERVAL_S = 0.0
+    assert directory.worker_target("node-a") == "10.0.0.5:1200"
+    # cache: a new worker appearing within TTL is still found via forced
+    # refresh-on-miss
+    kube.put_pod(worker_pod("node-b", "10.0.0.6", name="w2"))
+    assert directory.worker_target("node-b") == "10.0.0.6:1200"
+
+
+def test_directory_miss_refresh_is_rate_limited():
+    kube = FakeKubeClient()
+    kube.put_pod(worker_pod("node-a", "10.0.0.5"))
+    directory = WorkerDirectory(kube, ttl_s=60)
+    assert directory.worker_target("node-a") == "10.0.0.5:1200"
+    # a worker that appears right after a refresh is not visible until the
+    # miss-refresh floor passes — repeated misses must not LIST every call
+    kube.put_pod(worker_pod("node-b", "10.0.0.6", name="w2"))
+    with pytest.raises(WorkerNotFoundError):
+        directory.worker_target("node-b")
+
+
+def test_directory_unknown_node_raises():
+    directory = WorkerDirectory(FakeKubeClient())
+    with pytest.raises(WorkerNotFoundError):
+        directory.worker_target("nowhere")
+
+
+def test_directory_ignores_not_ready_workers():
+    kube = FakeKubeClient()
+    pod = worker_pod("node-a", "10.0.0.5")
+    pod["status"]["phase"] = "Pending"
+    kube.put_pod(pod)
+    directory = WorkerDirectory(kube)
+    with pytest.raises(WorkerNotFoundError):
+        directory.worker_target("node-a")
+
+
+# -- uuid parsing --------------------------------------------------------------
+
+def test_parse_uuids_variants():
+    assert _parse_uuids(b'{"uuids": ["a", "b"]}', "") == ["a", "b"]
+    assert _parse_uuids(b"uuids=a&uuids=b", "") == ["a", "b"]
+    assert _parse_uuids(b"uuids=a,b", "") == ["a", "b"]
+    assert _parse_uuids(b"", "uuids=a,b") == ["a", "b"]
+    assert _parse_uuids(b"", "") == []
+    assert _parse_uuids(b"{bad json", "") == []
+
+
+# -- gateway over a live worker ------------------------------------------------
+
+@pytest.fixture
+def stack(fake_host):
+    """WorkerRig + live gRPC worker + gateway whose directory points at it."""
+    rig = WorkerRig(fake_host)
+    server, port = build_server(rig.service, port=0, address="127.0.0.1")
+    server.start()
+
+    master_kube = FakeKubeClient()
+    master_kube.put_pod(worker_pod("node-a", "127.0.0.1"))
+    master_kube.put_pod(make_target_pod())      # master resolves pod→node
+    directory = WorkerDirectory(master_kube, grpc_port=port)
+    gateway = MasterGateway(master_kube, directory)
+    yield rig, gateway
+    server.stop(grace=0)
+
+
+def test_add_route_success(stack):
+    rig, gateway = stack
+    status, body = gateway.handle(
+        "GET",
+        "/addtpu/namespace/default/pod/workload/tpu/2/isEntireMount/false")
+    assert status == 200
+    assert body["result"] == "SUCCESS"
+    assert len(body["device_ids"]) == 2
+    assert len(rig.sim.slave_pods()) == 2
+
+
+def test_add_route_insufficient_is_503(stack):
+    _, gateway = stack
+    status, body = gateway.handle(
+        "GET",
+        "/addtpu/namespace/default/pod/workload/tpu/9/isEntireMount/false")
+    assert status == 503
+    assert body["result"] == "INSUFFICIENT_TPU"
+
+
+def test_add_route_missing_pod_is_404(stack):
+    _, gateway = stack
+    status, body = gateway.handle(
+        "GET", "/addtpu/namespace/default/pod/ghost/tpu/1/isEntireMount/true")
+    assert status == 404
+
+
+def test_policy_violation_is_412(stack):
+    _, gateway = stack
+    gateway.handle(
+        "GET",
+        "/addtpu/namespace/default/pod/workload/tpu/4/isEntireMount/true")
+    status, body = gateway.handle(
+        "GET",
+        "/addtpu/namespace/default/pod/workload/tpu/1/isEntireMount/false")
+    assert status == 412
+
+
+def test_remove_route_roundtrip(stack):
+    rig, gateway = stack
+    _, body = gateway.handle(
+        "GET",
+        "/addtpu/namespace/default/pod/workload/tpu/2/isEntireMount/false")
+    uuids = ",".join(body["device_ids"])
+    status, body = gateway.handle(
+        "POST", "/removetpu/namespace/default/pod/workload/force/false",
+        f"uuids={uuids}".encode())
+    assert status == 200
+    assert body["result"] == "SUCCESS"
+    assert rig.sim.slave_pods() == []
+
+
+def test_remove_busy_is_409_with_pids(stack):
+    rig, gateway = stack
+    _, body = gateway.handle(
+        "GET",
+        "/addtpu/namespace/default/pod/workload/tpu/1/isEntireMount/false")
+    path = body["device_paths"][0]
+    rig.sim.enumerator.busy_pids = {path: [rig.pid]}
+    status, body = gateway.handle(
+        "POST", "/removetpu/namespace/default/pod/workload/force/false",
+        json.dumps({"uuids": body["device_ids"]}).encode())
+    assert status == 409
+    assert body["busy_pids"] == [rig.pid]
+
+
+def test_no_worker_on_node_is_502(stack, fake_host):
+    rig, gateway = stack
+    gateway.directory._by_node.clear()
+    gateway.directory.kube = FakeKubeClient()       # directory sees no workers
+    status, body = gateway.handle(
+        "GET",
+        "/addtpu/namespace/default/pod/workload/tpu/1/isEntireMount/false")
+    assert status == 502
+    assert body["result"] == "WorkerNotFound"
+
+
+def test_unknown_route_404(stack):
+    _, gateway = stack
+    status, _ = gateway.handle("GET", "/nope")
+    assert status == 404
+    status, _ = gateway.handle("GET", "/healthz")
+    assert status == 200

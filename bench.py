@@ -2,8 +2,9 @@
 
 Drives the complete AddTPU/RemoveTPU path — HTTP master gateway → gRPC
 worker → allocator (slave pods through a scripted scheduler) → real cgroup-v1
-device-permission writes + device-node actuation on a fixture host tree — and
-reports the p50 attach latency for a 4-chip entire-mount.
+device-permission writes + device-node actuation on a fixture host tree, with
+the collector reading a real gRPC unix-socket kubelet — and reports the p50
+attach latency for a 4-chip entire-mount.
 
 Baseline: the north-star target is < 3 s p50 for a 4-chip host attach
 (BASELINE.json; the reference publishes no numbers — BASELINE.md). The
@@ -32,137 +33,42 @@ CYCLES = 25
 CHIPS = 4
 
 
-def build_stack(root: str):
-    from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
-    from gpumounter_tpu.actuation.mount import TPUMounter
-    from gpumounter_tpu.actuation.nsenter import ProcRootActuator
-    from gpumounter_tpu.allocator import TPUAllocator
-    from gpumounter_tpu.collector.collector import TPUCollector
-    from gpumounter_tpu.collector.fake_kubelet import FakeKubeletServer
-    from gpumounter_tpu.collector.podresources import (
-        FakePodResourcesClient, KubeletPodResourcesClient)
-    from gpumounter_tpu.device.enumerator import PyEnumerator
-    from gpumounter_tpu.k8s import objects
-    from gpumounter_tpu.k8s.client import FakeKubeClient
-    from gpumounter_tpu.master.discovery import WorkerDirectory
-    from gpumounter_tpu.master.gateway import MasterGateway
-    from gpumounter_tpu.utils.config import HostPaths, Settings
-    from gpumounter_tpu.worker.grpc_server import build_server
-    from gpumounter_tpu.worker.service import TPUMountService
+def main() -> None:
+    from gpumounter_tpu.testing.sim import LiveStack, WorkerRig
+    from gpumounter_tpu.utils.config import HostPaths
 
+    root = tempfile.mkdtemp(prefix="tpumounter-bench-")
     host = HostPaths(dev_root=f"{root}/dev", proc_root=f"{root}/proc",
                      sys_root=f"{root}/sys",
                      cgroup_root=f"{root}/sys/fs/cgroup",
                      kubelet_socket=f"{root}/pr/kubelet.sock")
     for d in (host.dev_root, host.proc_root, host.cgroup_root):
         os.makedirs(d)
-    for i in range(CHIPS):
-        open(f"{host.dev_root}/accel{i}", "w").close()
-        with open(f"{host.dev_root}/accel{i}.majmin", "w") as f:
-            f.write(f"120:{i}")
 
-    state = FakePodResourcesClient()
-    kubelet = FakeKubeletServer(host.kubelet_socket, state).start()
-    podres = KubeletPodResourcesClient(host.kubelet_socket)
-    enum = PyEnumerator(host, allow_fake=True)
-    collector = TPUCollector(enum, podres)
-
-    kube = FakeKubeClient()
-
-    def schedule(pod):
-        want = objects.resource_limit(pod, "google.com/tpu")
-        assigned = {i for c in state.assignments.values()
-                    for r in c.values() for ids in r.values() for i in ids}
-        free = [c.uuid for c in enum.enumerate() if c.uuid not in assigned]
-        if len(free) < want:
-            kube.set_pod_status(
-                objects.namespace(pod), objects.name(pod), phase="Pending",
-                conditions=[{"type": "PodScheduled", "status": "False",
-                             "reason": "Unschedulable"}])
-            return
-        state.assign(objects.namespace(pod), objects.name(pod), free[:want])
-        kube.set_pod_status(objects.namespace(pod), objects.name(pod),
-                            phase="Running")
-
-    kube.on_create.append(schedule)
-    kube.on_delete.append(
-        lambda pod: state.unassign(objects.namespace(pod),
-                                   objects.name(pod)))
-
-    settings = Settings()
-    settings.host = host
-    allocator = TPUAllocator(collector, kube, settings)
-    cg = CgroupDeviceController(host, driver="cgroupfs", version=1)
-    actuator = ProcRootActuator(host, fake_nodes=True)
-    mounter = TPUMounter(cg, actuator, enum, host)
-    service = TPUMountService(allocator, mounter, kube, settings)
-
-    cid = "containerd://" + "ab" * 32
-    pod = {"apiVersion": "v1", "kind": "Pod",
-           "metadata": {"name": "workload", "namespace": "default",
-                        "uid": "uid-w"},
-           "spec": {"nodeName": "node-a",
-                    "containers": [{"name": "main", "resources": {}}]},
-           "status": {"phase": "Running", "qosClass": "BestEffort",
-                      "containerStatuses": [{"name": "main",
-                                             "containerID": cid}]}}
-    kube.put_pod(pod)
-    cdir = cg.container_dir(pod, cid)
-    os.makedirs(cdir)
-    with open(f"{cdir}/cgroup.procs", "w") as f:
-        f.write("4242\n")
-    os.makedirs(f"{host.proc_root}/4242/root/dev")
-
-    grpc_server, grpc_port = build_server(service, port=0,
-                                          address="127.0.0.1")
-    grpc_server.start()
-
-    master_kube = FakeKubeClient()
-    master_kube.put_pod({"metadata": {"name": "w1", "namespace":
-                                      "kube-system",
-                                      "labels":
-                                      {"app": "tpu-mounter-worker"}},
-                         "spec": {"nodeName": "node-a"},
-                         "status": {"phase": "Running",
-                                    "podIP": "127.0.0.1"}})
-    master_kube.put_pod(pod)
-    gateway = MasterGateway(master_kube,
-                            WorkerDirectory(master_kube,
-                                            grpc_port=grpc_port))
-    http_server = gateway.serve(port=0, address="127.0.0.1")
-    base = f"http://127.0.0.1:{http_server.server_port}"
-    return base, (kubelet, grpc_server, http_server)
-
-
-def measure(base: str) -> list[float]:
-    attach = (f"{base}/addtpu/namespace/default/pod/workload/tpu/{CHIPS}"
-              "/isEntireMount/true")
-    detach = f"{base}/removetpu/namespace/default/pod/workload/force/false"
-    latencies = []
-    for _ in range(CYCLES):
-        t0 = time.monotonic()
-        with urllib.request.urlopen(attach) as resp:
-            body = json.loads(resp.read())
-        latencies.append(time.monotonic() - t0)
-        assert body["result"] == "SUCCESS", body
-        req = urllib.request.Request(
-            detach, data=json.dumps({"uuids": body["device_ids"]}).encode(),
-            method="POST")
-        with urllib.request.urlopen(req) as resp:
-            assert json.loads(resp.read())["result"] == "SUCCESS"
-    return latencies
-
-
-def main() -> None:
-    root = tempfile.mkdtemp(prefix="tpumounter-bench-")
-    base, servers = build_stack(root)
+    rig = WorkerRig(host, n_chips=CHIPS, actuator="procroot",
+                    use_kubelet_socket=True)
+    stack = LiveStack(rig)
+    attach = (f"{stack.base}/addtpu/namespace/default/pod/workload"
+              f"/tpu/{CHIPS}/isEntireMount/true")
+    detach = (f"{stack.base}/removetpu/namespace/default/pod/workload"
+              "/force/false")
     try:
-        latencies = measure(base)
+        latencies = []
+        for _ in range(CYCLES):
+            t0 = time.monotonic()
+            with urllib.request.urlopen(attach) as resp:
+                body = json.loads(resp.read())
+            latencies.append(time.monotonic() - t0)
+            assert body["result"] == "SUCCESS", body
+            req = urllib.request.Request(
+                detach,
+                data=json.dumps({"uuids": body["device_ids"]}).encode(),
+                method="POST")
+            with urllib.request.urlopen(req) as resp:
+                assert json.loads(resp.read())["result"] == "SUCCESS"
     finally:
-        kubelet, grpc_server, http_server = servers
-        http_server.shutdown()
-        grpc_server.stop(grace=0)
-        kubelet.stop()
+        stack.close()
+
     p50 = statistics.median(latencies)
     print(json.dumps({
         "metric": "hot_attach_p50_latency_4chip_entire_mount",

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Deploy helper (ref deploy.sh:8-40: deploy | redeploy | uninstall).
+set -euo pipefail
+
+MANIFESTS=(
+  deploy/namespace.yaml
+  deploy/service-account.yaml
+  deploy/rbac.yaml
+  deploy/tpu-mounter-workers.yaml
+  deploy/tpu-mounter-master.yaml
+  deploy/tpu-mounter-svc.yaml
+)
+
+deploy() {
+  for m in "${MANIFESTS[@]}"; do kubectl apply -f "$m"; done
+}
+
+uninstall() {
+  for ((i=${#MANIFESTS[@]}-1; i>=0; i--)); do
+    kubectl delete --ignore-not-found -f "${MANIFESTS[$i]}"
+  done
+  # namespace deletion is async; redeploy would otherwise apply into a
+  # Terminating namespace and fail
+  kubectl wait --for=delete namespace/tpu-pool --timeout=120s 2>/dev/null || true
+}
+
+case "${1:-}" in
+  deploy)    deploy ;;
+  redeploy)  uninstall; deploy ;;
+  uninstall) uninstall ;;
+  *) echo "usage: $0 deploy|redeploy|uninstall" >&2; exit 1 ;;
+esac

@@ -124,7 +124,10 @@ def validate_training(n_steps: int = 4) -> dict[str, Any]:
     mesh = model_lib.make_mesh() if n > 1 else None
     state = train_lib.init_state(jax.random.PRNGKey(0), cfg, mesh)
     step = train_lib.make_train_step(cfg, mesh)
-    tokens = train_lib.make_batch(jax.random.PRNGKey(1), 8, 64)
+    # sequence length must divide over the mesh's seq axis (ring attention
+    # shards T); 3 chips -> T=48, 8 -> T=64, single device -> 64
+    t_len = 16 * mesh.shape["seq"] if mesh else 64
+    tokens = train_lib.make_batch(jax.random.PRNGKey(1), 8, t_len, cfg.vocab)
     t0 = time.monotonic()
     first_loss = final_loss = float("nan")
     for i in range(n_steps):
@@ -146,8 +149,17 @@ def run_probe(expected: int | None = None,
         report["devices"] = wait_for_devices(expected, timeout_s)
     else:
         report["devices"] = device_summary()
-    report["collectives"] = validate_collectives()
-    report["training"] = validate_training()
+    # A compile/execution failure on a broken chip or ICI link is exactly
+    # what this probe exists to detect — it must become {"ok": false},
+    # never a traceback (the CLI contract is one JSON line, exit 0/1/2).
+    try:
+        report["collectives"] = validate_collectives()
+    except Exception as e:
+        report["collectives"] = {"ok": False, "error": repr(e)}
+    try:
+        report["training"] = validate_training()
+    except Exception as e:
+        report["training"] = {"ok": False, "error": repr(e)}
     report["ok"] = report["collectives"]["ok"] and report["training"]["ok"]
     return report
 

@@ -40,19 +40,29 @@ class WorkerDirectory:
         self.label_selector = label_selector
         self.grpc_port = grpc_port
         self.ttl_s = ttl_s
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()           # guards the cache map
+        self._refresh_lock = threading.Lock()   # serialises apiserver LISTs
         self._by_node: dict[str, str] = {}     # node -> worker pod IP
         self._fetched_at = 0.0
 
     def _refresh(self) -> None:
-        pods = self.kube.list_pods(self.namespace, self.label_selector)
-        by_node: dict[str, str] = {}
-        for pod in pods:
-            ip = pod.get("status", {}).get("podIP", "")
-            if objects.is_running(pod) and ip and objects.node_name(pod):
-                by_node[objects.node_name(pod)] = ip
-        self._by_node = by_node
-        self._fetched_at = time.monotonic()
+        """LIST outside the cache lock (a hung apiserver must not block
+        cache hits in other gateway threads), swap the map under it. A
+        second lock serialises LISTs; a thread that waited for another's
+        refresh reuses that result instead of re-LISTing (stampede guard)."""
+        before = self._fetched_at
+        with self._refresh_lock:
+            if self._fetched_at > before:
+                return      # someone else just refreshed
+            pods = self.kube.list_pods(self.namespace, self.label_selector)
+            by_node: dict[str, str] = {}
+            for pod in pods:
+                ip = pod.get("status", {}).get("podIP", "")
+                if objects.is_running(pod) and ip and objects.node_name(pod):
+                    by_node[objects.node_name(pod)] = ip
+            with self._lock:
+                self._by_node = by_node
+                self._fetched_at = time.monotonic()
         logger.debug("worker directory refreshed: %d nodes", len(by_node))
 
     # Floor between miss-triggered refreshes so clients hammering a node
@@ -62,17 +72,29 @@ class WorkerDirectory:
     def worker_target(self, node: str) -> str:
         """gRPC target ``ip:port`` of the worker on ``node``."""
         with self._lock:
-            refreshed = False
-            if time.monotonic() - self._fetched_at > self.ttl_s:
-                self._refresh()
-                refreshed = True
-            if (node not in self._by_node and not refreshed
-                    and time.monotonic() - self._fetched_at
-                    > self.MISS_REFRESH_INTERVAL_S):
-                # Miss on a stale-ish cache: the worker may have just
-                # started; one forced refresh, rate-limited.
-                self._refresh()
+            stale = time.monotonic() - self._fetched_at > self.ttl_s
             ip = self._by_node.get(node)
+        if stale or (ip is None and self._miss_refresh_allowed()):
+            self._refresh()
+            with self._lock:
+                ip = self._by_node.get(node)
         if not ip:
             raise WorkerNotFoundError(node)
         return f"{ip}:{self.grpc_port}"
+
+    def _miss_refresh_allowed(self) -> bool:
+        with self._lock:
+            return (time.monotonic() - self._fetched_at
+                    > self.MISS_REFRESH_INTERVAL_S)
+
+    def invalidate(self, node: str) -> None:
+        """Drop a cached entry the caller found to be dead (e.g. gRPC
+        UNAVAILABLE after a worker pod restart) so the next request
+        re-resolves instead of 502ing until the TTL expires."""
+        with self._lock:
+            if self._by_node.pop(node, None) is not None:
+                # age the cache so the next lookup's miss-refresh engages
+                self._fetched_at = min(
+                    self._fetched_at,
+                    time.monotonic() - self.MISS_REFRESH_INTERVAL_S - 1e-3)
+        logger.info("invalidated worker cache for node %s", node)

@@ -73,6 +73,28 @@ class MasterGateway:
         self.kube = kube
         self.directory = directory
         self._worker_client_factory = worker_client_factory
+        # Per-target client cache: gRPC channels are long-lived by design;
+        # re-dialing per request would put TCP+HTTP/2 setup on the
+        # latency-benchmarked hot path.
+        self._clients: dict[str, WorkerClient] = {}
+        self._clients_lock = threading.Lock()
+
+    def _client(self, target: str) -> WorkerClient:
+        with self._clients_lock:
+            client = self._clients.get(target)
+            if client is None:
+                client = self._worker_client_factory(target)
+                self._clients[target] = client
+            return client
+
+    def _drop_client(self, target: str) -> None:
+        with self._clients_lock:
+            client = self._clients.pop(target, None)
+        if client is not None:
+            try:
+                client.close()
+            except Exception:
+                pass
 
     # -- request handling ------------------------------------------------------
 
@@ -113,20 +135,31 @@ class MasterGateway:
                                 match["force"] == "true")
         return 404, {"result": "NoSuchRoute", "message": path}
 
-    def _dial(self, namespace: str, pod_name: str
-              ) -> tuple[objects.Pod, WorkerClient]:
+    def _call_worker(self, namespace: str, pod_name: str, fn):
+        """Resolve pod -> node -> worker and run ``fn(client)``. On
+        UNAVAILABLE the cached worker IP is presumed dead (pod restarted):
+        invalidate both caches and retry once against a fresh resolve."""
         pod = self.kube.get_pod(namespace, pod_name)   # ref main.go:52-66
         node = objects.node_name(pod)
         if not node:
             raise PodNotFoundError(namespace, pod_name)
         target = self.directory.worker_target(node)
-        return pod, self._worker_client_factory(target)
+        try:
+            return fn(self._client(target))
+        except grpc.RpcError as e:
+            if (not hasattr(e, "code")
+                    or e.code() != grpc.StatusCode.UNAVAILABLE):
+                raise
+            self._drop_client(target)
+            self.directory.invalidate(node)
+            fresh = self.directory.worker_target(node)
+            return fn(self._client(fresh))
 
     def _add(self, namespace: str, pod_name: str, tpu_num: int,
              entire: bool) -> tuple[int, dict]:
-        _, worker = self._dial(namespace, pod_name)
-        with worker:
-            resp = worker.add_tpu(pod_name, namespace, tpu_num, entire)
+        resp = self._call_worker(
+            namespace, pod_name,
+            lambda w: w.add_tpu(pod_name, namespace, tpu_num, entire))
         result = consts.AddResult(resp.result)
         REGISTRY.attach_results.inc(result=f"master_{result.name}")
         return _ADD_HTTP[result], {
@@ -137,9 +170,9 @@ class MasterGateway:
 
     def _remove(self, namespace: str, pod_name: str, uuids: list[str],
                 force: bool) -> tuple[int, dict]:
-        _, worker = self._dial(namespace, pod_name)
-        with worker:
-            resp = worker.remove_tpu(pod_name, namespace, uuids, force)
+        resp = self._call_worker(
+            namespace, pod_name,
+            lambda w: w.remove_tpu(pod_name, namespace, uuids, force))
         result = consts.RemoveResult(resp.result)
         REGISTRY.detach_results.inc(result=f"master_{result.name}")
         payload: dict = {"result": result.name}
@@ -193,9 +226,16 @@ def _parse_uuids(body: bytes, query: str) -> list[str]:
     text = body.decode(errors="replace").strip()
     if text.startswith("{"):
         try:
-            return [str(u) for u in json.loads(text).get("uuids", [])]
+            raw = json.loads(text).get("uuids", [])
         except json.JSONDecodeError:
             return []
+        if raw is None:
+            return []
+        if isinstance(raw, str):          # "0,1" — not char-by-char
+            return [u for u in raw.split(",") if u]
+        if isinstance(raw, list):
+            return [str(u) for u in raw]
+        return []
     merged: list[str] = []
     for source in (text, query):
         if not source:

@@ -1,5 +1,8 @@
-"""Re-export of the shared simulation rigs (gpumounter_tpu.testing.sim) —
-kept so test modules import from one local name."""
+"""Simulation rigs for tests, benchmarks, and local drives.
+
+Shipped inside the package (not under ``tests/``) because the bench harness
+and the verify drive use the same wiring; one implementation, no drift.
+"""
 
 from gpumounter_tpu.testing.sim import (ClusterSim, LiveStack, WorkerRig,
                                         make_target_pod, worker_pod)

@@ -1,0 +1,234 @@
+"""A simulated single-node cluster wired through the real control plane.
+
+``ClusterSim`` glues the fakes into a behaving system: a FakeKubeClient whose
+on_create hook plays the scheduler + TPU device plugin (slave pods requesting
+``google.com/tpu`` go Running and get free chips assigned in the fake
+PodResources table; insufficient chips ⇒ Unschedulable condition), and whose
+on_delete hook releases the assignment — exactly the control loop the real
+cluster runs for the allocator's slave-pod trick (SURVEY.md §0).
+
+``WorkerRig`` adds the worker stack on a fixture host tree; ``LiveStack``
+puts a real gRPC worker + real HTTP master in front of it (the BASELINE
+config 1 topology, all sockets live).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from gpumounter_tpu.collector.collector import TPUCollector
+from gpumounter_tpu.collector.podresources import FakePodResourcesClient
+from gpumounter_tpu.device.fake import FakeEnumerator, make_chips
+from gpumounter_tpu.k8s import objects
+from gpumounter_tpu.k8s.client import FakeKubeClient
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.config import Settings
+
+
+def make_target_pod(name="workload", namespace="default", node="node-a",
+                    container_id="containerd://" + "ab" * 32, uid="uid-w"):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": namespace, "uid": uid,
+                     "labels": {}},
+        "spec": {"nodeName": node, "containers": [
+            {"name": "main", "resources": {}}]},
+        "status": {
+            "phase": "Running",
+            "qosClass": "BestEffort",
+            "containerStatuses": [
+                {"name": "main", "containerID": container_id}],
+        },
+    }
+
+
+def worker_pod(node, ip, name="w1"):
+    """A Running tpu-mounter-worker pod as the master's discovery sees it."""
+    return {
+        "metadata": {"name": name, "namespace": consts.WORKER_NAMESPACE,
+                     "labels": {"app": "tpu-mounter-worker"}},
+        "spec": {"nodeName": node},
+        "status": {"phase": "Running", "podIP": ip},
+    }
+
+
+class ClusterSim:
+    """One fake node with ``n_chips`` TPU chips and a scripted scheduler.
+
+    ``kubelet_socket_path``: when set, the collector talks to a REAL gRPC
+    unix-socket server (FakeKubeletServer) through the production
+    KubeletPodResourcesClient instead of the in-memory fake — wire format
+    and all. Call :meth:`close` to stop it.
+    """
+
+    def __init__(self, n_chips=4, node="node-a", schedule_delay_s=0.0,
+                 settings: Settings | None = None,
+                 kubelet_socket_path: str | None = None):
+        self.node = node
+        self.settings = settings or Settings()
+        self.enumerator = FakeEnumerator(make_chips(n_chips))
+        self.podresources = FakePodResourcesClient()
+        self.kube = FakeKubeClient()
+        self.schedule_delay_s = schedule_delay_s
+        self._lock = threading.Lock()
+        self.kube.on_create.append(self._schedule)
+        self.kube.on_delete.append(self._release)
+
+        self._kubelet_server = None
+        collector_client = self.podresources
+        if kubelet_socket_path:
+            from gpumounter_tpu.collector.fake_kubelet import \
+                FakeKubeletServer
+            from gpumounter_tpu.collector.podresources import \
+                KubeletPodResourcesClient
+            self._kubelet_server = FakeKubeletServer(
+                kubelet_socket_path, self.podresources).start()
+            collector_client = KubeletPodResourcesClient(kubelet_socket_path)
+        self.collector = TPUCollector(
+            self.enumerator, collector_client,
+            resource_name=self.settings.resource_name,
+            pool_namespace=self.settings.pool_namespace)
+
+    def close(self) -> None:
+        if self._kubelet_server is not None:
+            self._kubelet_server.stop()
+            self._kubelet_server = None
+
+    # -- scripted control plane ------------------------------------------------
+
+    def _free_uuids(self) -> list[str]:
+        assigned = {
+            device_id
+            for containers in self.podresources.assignments.values()
+            for resources in containers.values()
+            for ids in resources.values()
+            for device_id in ids}
+        return [c.uuid for c in self.enumerator.chips
+                if c.uuid not in assigned]
+
+    def _schedule(self, pod: objects.Pod) -> None:
+        if self.schedule_delay_s:
+            time.sleep(self.schedule_delay_s)
+        want = objects.resource_limit(pod, self.settings.resource_name)
+        if want <= 0:
+            self.kube.set_pod_status(objects.namespace(pod),
+                                     objects.name(pod), phase="Running")
+            return
+        with self._lock:
+            free = self._free_uuids()
+            if len(free) < want:
+                self.kube.set_pod_status(
+                    objects.namespace(pod), objects.name(pod),
+                    phase="Pending",
+                    conditions=[{"type": "PodScheduled", "status": "False",
+                                 "reason": "Unschedulable"}])
+                return
+            self.podresources.assign(objects.namespace(pod),
+                                     objects.name(pod), free[:want])
+        self.kube.set_pod_status(
+            objects.namespace(pod), objects.name(pod), phase="Running",
+            conditions=[{"type": "PodScheduled", "status": "True"}])
+
+    def _release(self, pod: objects.Pod) -> None:
+        self.podresources.unassign(objects.namespace(pod), objects.name(pod))
+
+    # -- conveniences ----------------------------------------------------------
+
+    def add_target_pod(self, **kwargs) -> objects.Pod:
+        pod = make_target_pod(node=self.node, **kwargs)
+        self.kube.put_pod(pod)
+        return pod
+
+    def slave_pods(self) -> list[objects.Pod]:
+        return self.kube.list_pods(
+            self.settings.pool_namespace,
+            label_selector=(f"{consts.SLAVE_POD_LABEL_KEY}="
+                            f"{consts.SLAVE_POD_LABEL_VALUE}"))
+
+
+class WorkerRig:
+    """A full worker stack over a ClusterSim and a tmp host fixture tree:
+    real allocator + real mount façade + real cgroup(v1) controller.
+
+    ``actuator``: "recording" (default — assertable test double) or
+    "procroot" (real ProcRootActuator with fake device nodes under
+    ``<proc_root>/<pid>/root/dev`` — the bench/verify configuration).
+    """
+
+    def __init__(self, fake_host, n_chips=4, pid=4242, actuator="recording",
+                 use_kubelet_socket=False):
+        from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
+        from gpumounter_tpu.actuation.mount import TPUMounter
+        from gpumounter_tpu.actuation.nsenter import (ProcRootActuator,
+                                                      RecordingActuator)
+        from gpumounter_tpu.allocator import TPUAllocator
+        from gpumounter_tpu.worker.service import TPUMountService
+
+        self.sim = ClusterSim(
+            n_chips=n_chips,
+            kubelet_socket_path=(fake_host.kubelet_socket
+                                 if use_kubelet_socket else None))
+        self.sim.settings.host = fake_host
+        self.host = fake_host
+        self.pod = self.sim.add_target_pod()
+        self.pid = pid
+
+        # container cgroup with one live PID
+        self.cgroups = CgroupDeviceController(fake_host, driver="cgroupfs",
+                                              version=1)
+        cid = objects.container_ids(self.pod)[0]
+        self.cgroup_dir = self.cgroups.container_dir(self.pod, cid)
+        os.makedirs(self.cgroup_dir, exist_ok=True)
+        with open(os.path.join(self.cgroup_dir, "cgroup.procs"), "w") as f:
+            f.write(f"{pid}\n")
+        os.makedirs(os.path.join(fake_host.proc_root, str(pid)),
+                    exist_ok=True)
+
+        if actuator == "recording":
+            self.actuator = RecordingActuator()
+        elif actuator == "procroot":
+            self.actuator = ProcRootActuator(fake_host, fake_nodes=True)
+            os.makedirs(os.path.join(fake_host.proc_root, str(pid), "root",
+                                     "dev"), exist_ok=True)
+        else:
+            raise ValueError(f"unknown actuator kind {actuator!r}")
+        self.mounter = TPUMounter(self.cgroups, self.actuator,
+                                  self.sim.enumerator, fake_host)
+        self.allocator = TPUAllocator(self.sim.collector, self.sim.kube,
+                                      self.sim.settings)
+        self.service = TPUMountService(self.allocator, self.mounter,
+                                       self.sim.kube, self.sim.settings)
+
+    def close(self) -> None:
+        self.sim.close()
+
+
+class LiveStack:
+    """Real gRPC worker + real HTTP master over a WorkerRig, on localhost.
+    ``base`` is the master's URL; close() tears everything down."""
+
+    def __init__(self, rig: WorkerRig):
+        from gpumounter_tpu.master.discovery import WorkerDirectory
+        from gpumounter_tpu.master.gateway import MasterGateway
+        from gpumounter_tpu.worker.grpc_server import build_server
+
+        self.rig = rig
+        self.grpc_server, grpc_port = build_server(rig.service, port=0,
+                                                   address="127.0.0.1")
+        self.grpc_server.start()
+        self.master_kube = FakeKubeClient()
+        self.master_kube.put_pod(worker_pod(rig.sim.node, "127.0.0.1"))
+        self.master_kube.put_pod(rig.pod)
+        self.gateway = MasterGateway(
+            self.master_kube,
+            WorkerDirectory(self.master_kube, grpc_port=grpc_port))
+        self.http_server = self.gateway.serve(port=0, address="127.0.0.1")
+        self.base = f"http://127.0.0.1:{self.http_server.server_port}"
+
+    def close(self) -> None:
+        self.http_server.shutdown()
+        self.grpc_server.stop(grace=0)
+        self.rig.close()

@@ -1,0 +1,92 @@
+"""Deploy manifest sanity: parseable YAML, internally consistent names/
+labels/ports, and consistent with the code's constants. The reference shipped
+GPU_POOL_NAMESPACE=default while creating a gpu-pool namespace
+(deploy/gpu-mounter-workers.yaml:33-34 vs namespace.yaml:4 — SURVEY.md §8);
+this suite keeps that class of skew impossible here."""
+
+import os
+import stat
+import subprocess
+
+import yaml
+
+from gpumounter_tpu.utils import consts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load(name):
+    with open(os.path.join(REPO, "deploy", name)) as f:
+        return list(yaml.safe_load_all(f))
+
+
+def test_all_manifests_parse():
+    for name in os.listdir(os.path.join(REPO, "deploy")):
+        docs = load(name)
+        assert docs and all(d for d in docs), name
+
+
+def test_pool_namespace_consistent_with_code():
+    (ns,) = load("namespace.yaml")
+    assert ns["metadata"]["name"] == consts.DEFAULT_POOL_NAMESPACE
+    (worker,) = load("tpu-mounter-workers.yaml")
+    env = {e["name"]: e.get("value")
+           for e in worker["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env[consts.ENV_POOL_NAMESPACE] == consts.DEFAULT_POOL_NAMESPACE
+    (master,) = load("tpu-mounter-master.yaml")
+    menv = {e["name"]: e.get("value")
+            for e in master["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert menv[consts.ENV_POOL_NAMESPACE] == consts.DEFAULT_POOL_NAMESPACE
+
+
+def test_worker_labels_match_discovery_selector():
+    (worker,) = load("tpu-mounter-workers.yaml")
+    labels = worker["spec"]["template"]["metadata"]["labels"]
+    key, _, value = consts.WORKER_LABEL_SELECTOR.partition("=")
+    assert labels.get(key) == value
+    assert worker["metadata"]["namespace"] == consts.WORKER_NAMESPACE
+
+
+def test_worker_privileges_and_mounts():
+    (worker,) = load("tpu-mounter-workers.yaml")
+    spec = worker["spec"]["template"]["spec"]
+    assert spec["hostPID"] is True
+    container = spec["containers"][0]
+    assert container["securityContext"]["privileged"] is True
+    mounts = {m["mountPath"] for m in container["volumeMounts"]}
+    # every host surface the actuation layer touches must be mounted
+    assert {"/sys/fs/cgroup", "/dev", "/proc",
+            "/var/lib/kubelet/pod-resources"} <= mounts
+    ports = {p["containerPort"] for p in container["ports"]}
+    assert consts.WORKER_GRPC_PORT in ports
+
+
+def test_service_targets_master_port():
+    (svc,) = load("tpu-mounter-svc.yaml")
+    assert svc["spec"]["ports"][0]["targetPort"] == consts.MASTER_HTTP_PORT
+    (master,) = load("tpu-mounter-master.yaml")
+    mlabels = master["spec"]["template"]["metadata"]["labels"]
+    for k, v in svc["spec"]["selector"].items():
+        assert mlabels.get(k) == v
+
+
+def test_rbac_is_not_cluster_admin():
+    docs = load("rbac.yaml")
+    for doc in docs:
+        if doc["kind"] == "ClusterRoleBinding":
+            assert doc["roleRef"]["name"] != "cluster-admin"
+    # slave-pod writes only in the pool namespace
+    roles = [d for d in docs if d["kind"] == "Role"]
+    assert roles and all(
+        r["metadata"]["namespace"] == consts.DEFAULT_POOL_NAMESPACE
+        for r in roles)
+
+
+def test_deploy_sh_is_executable_and_covers_manifests():
+    path = os.path.join(REPO, "deploy.sh")
+    assert os.stat(path).st_mode & stat.S_IXUSR
+    content = open(path).read()
+    for name in os.listdir(os.path.join(REPO, "deploy")):
+        assert f"deploy/{name}" in content, f"{name} missing from deploy.sh"
+    rc = subprocess.run(["bash", "-n", path])
+    assert rc.returncode == 0

@@ -15,33 +15,16 @@ import urllib.request
 
 import pytest
 
-from gpumounter_tpu.k8s.client import FakeKubeClient
-from gpumounter_tpu.master.discovery import WorkerDirectory
-from gpumounter_tpu.master.gateway import MasterGateway
-from gpumounter_tpu.worker.grpc_server import build_server
-
-from tests.helpers import WorkerRig, make_target_pod
-from tests.test_master import worker_pod
+from tests.helpers import LiveStack, WorkerRig
 
 
 @pytest.fixture
 def live_stack(fake_host):
-    """Everything live on localhost: HTTP master + gRPC worker."""
-    rig = WorkerRig(fake_host)
-    grpc_server, grpc_port = build_server(rig.service, port=0,
-                                          address="127.0.0.1")
-    grpc_server.start()
-
-    master_kube = FakeKubeClient()
-    master_kube.put_pod(worker_pod("node-a", "127.0.0.1"))
-    master_kube.put_pod(make_target_pod())
-    gateway = MasterGateway(
-        master_kube, WorkerDirectory(master_kube, grpc_port=grpc_port))
-    http_server = gateway.serve(port=0, address="127.0.0.1")
-    base = f"http://127.0.0.1:{http_server.server_port}"
-    yield rig, base
-    http_server.shutdown()
-    grpc_server.stop(grace=0)
+    """Everything live on localhost: HTTP master + gRPC worker, with the
+    collector reading a real unix-socket kubelet."""
+    stack = LiveStack(WorkerRig(fake_host, use_kubelet_socket=True))
+    yield stack.rig, stack.base
+    stack.close()
 
 
 def _get(url):

@@ -11,16 +11,7 @@ from gpumounter_tpu.master.discovery import (WorkerDirectory,
 from gpumounter_tpu.master.gateway import MasterGateway, _parse_uuids
 from gpumounter_tpu.worker.grpc_server import WorkerClient, build_server
 
-from tests.helpers import WorkerRig, make_target_pod
-
-
-def worker_pod(node, ip, name="w1"):
-    return {
-        "metadata": {"name": name, "namespace": "kube-system",
-                     "labels": {"app": "tpu-mounter-worker"}},
-        "spec": {"nodeName": node},
-        "status": {"phase": "Running", "podIP": ip},
-    }
+from tests.helpers import WorkerRig, make_target_pod, worker_pod
 
 
 # -- discovery -----------------------------------------------------------------
@@ -74,6 +65,23 @@ def test_parse_uuids_variants():
     assert _parse_uuids(b"", "uuids=a,b") == ["a", "b"]
     assert _parse_uuids(b"", "") == []
     assert _parse_uuids(b"{bad json", "") == []
+    # JSON edge cases: string not iterated char-by-char, null/objects safe
+    assert _parse_uuids(b'{"uuids": "a,b"}', "") == ["a", "b"]
+    assert _parse_uuids(b'{"uuids": null}', "") == []
+    assert _parse_uuids(b'{"uuids": 7}', "") == []
+    assert _parse_uuids(b'{}', "") == []
+
+
+def test_directory_invalidate_forces_reresolve():
+    kube = FakeKubeClient()
+    kube.put_pod(worker_pod("node-a", "10.0.0.5"))
+    directory = WorkerDirectory(kube, ttl_s=3600)
+    assert directory.worker_target("node-a") == "10.0.0.5:1200"
+    # worker pod restarted with a new IP; TTL is far away
+    kube.delete_pod("kube-system", "w1")
+    kube.put_pod(worker_pod("node-a", "10.0.0.9"))
+    directory.invalidate("node-a")
+    assert directory.worker_target("node-a") == "10.0.0.9:1200"
 
 
 # -- gateway over a live worker ------------------------------------------------

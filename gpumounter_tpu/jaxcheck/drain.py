@@ -1,0 +1,86 @@
+"""Graceful device drain for hot-detach (BASELINE config 4).
+
+Detaching chips out from under a live JAX process invalidates every array on
+them. The safe sequence — which this module packages — is:
+
+    1. ``drain(state, path)``   — all device arrays → host, checkpoint to disk
+    2. control-plane RemoveTPU  — chips leave the pod (no force needed: after
+       step 1 nothing holds the device open once the backend is dropped)
+    3. ``probe.reinitialize_backend()`` / new process
+    4. (optional) AddTPU again  — same or different chip count
+    5. ``restore(path, mesh)``  — checkpoint → new device set, resharded
+
+Restore reshards onto whatever mesh the *new* device set supports — detach 4
+chips and reattach 2 and the state comes back sharded over 2. Checkpoints are
+a host-side pickle of the numpy-ified pytree: structure-preserving for any
+(TrainState, optax, dict) tree without pulling a checkpoint framework into
+the probe's dependency set; swap in orbax for production-size models.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Any
+
+import jax
+import numpy as np
+
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("jaxcheck.drain")
+
+
+def drain(tree: Any, path: str) -> Any:
+    """Device pytree → host numpy pytree, persisted at ``path`` (written
+    atomically — a crash mid-detach must not eat the only copy). Returns the
+    host tree."""
+    host_tree = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".draining")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(host_tree, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    leaves = jax.tree.leaves(host_tree)
+    logger.info("drained %d arrays (%.1f MB) to %s", len(leaves),
+                sum(a.nbytes for a in leaves if hasattr(a, "nbytes")) / 1e6,
+                path)
+    return host_tree
+
+
+def restore(path: str, shardings: Any = None) -> Any:
+    """Checkpoint → device pytree on the CURRENT backend. ``shardings`` is an
+    optional matching pytree of ``NamedSharding``s (e.g.
+    ``model.param_shardings`` over the post-reattach mesh); without it,
+    arrays land on the default device."""
+    with open(path, "rb") as f:
+        host_tree = pickle.load(f)
+    if shardings is None:
+        return jax.tree.map(jax.device_put, host_tree)
+    return jax.device_put(host_tree, shardings)
+
+
+def drain_restore_cycle(tree: Any, shardings: Any = None,
+                        path: str | None = None) -> Any:
+    """drain → backend re-init → restore, in one call: what a sidecar runs
+    around a detach+reattach when the JAX process must survive it."""
+    from gpumounter_tpu.jaxcheck.probe import reinitialize_backend
+
+    own_tmp = path is None
+    if own_tmp:
+        fd, path = tempfile.mkstemp(suffix=".ckpt")
+        os.close(fd)
+    try:
+        drain(tree, path)
+        reinitialize_backend()
+        return restore(path, shardings)
+    finally:
+        if own_tmp and os.path.exists(path):
+            os.unlink(path)

@@ -1,0 +1,130 @@
+"""Pallas TPU kernel for the ring-attention block step.
+
+Each ring step computes flash-attention statistics of the local Q shard
+against one rotating K/V block. This kernel fuses that whole step — QKᵀ,
+causal mask (in global coordinates), block softmax, and PV — into one
+MXU-shaped pallas_call, so the scores matrix never round-trips through HBM:
+
+    out per (batch·head, q-tile) program:
+        pv  = exp(s - m_blk) @ V        [TILE_Q, D]
+        m   = rowmax(s)                 [TILE_Q]
+        l   = rowsum(exp(s - m_blk))    [TILE_Q]
+
+The ring body then merges (m, l, pv) into its running online-softmax state
+(:func:`gpumounter_tpu.jaxcheck.ring_attention.merge_block`) — the classic
+flash-attention recurrence, with the K/V rotation over ICI happening outside
+the kernel via ``lax.ppermute``.
+
+Layout: [BH, T, D] with D padded to the 128-lane MXU width by the caller.
+``interpret=True`` runs the same kernel on CPU for tests (no TPU needed).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+TILE_Q = 128       # q rows per program — MXU-height-aligned
+
+
+def _block_kernel(off_ref, q_ref, k_ref, v_ref, pv_ref, m_ref, l_ref,
+                  *, scale: float):
+    """One (bh, q-tile) program. q_ref [1, TILE_Q, D]; k_ref/v_ref
+    [1, TK, D]; off_ref [2] int32 SMEM: global offsets of the q shard and
+    the k block."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+
+    # scores on the MXU, f32 accumulation
+    s = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale          # [TILE_Q, TK]
+
+    # causal mask in global coordinates (2D iota — TPU requires >= 2D)
+    tile_q, tk = s.shape
+    q_pos = off_ref[0] + pl.program_id(1) * TILE_Q + \
+        jax.lax.broadcasted_iota(jnp.int32, (tile_q, tk), 0)
+    k_pos = off_ref[1] + \
+        jax.lax.broadcasted_iota(jnp.int32, (tile_q, tk), 1)
+    s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+    m = jnp.max(s, axis=1)                                   # [TILE_Q]
+    p = jnp.exp(s - m[:, None])
+    l = jnp.sum(p, axis=1)
+    pv = jax.lax.dot_general(
+        p, v_ref[0].astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                  # [TILE_Q, D]
+
+    pv_ref[0] = pv
+    m_ref[0, 0, :] = m
+    l_ref[0, 0, :] = l
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("interpret", "logical_d"))
+def flash_block(q, k, v, q_offset, k_offset, interpret: bool = False,
+                logical_d: int | None = None):
+    """Flash statistics of q against one K/V block, causally masked in
+    global coordinates.
+
+    q: [BH, TQ, D]; k, v: [BH, TK, D]; offsets are scalars (traced OK).
+    Returns (pv [BH, TQ, D] f32, m [BH, TQ] f32, l [BH, TQ] f32).
+    TQ must be a multiple of TILE_Q (the sequence shard per ring device).
+    When zero-padding D to the 128-lane MXU width, pass the ORIGINAL head
+    dim as ``logical_d`` — the softmax temperature is 1/sqrt(logical_d),
+    and padding must not change it.
+    """
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    assert tq % TILE_Q == 0, f"TQ={tq} not a multiple of {TILE_Q}"
+    scale = 1.0 / ((logical_d or d) ** 0.5)
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(k_offset, jnp.int32)])
+
+    grid = (bh, tq // TILE_Q)
+    return pl.pallas_call(
+        functools.partial(_block_kernel, scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, TILE_Q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, TILE_Q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, TILE_Q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, TILE_Q), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(offsets, q, k, v)
+
+
+def flash_block_bthd(q, k, v, q_offset, k_offset,
+                     interpret: bool = False,
+                     logical_d: int | None = None):
+    """[B, T, H, D]-layout wrapper matching the ring body's tensors.
+    Returns (pv [B, TQ, H, D], m [B, H, TQ], l [B, H, TQ]) in f32."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+
+    def to_bhd(x, t):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    pv, m, l = flash_block(to_bhd(q, tq), to_bhd(k, tk), to_bhd(v, tk),
+                           q_offset, k_offset, interpret=interpret,
+                           logical_d=logical_d)
+    pv = pv.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    return pv, m.reshape(b, h, tq), l.reshape(b, h, tq)

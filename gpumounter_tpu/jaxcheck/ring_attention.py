@@ -44,16 +44,60 @@ def _block_attend(q, k, q_offset, k_offset):
     return s
 
 
-def ring_attention(q, k, v, axis_name: str):
+def merge_block(acc, m, l, pv_blk, m_blk, l_blk):
+    """Online-softmax merge of one block's flash statistics into the running
+    state — the flash-attention recurrence. acc/pv_blk: [B, T, H, D] f32;
+    m/l/m_blk/l_blk: [B, H, T] f32. A fully-masked block arrives with
+    m_blk == NEG_INF, so its contribution is scaled by exp(NEG_INF - m) = 0
+    and annihilates regardless of its (garbage) pv/l values."""
+    m_new = jnp.maximum(m, m_blk)
+    scale_old = jnp.exp(m - m_new)
+    scale_blk = jnp.exp(m_blk - m_new)
+    l_new = l * scale_old + l_blk * scale_blk
+    acc_new = (acc * scale_old.transpose(0, 2, 1)[..., None]
+               + pv_blk * scale_blk.transpose(0, 2, 1)[..., None])
+    return acc_new, m_new, l_new
+
+
+def _einsum_block(q, k_blk, v_blk, q_offset, k_offset):
+    """XLA-fused block statistics (the portable path; XLA fuses mask+softmax
+    into the matmuls on TPU too). Returns (pv, m_blk, l_blk) like the pallas
+    kernel."""
+    s = _block_attend(q, k_blk, q_offset, k_offset).astype(jnp.float32)
+    m_blk = s.max(axis=-1)                           # [B, H, Tq]
+    p = jnp.exp(s - m_blk[..., None])
+    l_blk = p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype),
+                    v_blk).astype(jnp.float32)
+    return pv, m_blk, l_blk
+
+
+def ring_attention(q, k, v, axis_name: str, block_impl: str = "einsum",
+                   interpret: bool = False):
     """Causal multi-head attention with q/k/v sharded on sequence dim over
     ``axis_name``. Shapes (per shard): [B, T_local, H, D] -> [B, T_local, H, D].
 
     Must be called inside ``shard_map`` (or pmap) over ``axis_name``.
+    ``block_impl``: "einsum" (XLA-fused) or "pallas" (the fused MXU kernel in
+    :mod:`gpumounter_tpu.jaxcheck.pallas_attention`; requires T_local to be a
+    multiple of its TILE_Q; ``interpret=True`` runs it on CPU).
     """
     n = lax.psum(1, axis_name)
     my_index = lax.axis_index(axis_name)
     batch, t_local, heads, dim = q.shape
     q_offset = my_index * t_local
+
+    if block_impl == "pallas":
+        from gpumounter_tpu.jaxcheck.pallas_attention import flash_block_bthd
+
+        def block_fn(k_blk, v_blk, k_offset):
+            return flash_block_bthd(q, k_blk, v_blk, q_offset, k_offset,
+                                    interpret=interpret)
+    elif block_impl == "einsum":
+        def block_fn(k_blk, v_blk, k_offset):
+            return _einsum_block(q, k_blk, v_blk, q_offset, k_offset)
+    else:
+        raise ValueError(f"unknown block_impl {block_impl!r}")
 
     acc0 = jnp.zeros((batch, t_local, heads, dim), jnp.float32)
     m0 = jnp.full((batch, heads, t_local), NEG_INF, jnp.float32)
@@ -64,20 +108,12 @@ def ring_attention(q, k, v, axis_name: str):
         # Which global block do we hold after i rotations? Blocks move to the
         # next-higher rank each step, so we now hold block (my - i) mod n.
         src = (my_index - i) % n
-        s = _block_attend(q, k_blk, q_offset, src * t_local)
-        s = s.astype(jnp.float32)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        # renormalise the running accumulator to the new max
-        scale = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[..., None])            # [B, H, Tq, Tk]
-        l_new = l * scale + p.sum(axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype),
-                        v_blk).astype(jnp.float32)
-        acc_new = acc * scale.transpose(0, 2, 1)[..., None] + pv
+        pv_blk, m_blk, l_blk = block_fn(k_blk, v_blk, src * t_local)
+        acc, m, l = merge_block(acc, m, l, pv_blk, m_blk, l_blk)
         k_next, v_next = lax.ppermute(
             (k_blk, v_blk), axis_name,
             perm=[(j, (j + 1) % n) for j in range(n)])
-        return acc_new, m_new, l_new, k_next, v_next
+        return acc, m, l, k_next, v_next
 
     acc, m, l, _, _ = lax.fori_loop(0, n, body, (acc0, m0, l0, k, v))
     out = acc / l.transpose(0, 2, 1)[..., None]
@@ -98,18 +134,22 @@ def full_attention(q, k, v):
 
 
 def make_sharded_ring_attention(mesh: Mesh, seq_axis: str = "seq",
-                                spec: P | None = None):
+                                spec: P | None = None,
+                                block_impl: str = "einsum",
+                                interpret: bool = False):
     """shard_map-wrapped ring attention: takes globally-shaped [B, T, H, D]
     arrays sharded on T over ``seq_axis`` and runs the ring kernel. ``spec``
     may also shard batch/head dims (data/tensor parallelism compose with the
-    ring — those axes are embarrassingly parallel inside the kernel)."""
+    ring — those axes are embarrassingly parallel inside the kernel).
+    ``block_impl="pallas"`` uses the fused MXU block kernel."""
     spec = spec if spec is not None else P(None, seq_axis, None, None)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     def sharded(q, k, v):
-        return ring_attention(q, k, v, seq_axis)
+        return ring_attention(q, k, v, seq_axis, block_impl=block_impl,
+                              interpret=interpret)
 
     return sharded
 

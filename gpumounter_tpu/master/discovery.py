@@ -20,6 +20,10 @@ from gpumounter_tpu.utils.log import get_logger
 
 logger = get_logger("master.discovery")
 
+# Workers normally all serve on WORKER_GRPC_PORT; a pod can override its
+# advertised port with this annotation (hostNetwork setups, local testing).
+PORT_ANNOTATION = "tpumounter.io/grpc-port"
+
 
 class WorkerNotFoundError(TPUMounterError):
     def __init__(self, node: str):
@@ -42,7 +46,7 @@ class WorkerDirectory:
         self.ttl_s = ttl_s
         self._lock = threading.Lock()           # guards the cache map
         self._refresh_lock = threading.Lock()   # serialises apiserver LISTs
-        self._by_node: dict[str, str] = {}     # node -> worker pod IP
+        self._by_node: dict[str, str] = {}     # node -> "ip:port" target
         self._fetched_at = 0.0
 
     def _refresh(self) -> None:
@@ -59,7 +63,10 @@ class WorkerDirectory:
             for pod in pods:
                 ip = pod.get("status", {}).get("podIP", "")
                 if objects.is_running(pod) and ip and objects.node_name(pod):
-                    by_node[objects.node_name(pod)] = ip
+                    # per-pod port override (hostNetwork / test deployments)
+                    port = (pod.get("metadata", {}).get("annotations", {})
+                            or {}).get(PORT_ANNOTATION, self.grpc_port)
+                    by_node[objects.node_name(pod)] = f"{ip}:{port}"
             with self._lock:
                 self._by_node = by_node
                 self._fetched_at = time.monotonic()
@@ -73,14 +80,14 @@ class WorkerDirectory:
         """gRPC target ``ip:port`` of the worker on ``node``."""
         with self._lock:
             stale = time.monotonic() - self._fetched_at > self.ttl_s
-            ip = self._by_node.get(node)
-        if stale or (ip is None and self._miss_refresh_allowed()):
+            target = self._by_node.get(node)
+        if stale or (target is None and self._miss_refresh_allowed()):
             self._refresh()
             with self._lock:
-                ip = self._by_node.get(node)
-        if not ip:
+                target = self._by_node.get(node)
+        if not target:
             raise WorkerNotFoundError(node)
-        return f"{ip}:{self.grpc_port}"
+        return target
 
     def _miss_refresh_allowed(self) -> bool:
         with self._lock:

@@ -133,7 +133,62 @@ class MasterGateway:
             uuids = _parse_uuids(body, parsed.query)
             return self._remove(match["ns"], match["pod"], uuids,
                                 match["force"] == "true")
+        if parsed.path == "/addtpuslice" and method == "POST":
+            return self._slice_attach(body)
+        if parsed.path == "/removetpuslice" and method == "POST":
+            return self._slice_detach(body)
         return 404, {"result": "NoSuchRoute", "message": path}
+
+    # -- multi-host slice transactions (BASELINE config 5) ---------------------
+
+    def _slice_coordinator(self):
+        from gpumounter_tpu.master.slice import SliceCoordinator
+        return SliceCoordinator(self)
+
+    @staticmethod
+    def _parse_slice_body(body: bytes) -> tuple[list[tuple[str, str]], dict]:
+        try:
+            obj = json.loads(body.decode() or "{}")
+        except json.JSONDecodeError as e:
+            raise ValueError(f"bad JSON body: {e}") from e
+        if not isinstance(obj, dict) or not isinstance(obj.get("pods"), list):
+            raise ValueError(
+                'body must be {"pods": [{"namespace": ..., "pod": ...}, '
+                '...], ...}')
+        pods = [(str(p.get("namespace", "default")), str(p["pod"]))
+                for p in obj["pods"] if isinstance(p, dict) and p.get("pod")]
+        if not pods:
+            raise ValueError(
+                'body must be {"pods": [{"namespace": ..., "pod": ...}, '
+                '...], ...}')
+        return pods, obj
+
+    def _slice_attach(self, body: bytes) -> tuple[int, dict]:
+        try:
+            pods, obj = self._parse_slice_body(body)
+            tpus = obj.get("tpusPerHost", 4)
+            if not isinstance(tpus, int) or isinstance(tpus, bool) \
+                    or tpus < 1:
+                raise ValueError(
+                    f"tpusPerHost must be a positive integer, got {tpus!r}")
+        except ValueError as e:
+            return 400, {"result": "BadRequest", "message": str(e)}
+        ok, results = self._slice_coordinator().attach(pods, tpus)
+        return (200 if ok else 503), {
+            "result": "SUCCESS" if ok else "SliceAttachFailed",
+            "rolled_back": not ok,
+            "pods": [r.to_json() for r in results]}
+
+    def _slice_detach(self, body: bytes) -> tuple[int, dict]:
+        try:
+            pods, obj = self._parse_slice_body(body)
+        except ValueError as e:
+            return 400, {"result": "BadRequest", "message": str(e)}
+        force = bool(obj.get("force", False))
+        ok, results = self._slice_coordinator().detach(pods, force)
+        return (200 if ok else 409), {
+            "result": "SUCCESS" if ok else "SliceDetachIncomplete",
+            "pods": [r.to_json() for r in results]}
 
     def _call_worker(self, namespace: str, pod_name: str, fn):
         """Resolve pod -> node -> worker and run ``fn(client)``. On

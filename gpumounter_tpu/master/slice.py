@@ -1,0 +1,128 @@
+"""Multi-host slice attach: one transaction across per-node workers.
+
+SURVEY.md §7 hard part 5: the reference has no cross-worker coordination —
+each AddGPU touches exactly one node. A multi-host TPU slice (e.g. v5p-16)
+spans hosts, and a half-attached slice is useless: every host's JAX process
+must see its local chips or ``jax.distributed`` initialisation hangs. The
+master therefore offers a slice-level transaction:
+
+- **attach**: entire-mount every target pod (one pod per host) concurrently;
+  if ANY host fails, roll back the ones that succeeded (best-effort detach)
+  and report per-pod results. All-or-nothing at the slice level.
+- **detach**: fan out RemoveTPU to every pod; failures reported per pod
+  (no rollback — detach is already the rollback direction).
+
+The per-host mechanism is unchanged (slave pods + actuation); this layer is
+pure orchestration, so node accounting stays exact on every host.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("master.slice")
+
+
+@dataclasses.dataclass
+class PodResult:
+    namespace: str
+    pod: str
+    result: str
+    device_ids: list[str] = dataclasses.field(default_factory=list)
+    message: str = ""
+
+    def to_json(self) -> dict:
+        out = {"namespace": self.namespace, "pod": self.pod,
+               "result": self.result}
+        if self.device_ids:
+            out["device_ids"] = self.device_ids
+        if self.message:
+            out["message"] = self.message
+        return out
+
+
+class SliceCoordinator:
+    """Runs slice transactions through a MasterGateway's worker plumbing."""
+
+    def __init__(self, gateway, max_parallel: int = 16):
+        self.gateway = gateway
+        self.max_parallel = max_parallel
+
+    # -- attach ----------------------------------------------------------------
+
+    def attach(self, pods: list[tuple[str, str]],
+               tpus_per_host: int) -> tuple[bool, list[PodResult]]:
+        """Entire-mount ``tpus_per_host`` chips to every (namespace, pod).
+        Returns (ok, per-pod results). On any failure every successful
+        attach is rolled back."""
+        results = self._fan_out(
+            pods, lambda ns, name: self._attach_one(ns, name, tpus_per_host))
+        ok = all(r.result == "SUCCESS" for r in results)
+        if not ok:
+            succeeded = [r for r in results if r.result == "SUCCESS"]
+            if succeeded:
+                logger.warning("slice attach failed; rolling back %d hosts",
+                               len(succeeded))
+                # Detach exactly the chips THIS transaction attached — a pod
+                # may hold earlier mounts that must survive the rollback.
+                rollback = self._fan_out(
+                    [(r.namespace, r.pod) for r in succeeded],
+                    lambda ns, name: self._detach_one(
+                        ns, name, force=True,
+                        uuids=next(r.device_ids for r in succeeded
+                                   if (r.namespace, r.pod) == (ns, name))))
+                for r in rollback:
+                    if r.result != "SUCCESS":
+                        logger.error("slice rollback left %s/%s attached: %s",
+                                     r.namespace, r.pod, r.message)
+        return ok, results
+
+    def _attach_one(self, namespace: str, pod: str,
+                    tpu_num: int) -> PodResult:
+        try:
+            resp = self.gateway._call_worker(
+                namespace, pod,
+                lambda w: w.add_tpu(pod, namespace, tpu_num, True))
+            result = consts.AddResult(resp.result)
+            out = PodResult(namespace, pod, result.name,
+                            device_ids=list(resp.device_ids))
+        except Exception as e:
+            out = PodResult(namespace, pod, "ERROR", message=str(e))
+        REGISTRY.attach_results.inc(result=f"slice_{out.result}")
+        return out
+
+    # -- detach ----------------------------------------------------------------
+
+    def detach(self, pods: list[tuple[str, str]],
+               force: bool = False) -> tuple[bool, list[PodResult]]:
+        results = self._fan_out(
+            pods, lambda ns, name: self._detach_one(ns, name, force))
+        # TPU_NOT_FOUND counts as done: retrying a completed detach must
+        # converge to success, not 409 forever.
+        ok = all(r.result in ("SUCCESS", "TPU_NOT_FOUND") for r in results)
+        return ok, results
+
+    def _detach_one(self, namespace: str, pod: str, force: bool,
+                    uuids: list[str] | None = None) -> PodResult:
+        try:
+            resp = self.gateway._call_worker(
+                namespace, pod,
+                lambda w: w.remove_tpu(pod, namespace, uuids or [], force))
+            result = consts.RemoveResult(resp.result)
+            out = PodResult(namespace, pod, result.name)
+        except Exception as e:
+            out = PodResult(namespace, pod, "ERROR", message=str(e))
+        REGISTRY.detach_results.inc(result=f"slice_{out.result}")
+        return out
+
+    # -- plumbing --------------------------------------------------------------
+
+    def _fan_out(self, pods: list[tuple[str, str]], fn) -> list[PodResult]:
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(self.max_parallel, max(1, len(pods)))) as ex:
+            return list(ex.map(lambda p: fn(p[0], p[1]), pods))

@@ -45,14 +45,20 @@ def make_target_pod(name="workload", namespace="default", node="node-a",
     }
 
 
-def worker_pod(node, ip, name="w1"):
-    """A Running tpu-mounter-worker pod as the master's discovery sees it."""
-    return {
+def worker_pod(node, ip, name="w1", grpc_port: int | None = None):
+    """A Running tpu-mounter-worker pod as the master's discovery sees it.
+    ``grpc_port`` sets the per-pod port-override annotation (local stacks
+    run several workers on one IP)."""
+    pod = {
         "metadata": {"name": name, "namespace": consts.WORKER_NAMESPACE,
                      "labels": {"app": "tpu-mounter-worker"}},
         "spec": {"nodeName": node},
         "status": {"phase": "Running", "podIP": ip},
     }
+    if grpc_port is not None:
+        from gpumounter_tpu.master.discovery import PORT_ANNOTATION
+        pod["metadata"]["annotations"] = {PORT_ANNOTATION: str(grpc_port)}
+    return pod
 
 
 class ClusterSim:
@@ -159,7 +165,8 @@ class WorkerRig:
     """
 
     def __init__(self, fake_host, n_chips=4, pid=4242, actuator="recording",
-                 use_kubelet_socket=False):
+                 use_kubelet_socket=False, node="node-a",
+                 pod_name="workload"):
         from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
         from gpumounter_tpu.actuation.mount import TPUMounter
         from gpumounter_tpu.actuation.nsenter import (ProcRootActuator,
@@ -168,12 +175,13 @@ class WorkerRig:
         from gpumounter_tpu.worker.service import TPUMountService
 
         self.sim = ClusterSim(
-            n_chips=n_chips,
+            n_chips=n_chips, node=node,
             kubelet_socket_path=(fake_host.kubelet_socket
                                  if use_kubelet_socket else None))
         self.sim.settings.host = fake_host
         self.host = fake_host
-        self.pod = self.sim.add_target_pod()
+        self.pod = self.sim.add_target_pod(name=pod_name)
+        self.pod_name = pod_name
         self.pid = pid
 
         # container cgroup with one live PID
@@ -232,3 +240,40 @@ class LiveStack:
         self.http_server.shutdown()
         self.grpc_server.stop(grace=0)
         self.rig.close()
+
+
+class MultiNodeStack:
+    """N simulated TPU nodes (one WorkerRig + live gRPC worker each) behind
+    ONE master — the multi-host slice topology (BASELINE config 5). Node i
+    is ``node-i`` holding pod ``workload-i``."""
+
+    def __init__(self, hosts: list, n_chips=4):
+        from gpumounter_tpu.master.discovery import WorkerDirectory
+        from gpumounter_tpu.master.gateway import MasterGateway
+        from gpumounter_tpu.worker.grpc_server import build_server
+
+        self.rigs: list[WorkerRig] = []
+        self.grpc_servers = []
+        self.master_kube = FakeKubeClient()
+        for i, host in enumerate(hosts):
+            rig = WorkerRig(host, n_chips=n_chips, node=f"node-{i}",
+                            pod_name=f"workload-{i}")
+            server, port = build_server(rig.service, port=0,
+                                        address="127.0.0.1")
+            server.start()
+            self.rigs.append(rig)
+            self.grpc_servers.append(server)
+            self.master_kube.put_pod(worker_pod(
+                f"node-{i}", "127.0.0.1", name=f"w{i}", grpc_port=port))
+            self.master_kube.put_pod(rig.pod)
+        self.gateway = MasterGateway(self.master_kube,
+                                     WorkerDirectory(self.master_kube))
+        self.http_server = self.gateway.serve(port=0, address="127.0.0.1")
+        self.base = f"http://127.0.0.1:{self.http_server.server_port}"
+
+    def close(self) -> None:
+        self.http_server.shutdown()
+        for server in self.grpc_servers:
+            server.stop(grace=0)
+        for rig in self.rigs:
+            rig.close()

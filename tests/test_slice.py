@@ -1,0 +1,121 @@
+"""Multi-host slice transactions (BASELINE config 5): one master, several
+simulated TPU nodes, all-or-nothing attach with rollback."""
+
+import json
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.testing.sim import MultiNodeStack
+from gpumounter_tpu.utils.config import HostPaths
+
+
+def _host(tmp_path, i):
+    base = tmp_path / f"node{i}"
+    for sub in ("dev", "proc", "sys/fs/cgroup"):
+        (base / sub).mkdir(parents=True)
+    return HostPaths(dev_root=str(base / "dev"),
+                     proc_root=str(base / "proc"),
+                     sys_root=str(base / "sys"),
+                     cgroup_root=str(base / "sys" / "fs" / "cgroup"),
+                     kubelet_socket=str(base / "pr" / "kubelet.sock"))
+
+
+@pytest.fixture
+def stack(tmp_path):
+    s = MultiNodeStack([_host(tmp_path, 0), _host(tmp_path, 1)], n_chips=4)
+    yield s
+    s.close()
+
+
+def _post(url, obj):
+    req = urllib.request.Request(url, data=json.dumps(obj).encode(),
+                                 method="POST")
+    try:
+        resp = urllib.request.urlopen(req)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+SLICE = {"pods": [{"namespace": "default", "pod": "workload-0"},
+                  {"namespace": "default", "pod": "workload-1"}],
+         "tpusPerHost": 4}
+
+
+def test_slice_attach_all_hosts(stack):
+    status, body = _post(f"{stack.base}/addtpuslice", SLICE)
+    assert status == 200
+    assert body["result"] == "SUCCESS"
+    assert len(body["pods"]) == 2
+    for entry, rig in zip(sorted(body["pods"], key=lambda p: p["pod"]),
+                          stack.rigs):
+        assert entry["result"] == "SUCCESS"
+        assert len(entry["device_ids"]) == 4
+        assert len(rig.sim.slave_pods()) == 1       # one entire-mount per host
+
+
+def test_slice_detach(stack):
+    _post(f"{stack.base}/addtpuslice", SLICE)
+    status, body = _post(f"{stack.base}/removetpuslice",
+                         {"pods": SLICE["pods"]})
+    assert status == 200
+    assert body["result"] == "SUCCESS"
+    for rig in stack.rigs:
+        assert rig.sim.slave_pods() == []
+
+
+def test_slice_attach_rolls_back_on_partial_failure(stack):
+    # node-1 has no free chips: pre-claim them via the per-pod route
+    status, body = _post(f"{stack.base}/removetpuslice", {"pods": []})
+    assert status == 400                            # empty pod list rejected
+    urllib.request.urlopen(
+        f"{stack.base}/addtpu/namespace/default/pod/workload-1/tpu/4"
+        "/isEntireMount/true")
+
+    status, body = _post(f"{stack.base}/addtpuslice", SLICE)
+    assert status == 503
+    assert body["result"] == "SliceAttachFailed"
+    assert body["rolled_back"] is True
+    results = {p["pod"]: p["result"] for p in body["pods"]}
+    assert results["workload-1"] in ("INSUFFICIENT_TPU", "ERROR")
+    # node-0's successful attach was rolled back — chips free again
+    assert stack.rigs[0].sim.slave_pods() == []
+    # node-1's pre-existing mount is untouched
+    assert len(stack.rigs[1].sim.slave_pods()) == 1
+
+
+def test_slice_bad_body_is_400(stack):
+    for bad in ({"pods": "nope"}, [], None, {"pods": [{}]},
+                {"pods": SLICE["pods"], "tpusPerHost": None},
+                {"pods": SLICE["pods"], "tpusPerHost": 0},
+                {"pods": SLICE["pods"], "tpusPerHost": "abc"}):
+        status, body = _post(f"{stack.base}/addtpuslice", bad)
+        assert status == 400, bad
+        assert body["result"] == "BadRequest"
+
+
+def test_slice_detach_is_idempotent(stack):
+    _post(f"{stack.base}/addtpuslice", SLICE)
+    status, _ = _post(f"{stack.base}/removetpuslice", {"pods": SLICE["pods"]})
+    assert status == 200
+    # retry of a completed detach converges to 200, not 409
+    status, body = _post(f"{stack.base}/removetpuslice",
+                         {"pods": SLICE["pods"]})
+    assert status == 200
+    assert {p["result"] for p in body["pods"]} == {"TPU_NOT_FOUND"}
+
+
+def test_slice_rollback_preserves_preexisting_mounts(stack):
+    # workload-1 already holds 2 chips from a per-pod single-mount flow
+    import urllib.request as _rq
+    _rq.urlopen(f"{stack.base}/addtpu/namespace/default/pod/workload-1"
+                "/tpu/2/isEntireMount/true")
+    assert len(stack.rigs[1].sim.slave_pods()) == 1
+
+    # slice wants 4 per host: node-1 only has 2 free -> transaction fails
+    status, body = _post(f"{stack.base}/addtpuslice", SLICE)
+    assert status == 503
+    # rollback removed node-0's new chips but NOT node-1's earlier mount
+    assert stack.rigs[0].sim.slave_pods() == []
+    assert len(stack.rigs[1].sim.slave_pods()) == 1

@@ -80,7 +80,13 @@ def drain_restore_cycle(tree: Any, shardings: Any = None,
     try:
         drain(tree, path)
         reinitialize_backend()
-        return restore(path, shardings)
-    finally:
-        if own_tmp and os.path.exists(path):
-            os.unlink(path)
+        restored = restore(path, shardings)
+    except BaseException:
+        # The checkpoint may be the ONLY surviving copy (device buffers are
+        # invalid after the backend drop) — never delete it on failure.
+        logger.error("drain/restore cycle failed; checkpoint kept at %s",
+                     path)
+        raise
+    if own_tmp and os.path.exists(path):
+        os.unlink(path)
+    return restored

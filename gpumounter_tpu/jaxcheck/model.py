@@ -148,11 +148,24 @@ def make_mesh(devices=None, data: int | None = None, seq: int | None = None,
     return Mesh(grid, ("data", "seq", "model"))
 
 
-def make_attention(mesh: Mesh | None, cfg: ModelConfig) -> Callable:
-    """Ring attention over the mesh's seq axis, or full attention when
-    unsharded (single chip / seq axis of 1)."""
+def make_attention(mesh: Mesh | None, cfg: ModelConfig,
+                   impl: str = "ring") -> Callable:
+    """Sequence-parallel attention over the mesh's seq axis — ``impl`` is
+    "ring" (ppermute K/V rotation) or "ulysses" (all-to-all head
+    redistribution); full attention when unsharded (single chip / seq axis
+    of 1)."""
     if mesh is None or mesh.shape["seq"] == 1:
         return full_attention
-    del cfg
-    return make_sharded_ring_attention(
-        mesh, "seq", spec=P("data", "seq", "model", None))
+    spec = P("data", "seq", "model", None)
+    if impl == "ulysses":
+        from gpumounter_tpu.jaxcheck.ulysses import make_ulysses_attention
+        # per-device head count after TP sharding must split over seq too
+        per_device = mesh.shape["model"] * mesh.shape["seq"]
+        if cfg.n_heads % per_device != 0:
+            raise ValueError(
+                f"ulysses needs n_heads ({cfg.n_heads}) divisible by "
+                f"model*seq mesh axes ({per_device})")
+        return make_ulysses_attention(mesh, "seq", spec=spec)
+    if impl == "ring":
+        return make_sharded_ring_attention(mesh, "seq", spec=spec)
+    raise ValueError(f"unknown attention impl {impl!r}")

@@ -1,0 +1,69 @@
+"""Ulysses-style sequence parallelism: all-to-all head redistribution.
+
+The second standard long-context scheme next to ring attention (DeepSpeed
+Ulysses): instead of rotating K/V blocks around a ring, redistribute ONCE —
+an all-to-all converts sequence-sharded [B, T/n, H, D] tensors into
+head-sharded [B, T, H/n, D], each device runs ordinary full attention over
+the complete sequence for its heads, and a second all-to-all restores
+sequence sharding.
+
+Trade-offs vs the ring (why both exist in this harness):
+
+- Ulysses: 2 all-to-alls total, full attention locally — better when
+  H >= n and T is moderate; all-to-all stresses every ICI link at once.
+- Ring: n neighbour hops overlappable with compute, O(T_local²) score
+  blocks — better for very long T and when H < n.
+
+As a post-attach validator, Ulysses exercises the all-to-all collective
+path, complementing the ring's ppermute — together they cover both ICI
+traffic patterns a long-context training job generates.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gpumounter_tpu.jaxcheck.ring_attention import full_attention
+
+
+def _ulysses_attention(q, k, v, axis_name: str):
+    """Per-shard body. q/k/v: [B, T_local, H, D] (sequence-sharded).
+    H must be divisible by the axis size."""
+    n = lax.psum(1, axis_name)
+    _, _, heads, _ = q.shape
+    assert heads % n == 0, (
+        f"Ulysses needs heads ({heads}) divisible by axis size ({n})")
+
+    def seq_to_heads(x):
+        # [B, T/n, H, D] -> [B, T, H/n, D]: split heads across devices,
+        # gather the sequence. all_to_all(split_axis=heads, concat_axis=seq)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = full_attention(q, k, v)      # full causal attention, local heads
+    return heads_to_seq(out)
+
+
+def make_ulysses_attention(mesh: Mesh, seq_axis: str = "seq",
+                           spec: P | None = None):
+    """shard_map-wrapped Ulysses attention with the same call signature as
+    :func:`make_sharded_ring_attention`: globally-shaped [B, T, H, D] inputs
+    sequence-sharded over ``seq_axis``."""
+    spec = spec if spec is not None else P(None, seq_axis, None, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    def sharded(q, k, v):
+        return _ulysses_attention(q, k, v, seq_axis)
+
+    return sharded

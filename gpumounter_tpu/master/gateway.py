@@ -22,6 +22,7 @@ import json
 import re
 import threading
 import urllib.parse
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import grpc
@@ -44,6 +45,8 @@ _ADD_RE = re.compile(
 _REMOVE_RE = re.compile(
     r"^/removetpu/namespace/(?P<ns>[^/]+)/pod/(?P<pod>[^/]+)"
     r"/force/(?P<force>true|false)$")
+_STATUS_RE = re.compile(
+    r"^/tpustatus/namespace/(?P<ns>[^/]+)/pod/(?P<pod>[^/]+)$")
 
 _ADD_HTTP = {
     consts.AddResult.SUCCESS: 200,
@@ -100,43 +103,57 @@ class MasterGateway:
 
     def handle(self, method: str, path: str,
                body: bytes = b"") -> tuple[int, dict]:
-        """Returns (http_status, json_payload)."""
+        """Returns (http_status, json_payload). Every request gets an
+        x-request-id, echoed in the payload and stamped onto worker gRPC
+        metadata, so one mount flow greps across master+worker logs."""
+        rid = uuid.uuid4().hex[:12]
         try:
-            return self._route(method, path, body)
+            status, payload = self._route(method, path, body, rid)
         except PodNotFoundError as e:
-            return 404, {"result": "PodNotFound", "message": str(e)}
+            status, payload = 404, {"result": "PodNotFound",
+                                    "message": str(e)}
         except WorkerNotFoundError as e:
-            return 502, {"result": "WorkerNotFound", "message": str(e)}
+            status, payload = 502, {"result": "WorkerNotFound",
+                                    "message": str(e)}
         except K8sApiError as e:
-            return 502, {"result": "ApiserverError", "message": str(e)}
+            status, payload = 502, {"result": "ApiserverError",
+                                    "message": str(e)}
         except grpc.RpcError as e:
             code = e.code() if hasattr(e, "code") else None
-            return (_GRPC_HTTP.get(code, 502),
-                    {"result": str(code and code.name),
-                     "message": e.details() if hasattr(e, "details")
-                     else str(e)})
+            status, payload = (_GRPC_HTTP.get(code, 502),
+                               {"result": str(code and code.name),
+                                "message": e.details()
+                                if hasattr(e, "details") else str(e)})
         except ValueError as e:
             # e.g. a version-skewed worker returning a result enum value we
             # don't know — answer with JSON instead of dropping the socket
-            return 502, {"result": "UnknownWorkerResult", "message": str(e)}
+            status, payload = 502, {"result": "UnknownWorkerResult",
+                                    "message": str(e)}
+        # error paths especially need the id — they're what gets debugged
+        payload.setdefault("request_id", rid)
+        return status, payload
 
-    def _route(self, method: str, path: str, body: bytes) -> tuple[int, dict]:
+    def _route(self, method: str, path: str, body: bytes,
+               rid: str = "-") -> tuple[int, dict]:
         parsed = urllib.parse.urlparse(path)
         if parsed.path == "/healthz":
             return 200, {"status": "ok"}
         match = _ADD_RE.match(parsed.path)
         if match and method == "GET":
             return self._add(match["ns"], match["pod"], int(match["num"]),
-                             match["entire"] == "true")
+                             match["entire"] == "true", rid)
         match = _REMOVE_RE.match(parsed.path)
         if match and method == "POST":
             uuids = _parse_uuids(body, parsed.query)
             return self._remove(match["ns"], match["pod"], uuids,
-                                match["force"] == "true")
+                                match["force"] == "true", rid)
+        match = _STATUS_RE.match(parsed.path)
+        if match and method == "GET":
+            return self._status(match["ns"], match["pod"], rid)
         if parsed.path == "/addtpuslice" and method == "POST":
-            return self._slice_attach(body)
+            return self._slice_attach(body, rid)
         if parsed.path == "/removetpuslice" and method == "POST":
-            return self._slice_detach(body)
+            return self._slice_detach(body, rid)
         return 404, {"result": "NoSuchRoute", "message": path}
 
     # -- multi-host slice transactions (BASELINE config 5) ---------------------
@@ -163,7 +180,7 @@ class MasterGateway:
                 '...], ...}')
         return pods, obj
 
-    def _slice_attach(self, body: bytes) -> tuple[int, dict]:
+    def _slice_attach(self, body: bytes, rid: str = "-") -> tuple[int, dict]:
         try:
             pods, obj = self._parse_slice_body(body)
             tpus = obj.get("tpusPerHost", 4)
@@ -173,19 +190,21 @@ class MasterGateway:
                     f"tpusPerHost must be a positive integer, got {tpus!r}")
         except ValueError as e:
             return 400, {"result": "BadRequest", "message": str(e)}
-        ok, results = self._slice_coordinator().attach(pods, tpus)
+        ok, results, rollback_clean = self._slice_coordinator().attach(
+            pods, tpus, request_id=rid)
         return (200 if ok else 503), {
             "result": "SUCCESS" if ok else "SliceAttachFailed",
-            "rolled_back": not ok,
+            "rolled_back": (not ok) and rollback_clean,
             "pods": [r.to_json() for r in results]}
 
-    def _slice_detach(self, body: bytes) -> tuple[int, dict]:
+    def _slice_detach(self, body: bytes, rid: str = "-") -> tuple[int, dict]:
         try:
             pods, obj = self._parse_slice_body(body)
         except ValueError as e:
             return 400, {"result": "BadRequest", "message": str(e)}
         force = bool(obj.get("force", False))
-        ok, results = self._slice_coordinator().detach(pods, force)
+        ok, results = self._slice_coordinator().detach(pods, force,
+                                                       request_id=rid)
         return (200 if ok else 409), {
             "result": "SUCCESS" if ok else "SliceDetachIncomplete",
             "pods": [r.to_json() for r in results]}
@@ -211,10 +230,11 @@ class MasterGateway:
             return fn(self._client(fresh))
 
     def _add(self, namespace: str, pod_name: str, tpu_num: int,
-             entire: bool) -> tuple[int, dict]:
+             entire: bool, rid: str = "-") -> tuple[int, dict]:
         resp = self._call_worker(
             namespace, pod_name,
-            lambda w: w.add_tpu(pod_name, namespace, tpu_num, entire))
+            lambda w: w.add_tpu(pod_name, namespace, tpu_num, entire,
+                                request_id=rid))
         result = consts.AddResult(resp.result)
         REGISTRY.attach_results.inc(result=f"master_{result.name}")
         return _ADD_HTTP[result], {
@@ -224,16 +244,32 @@ class MasterGateway:
         }
 
     def _remove(self, namespace: str, pod_name: str, uuids: list[str],
-                force: bool) -> tuple[int, dict]:
+                force: bool, rid: str = "-") -> tuple[int, dict]:
         resp = self._call_worker(
             namespace, pod_name,
-            lambda w: w.remove_tpu(pod_name, namespace, uuids, force))
+            lambda w: w.remove_tpu(pod_name, namespace, uuids, force,
+                                   request_id=rid))
         result = consts.RemoveResult(resp.result)
         REGISTRY.detach_results.inc(result=f"master_{result.name}")
         payload: dict = {"result": result.name}
         if resp.busy_pids:
             payload["busy_pids"] = list(resp.busy_pids)
         return _REMOVE_HTTP[result], payload
+
+    def _status(self, namespace: str, pod_name: str,
+                rid: str = "-") -> tuple[int, dict]:
+        resp = self._call_worker(
+            namespace, pod_name,
+            lambda w: w.tpu_status(pod_name, namespace, request_id=rid))
+        return 200, {
+            "mount_type": resp.mount_type,
+            "chips": [{
+                "device_id": c.device_id,
+                "device_path": c.device_path,
+                "slave_pod": c.slave_pod,
+                "busy_pids": list(c.busy_pids),
+            } for c in resp.chips],
+        }
 
     # -- HTTP server -----------------------------------------------------------
 

@@ -12,6 +12,7 @@ from gpumounter_tpu.master.discovery import WorkerDirectory
 from gpumounter_tpu.master.gateway import MasterGateway
 from gpumounter_tpu.utils.config import Settings
 from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.worker.grpc_server import WorkerClient, load_tls_config
 
 logger = get_logger("master.main")
 
@@ -23,7 +24,10 @@ def main() -> None:
                                 namespace=settings.worker_namespace,
                                 label_selector=settings.worker_label_selector,
                                 grpc_port=settings.worker_grpc_port)
-    gateway = MasterGateway(kube, directory)
+    tls = load_tls_config()
+    gateway = MasterGateway(
+        kube, directory,
+        worker_client_factory=lambda target: WorkerClient(target, tls=tls))
     server = gateway.serve(settings.master_http_port)
     logger.info("master ready on :%d", settings.master_http_port)
     try:

@@ -56,38 +56,63 @@ class SliceCoordinator:
     # -- attach ----------------------------------------------------------------
 
     def attach(self, pods: list[tuple[str, str]],
-               tpus_per_host: int) -> tuple[bool, list[PodResult]]:
+               tpus_per_host: int,
+               request_id: str | None = None
+               ) -> tuple[bool, list[PodResult], bool]:
         """Entire-mount ``tpus_per_host`` chips to every (namespace, pod).
-        Returns (ok, per-pod results). On any failure every successful
-        attach is rolled back."""
+        Returns (ok, per-pod results, rollback_clean). On any failure the
+        transaction is rolled back:
+
+        - SUCCESS hosts: detach exactly the device_ids this transaction
+          attached (earlier mounts on the pod must survive).
+        - ERROR hosts whose failure was transport-level (lost reply/timeout):
+          the worker may have attached chips we never learned about. Since a
+          slice attach is an entire-mount — and entire-mounts are only
+          permitted on pods with no existing mounts (util.go:207-226 policy)
+          — every slave-held chip on such a pod belongs to this transaction,
+          so a detach-all is safe and is attempted. Policy rejections
+          (FAILED_PRECONDITION) attached nothing and are skipped.
+
+        ``rollback_clean`` is False if any rollback detach itself failed
+        (chips may be leaked; the per-pod results say where to look).
+        """
         results = self._fan_out(
-            pods, lambda ns, name: self._attach_one(ns, name, tpus_per_host))
+            pods,
+            lambda ns, name: self._attach_one(ns, name, tpus_per_host,
+                                              request_id))
         ok = all(r.result == "SUCCESS" for r in results)
+        rollback_clean = True
         if not ok:
-            succeeded = [r for r in results if r.result == "SUCCESS"]
-            if succeeded:
+            to_roll: list[tuple[str, str, list[str] | None]] = []
+            for r in results:
+                if r.result == "SUCCESS":
+                    to_roll.append((r.namespace, r.pod, r.device_ids))
+                elif (r.result == "ERROR"
+                      and "FAILED_PRECONDITION" not in r.message):
+                    to_roll.append((r.namespace, r.pod, None))  # detach all
+            if to_roll:
                 logger.warning("slice attach failed; rolling back %d hosts",
-                               len(succeeded))
-                # Detach exactly the chips THIS transaction attached — a pod
-                # may hold earlier mounts that must survive the rollback.
+                               len(to_roll))
+                uuid_map = {(ns, name): uuids for ns, name, uuids in to_roll}
                 rollback = self._fan_out(
-                    [(r.namespace, r.pod) for r in succeeded],
+                    [(ns, name) for ns, name, _ in to_roll],
                     lambda ns, name: self._detach_one(
-                        ns, name, force=True,
-                        uuids=next(r.device_ids for r in succeeded
-                                   if (r.namespace, r.pod) == (ns, name))))
+                        ns, name, force=True, uuids=uuid_map[(ns, name)],
+                        request_id=request_id))
                 for r in rollback:
-                    if r.result != "SUCCESS":
+                    if r.result not in ("SUCCESS", "TPU_NOT_FOUND"):
+                        rollback_clean = False
                         logger.error("slice rollback left %s/%s attached: %s",
                                      r.namespace, r.pod, r.message)
-        return ok, results
+        return ok, results, rollback_clean
 
-    def _attach_one(self, namespace: str, pod: str,
-                    tpu_num: int) -> PodResult:
+    def _attach_one(self, namespace: str, pod: str, tpu_num: int,
+                    request_id: str | None = None) -> PodResult:
         try:
             resp = self.gateway._call_worker(
                 namespace, pod,
-                lambda w: w.add_tpu(pod, namespace, tpu_num, True))
+                lambda w: w.add_tpu(pod, namespace, tpu_num, True,
+                                    request_id=request_id))
             result = consts.AddResult(resp.result)
             out = PodResult(namespace, pod, result.name,
                             device_ids=list(resp.device_ids))
@@ -98,21 +123,25 @@ class SliceCoordinator:
 
     # -- detach ----------------------------------------------------------------
 
-    def detach(self, pods: list[tuple[str, str]],
-               force: bool = False) -> tuple[bool, list[PodResult]]:
+    def detach(self, pods: list[tuple[str, str]], force: bool = False,
+               request_id: str | None = None
+               ) -> tuple[bool, list[PodResult]]:
         results = self._fan_out(
-            pods, lambda ns, name: self._detach_one(ns, name, force))
+            pods, lambda ns, name: self._detach_one(
+                ns, name, force, request_id=request_id))
         # TPU_NOT_FOUND counts as done: retrying a completed detach must
         # converge to success, not 409 forever.
         ok = all(r.result in ("SUCCESS", "TPU_NOT_FOUND") for r in results)
         return ok, results
 
     def _detach_one(self, namespace: str, pod: str, force: bool,
-                    uuids: list[str] | None = None) -> PodResult:
+                    uuids: list[str] | None = None,
+                    request_id: str | None = None) -> PodResult:
         try:
             resp = self.gateway._call_worker(
                 namespace, pod,
-                lambda w: w.remove_tpu(pod, namespace, uuids or [], force))
+                lambda w: w.remove_tpu(pod, namespace, uuids or [], force,
+                                       request_id=request_id))
             result = consts.RemoveResult(resp.result)
             out = PodResult(namespace, pod, result.name)
         except Exception as e:

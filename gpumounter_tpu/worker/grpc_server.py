@@ -25,9 +25,22 @@ logger = get_logger("worker.grpc")
 SERVICE_NAME = "tpu_mount.TPUMountService"
 
 
+def _request_id(context: grpc.ServicerContext) -> str:
+    """x-request-id from the caller's metadata (master stamps one per HTTP
+    request) so one mount flow is grep-able across master+worker logs."""
+    for key, value in context.invocation_metadata() or ():
+        if key == "x-request-id":
+            return value
+    return "-"
+
+
 def _add_handler(service: TPUMountService):
     def handle(request: pb.AddTPURequest,
                context: grpc.ServicerContext) -> pb.AddTPUResponse:
+        rid = _request_id(context)
+        logger.info("[rid=%s] AddTPU %s/%s n=%d entire=%s", rid,
+                    request.namespace, request.pod_name, request.tpu_num,
+                    request.is_entire_mount)
         try:
             outcome = service.add_tpu(request.pod_name, request.namespace,
                                       request.tpu_num,
@@ -35,11 +48,12 @@ def _add_handler(service: TPUMountService):
         except MountPolicyError as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         except TPUMounterError as e:
-            logger.exception("AddTPU internal failure")
+            logger.exception("[rid=%s] AddTPU internal failure", rid)
             context.abort(grpc.StatusCode.INTERNAL, str(e))
         resp = pb.AddTPUResponse(result=int(outcome.result))
         resp.device_ids.extend(c.uuid for c in outcome.chips)
         resp.device_paths.extend(c.container_path for c in outcome.chips)
+        logger.info("[rid=%s] AddTPU -> %s", rid, outcome.result.name)
         return resp
     return handle
 
@@ -47,22 +61,114 @@ def _add_handler(service: TPUMountService):
 def _remove_handler(service: TPUMountService):
     def handle(request: pb.RemoveTPURequest,
                context: grpc.ServicerContext) -> pb.RemoveTPUResponse:
+        rid = _request_id(context)
+        logger.info("[rid=%s] RemoveTPU %s/%s uuids=%s force=%s", rid,
+                    request.namespace, request.pod_name,
+                    list(request.uuids), request.force)
         try:
             outcome = service.remove_tpu(request.pod_name, request.namespace,
                                          list(request.uuids), request.force)
         except TPUMounterError as e:
-            logger.exception("RemoveTPU internal failure")
+            logger.exception("[rid=%s] RemoveTPU internal failure", rid)
             context.abort(grpc.StatusCode.INTERNAL, str(e))
         resp = pb.RemoveTPUResponse(result=int(outcome.result))
         resp.busy_pids.extend(outcome.busy_pids)
+        logger.info("[rid=%s] RemoveTPU -> %s", rid, outcome.result.name)
         return resp
     return handle
+
+
+def _status_handler(service: TPUMountService):
+    def handle(request: pb.TPUStatusRequest,
+               context: grpc.ServicerContext) -> pb.TPUStatusResponse:
+        from gpumounter_tpu.utils.errors import PodNotFoundError
+        try:
+            mount_type, chips = service.tpu_status(request.pod_name,
+                                                   request.namespace)
+        except PodNotFoundError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except TPUMounterError as e:
+            logger.exception("TPUStatus internal failure")
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        resp = pb.TPUStatusResponse(mount_type=mount_type.value)
+        for chip in chips:
+            entry = resp.chips.add(device_id=chip.device_id,
+                                   device_path=chip.device_path,
+                                   slave_pod=chip.slave_pod)
+            entry.busy_pids.extend(chip.busy_pids)
+        return resp
+    return handle
+
+
+# Workers are dialed by pod IP, which cannot appear in a pre-provisioned
+# cert's SANs; the client instead verifies against this fixed DNS name,
+# which the cert must carry (override with TPU_MOUNTER_TLS_SERVER_NAME).
+DEFAULT_TLS_SERVER_NAME = "tpu-mounter-worker"
+
+
+def load_tls_config(env: dict | None = None) -> "TlsConfig | None":
+    """TLS material from TPU_MOUNTER_TLS_{CERT,KEY,CA}_FILE env vars. The
+    reference dials workers with ``grpc.WithInsecure`` on the pod network
+    (cmd/GPUMounter-master/main.go:82 — SURVEY.md §7 lists TLS as a
+    required delta); with a CA set on the server, client certs are required
+    (mTLS). CA-only is valid for a client (server-auth TLS). A half-set
+    cert/key pair raises rather than silently downgrading to plaintext."""
+    import os
+    env = os.environ if env is None else env
+    cert = env.get("TPU_MOUNTER_TLS_CERT_FILE")
+    key = env.get("TPU_MOUNTER_TLS_KEY_FILE")
+    ca = env.get("TPU_MOUNTER_TLS_CA_FILE")
+    if not (cert or key or ca):
+        return None
+    if bool(cert) != bool(key):
+        raise ValueError(
+            "TPU_MOUNTER_TLS_CERT_FILE and TPU_MOUNTER_TLS_KEY_FILE must be "
+            "set together (refusing to silently run without TLS)")
+    return TlsConfig(cert_file=cert, key_file=key, ca_file=ca,
+                     server_name=env.get("TPU_MOUNTER_TLS_SERVER_NAME",
+                                         DEFAULT_TLS_SERVER_NAME))
+
+
+class TlsConfig:
+    def __init__(self, cert_file: str | None = None,
+                 key_file: str | None = None,
+                 ca_file: str | None = None,
+                 server_name: str = DEFAULT_TLS_SERVER_NAME):
+        self.cert_file = cert_file
+        self.key_file = key_file
+        self.ca_file = ca_file
+        self.server_name = server_name
+
+    def _read(self, path: str | None) -> bytes | None:
+        if not path:
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    def server_credentials(self) -> grpc.ServerCredentials:
+        if not (self.cert_file and self.key_file):
+            raise ValueError("server TLS requires cert and key files")
+        ca = self._read(self.ca_file)
+        return grpc.ssl_server_credentials(
+            [(self._read(self.key_file), self._read(self.cert_file))],
+            root_certificates=ca,
+            require_client_auth=ca is not None)
+
+    def channel_credentials(self) -> grpc.ChannelCredentials:
+        return grpc.ssl_channel_credentials(
+            root_certificates=self._read(self.ca_file),
+            private_key=self._read(self.key_file),
+            certificate_chain=self._read(self.cert_file))
+
+    def channel_options(self) -> list[tuple[str, str]]:
+        return [("grpc.ssl_target_name_override", self.server_name)]
 
 
 def build_server(service: TPUMountService,
                  port: int = consts.WORKER_GRPC_PORT,
                  address: str = "[::]",
-                 max_workers: int = 8) -> tuple[grpc.Server, int]:
+                 max_workers: int = 8,
+                 tls: TlsConfig | None = None) -> tuple[grpc.Server, int]:
     """Returns (server, bound_port); port 0 picks a free port (tests)."""
     server = grpc.server(
         concurrent.futures.ThreadPoolExecutor(max_workers=max_workers))
@@ -75,19 +181,35 @@ def build_server(service: TPUMountService,
             _remove_handler(service),
             request_deserializer=pb.RemoveTPURequest.FromString,
             response_serializer=pb.RemoveTPUResponse.SerializeToString),
+        "TPUStatus": grpc.unary_unary_rpc_method_handler(
+            _status_handler(service),
+            request_deserializer=pb.TPUStatusRequest.FromString,
+            response_serializer=pb.TPUStatusResponse.SerializeToString),
     })
     server.add_generic_rpc_handlers((handler,))
-    bound = server.add_insecure_port(f"{address}:{port}")
+    if tls is not None:
+        bound = server.add_secure_port(f"{address}:{port}",
+                                       tls.server_credentials())
+    else:
+        bound = server.add_insecure_port(f"{address}:{port}")
     return server, bound
 
 
 class WorkerClient:
-    """Typed client for the worker RPCs (used by the master and tests)."""
+    """Typed client for the worker RPCs (used by the master and tests).
+    ``request_id`` (settable per call) rides gRPC metadata as x-request-id
+    for cross-binary log correlation."""
 
-    def __init__(self, target: str, timeout_s: float = 180.0):
+    def __init__(self, target: str, timeout_s: float = 180.0,
+                 tls: TlsConfig | None = None):
         self.target = target
         self.timeout_s = timeout_s
-        self._channel = grpc.insecure_channel(target)
+        if tls is not None:
+            self._channel = grpc.secure_channel(
+                target, tls.channel_credentials(),
+                options=tls.channel_options())
+        else:
+            self._channel = grpc.insecure_channel(target)
         self._add = self._channel.unary_unary(
             f"/{SERVICE_NAME}/AddTPU",
             request_serializer=pb.AddTPURequest.SerializeToString,
@@ -96,21 +218,37 @@ class WorkerClient:
             f"/{SERVICE_NAME}/RemoveTPU",
             request_serializer=pb.RemoveTPURequest.SerializeToString,
             response_deserializer=pb.RemoveTPUResponse.FromString)
+        self._status = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/TPUStatus",
+            request_serializer=pb.TPUStatusRequest.SerializeToString,
+            response_deserializer=pb.TPUStatusResponse.FromString)
+
+    @staticmethod
+    def _metadata(request_id: str | None):
+        return (("x-request-id", request_id),) if request_id else None
 
     def add_tpu(self, pod_name: str, namespace: str, tpu_num: int,
-                is_entire_mount: bool) -> pb.AddTPUResponse:
+                is_entire_mount: bool,
+                request_id: str | None = None) -> pb.AddTPUResponse:
         return self._add(
             pb.AddTPURequest(pod_name=pod_name, namespace=namespace,
                              tpu_num=tpu_num,
                              is_entire_mount=is_entire_mount),
-            timeout=self.timeout_s)
+            timeout=self.timeout_s, metadata=self._metadata(request_id))
 
     def remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
-                   force: bool) -> pb.RemoveTPUResponse:
+                   force: bool,
+                   request_id: str | None = None) -> pb.RemoveTPUResponse:
         return self._remove(
             pb.RemoveTPURequest(pod_name=pod_name, namespace=namespace,
                                 uuids=uuids, force=force),
-            timeout=self.timeout_s)
+            timeout=self.timeout_s, metadata=self._metadata(request_id))
+
+    def tpu_status(self, pod_name: str, namespace: str,
+                   request_id: str | None = None) -> pb.TPUStatusResponse:
+        return self._status(
+            pb.TPUStatusRequest(pod_name=pod_name, namespace=namespace),
+            timeout=self.timeout_s, metadata=self._metadata(request_id))
 
     def close(self) -> None:
         self._channel.close()

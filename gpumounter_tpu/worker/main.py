@@ -24,7 +24,7 @@ from gpumounter_tpu.k8s.client import InClusterKubeClient
 from gpumounter_tpu.utils.config import Settings
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
-from gpumounter_tpu.worker.grpc_server import build_server
+from gpumounter_tpu.worker.grpc_server import build_server, load_tls_config
 from gpumounter_tpu.worker.service import TPUMountService
 
 logger = get_logger("worker.main")
@@ -91,7 +91,11 @@ def main() -> None:
     # the kubelet socket is unavailable) — the nodeSelector guarantees TPU
     # nodes, so a broken stack here is a deploy error worth crashing on.
     service = build_stack(settings)
-    server, port = build_server(service, settings.worker_grpc_port)
+    tls = load_tls_config()
+    if tls:
+        logger.info("worker gRPC TLS enabled (mTLS=%s)",
+                    bool(tls.ca_file))
+    server, port = build_server(service, settings.worker_grpc_port, tls=tls)
     server.start()
     _HealthHandler.ready = True
     logger.info("worker serving gRPC on :%d, health on :%d", port,

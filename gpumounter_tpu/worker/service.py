@@ -55,6 +55,14 @@ class RemoveOutcome:
     message: str = ""
 
 
+@dataclasses.dataclass
+class ChipStatus:
+    device_id: str
+    device_path: str
+    slave_pod: str            # "" when the chip came from the pod's own spec
+    busy_pids: list[int]
+
+
 class TPUMountService:
     """One per worker; owns the node-local orchestration."""
 
@@ -186,6 +194,29 @@ class TPUMountService:
         logger.info("RemoveTPU ok: %d chips off %s/%s (force=%s)",
                     len(chips), namespace, pod_name, force)
         return RemoveOutcome(consts.RemoveResult.SUCCESS)
+
+    # -- TPUStatus (observability; no reference analog — their check was a
+    # human running nvidia-smi, docs/guide/QuickStart.md:42-97) ---------------
+
+    def tpu_status(self, pod_name: str,
+                   namespace: str) -> tuple[consts.MountType,
+                                            list[ChipStatus]]:
+        """Raises PodNotFoundError for unknown pods (gRPC NOT_FOUND)."""
+        pod = self.kube.get_pod(namespace, pod_name)
+        chips = self.allocator.collector.get_pod_tpu_resources(pod_name,
+                                                               namespace)
+        mount_type = self.allocator.get_mount_type(pod_name)
+        prefix = pod_name + consts.SLAVE_POD_INFIX
+        out = []
+        for chip in chips:
+            held_by_slave = (chip.namespace == self.settings.pool_namespace
+                             and chip.pod_name.startswith(prefix))
+            out.append(ChipStatus(
+                device_id=chip.uuid,
+                device_path=chip.container_path,
+                slave_pod=chip.pod_name if held_by_slave else "",
+                busy_pids=self.mounter.pod_device_processes(pod, chip)))
+        return mount_type, out
 
     @staticmethod
     def _partially_covered_holders(chips: list[TPUChip], holders: list[str],

@@ -93,6 +93,29 @@ def test_pallas_ring_matches_full_attention():
                                atol=3e-5, rtol=3e-5)
 
 
+def test_ulysses_matches_full_attention():
+    from gpumounter_tpu.jaxcheck.ulysses import make_ulysses_attention
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("seq",))
+    q, k, v = make_qkv(jax.random.PRNGKey(6), b=2, t=128, h=8, d=32)
+    ref = full_attention(q, k, v)
+    out = make_ulysses_attention(mesh)(q, k, v)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_train_step_with_ulysses_attention():
+    mesh = model_lib.make_mesh(data=2, model=2)       # seq=2; heads 8 % 4 == 0
+    attn = model_lib.make_attention(mesh, TINY, impl="ulysses")
+    params = model_lib.init_params(jax.random.PRNGKey(0), TINY)
+    tokens = train_lib.make_batch(jax.random.PRNGKey(1), 4, 32, TINY.vocab)
+    logits_u = model_lib.forward(params, tokens, TINY, attn_fn=attn)
+    logits_r = model_lib.forward(
+        params, tokens, TINY,
+        attn_fn=model_lib.make_attention(mesh, TINY, impl="ring"))
+    np.testing.assert_allclose(np.asarray(logits_u), np.asarray(logits_r),
+                               atol=5e-4, rtol=5e-4)
+
+
 # -- model ---------------------------------------------------------------------
 
 def test_forward_shapes_and_finite():

@@ -1,0 +1,144 @@
+"""Worker TLS (mTLS round-trip with generated certs), the TPUStatus RPC +
+/tpustatus route, and request-id tracing."""
+
+import datetime
+import subprocess
+
+import grpc
+import pytest
+
+from gpumounter_tpu.worker.grpc_server import (TlsConfig, WorkerClient,
+                                               build_server, load_tls_config)
+
+from tests.helpers import LiveStack, WorkerRig
+
+
+def make_cert(tmp_path, name, san="tpu-mounter-worker"):
+    """Self-signed cert carrying the fixed worker SAN (pod IPs can't be in a
+    pre-provisioned cert, so the client verifies this DNS name instead)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    subject = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, san)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(subject).issuer_name(subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now)
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.DNSName(san)]), critical=False)
+            .sign(key, hashes.SHA256()))
+    cert_path = tmp_path / f"{name}.crt"
+    key_path = tmp_path / f"{name}.key"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()))
+    return str(cert_path), str(key_path)
+
+
+def test_tls_round_trip_dialing_by_ip(fake_host, tmp_path):
+    """The production scenario: master dials the worker by POD IP; the cert
+    carries only the fixed SAN, verified via ssl_target_name_override."""
+    cert, key = make_cert(tmp_path, "server")
+    rig = WorkerRig(fake_host)
+    tls_server = TlsConfig(cert_file=cert, key_file=key, ca_file=cert)
+    server, port = build_server(rig.service, port=0, address="127.0.0.1",
+                                tls=tls_server)
+    server.start()
+    try:
+        # mTLS: client presents the same cert (self-signed CA == cert),
+        # dials the bare IP, verifies against the default SAN override
+        client = WorkerClient(
+            f"127.0.0.1:{port}",
+            tls=TlsConfig(cert_file=cert, key_file=key, ca_file=cert))
+        resp = client.add_tpu("workload", "default", 1, False)
+        assert resp.result == 0
+        client.close()
+
+        # plaintext client against the TLS server must fail
+        plain = WorkerClient(f"127.0.0.1:{port}", timeout_s=3)
+        with pytest.raises(grpc.RpcError):
+            plain.add_tpu("workload", "default", 1, False)
+        plain.close()
+    finally:
+        server.stop(grace=0)
+
+
+def test_load_tls_config_rejects_partial_pair(tmp_path):
+    cert, key = make_cert(tmp_path, "x")
+    with pytest.raises(ValueError):
+        load_tls_config({"TPU_MOUNTER_TLS_CERT_FILE": cert})
+    with pytest.raises(ValueError):
+        load_tls_config({"TPU_MOUNTER_TLS_KEY_FILE": key})
+    # CA-only is valid (client-side server-auth TLS)...
+    cfg = load_tls_config({"TPU_MOUNTER_TLS_CA_FILE": cert})
+    cfg.channel_credentials()
+    # ...but cannot serve
+    with pytest.raises(ValueError):
+        cfg.server_credentials()
+
+
+def test_load_tls_config_from_env(tmp_path):
+    cert, key = make_cert(tmp_path, "w")
+    assert load_tls_config({}) is None
+    cfg = load_tls_config({"TPU_MOUNTER_TLS_CERT_FILE": cert,
+                           "TPU_MOUNTER_TLS_KEY_FILE": key})
+    assert cfg is not None and cfg.ca_file is None
+    cfg = load_tls_config({"TPU_MOUNTER_TLS_CERT_FILE": cert,
+                           "TPU_MOUNTER_TLS_KEY_FILE": key,
+                           "TPU_MOUNTER_TLS_CA_FILE": cert})
+    assert cfg.ca_file == cert
+    cfg.server_credentials()        # material parses
+    cfg.channel_credentials()
+
+
+@pytest.fixture
+def stack(fake_host):
+    s = LiveStack(WorkerRig(fake_host))
+    yield s
+    s.close()
+
+
+def test_status_route_reports_chips_and_busy(stack):
+    rig, gateway = stack.rig, stack.gateway
+    status, body = gateway.handle(
+        "GET", "/tpustatus/namespace/default/pod/workload")
+    assert status == 200
+    assert body["mount_type"] == "no-mount"
+    assert body["chips"] == []
+
+    _, added = gateway.handle(
+        "GET",
+        "/addtpu/namespace/default/pod/workload/tpu/2/isEntireMount/true")
+    rig.sim.enumerator.busy_pids = {"/dev/accel0": [rig.pid]}
+    status, body = gateway.handle(
+        "GET", "/tpustatus/namespace/default/pod/workload")
+    assert status == 200
+    assert body["mount_type"] == "entire-mount"
+    assert len(body["chips"]) == 2
+    by_id = {c["device_id"]: c for c in body["chips"]}
+    assert by_id["0"]["busy_pids"] == [rig.pid]
+    assert by_id["1"]["busy_pids"] == []
+    assert by_id["0"]["slave_pod"].startswith("workload-slave-pod-")
+
+
+def test_status_unknown_pod_404(stack):
+    status, body = stack.gateway.handle(
+        "GET", "/tpustatus/namespace/default/pod/ghost")
+    assert status == 404
+
+
+def test_request_id_echoed_and_unique(stack):
+    _, b1 = stack.gateway.handle("GET", "/healthz")
+    _, b2 = stack.gateway.handle("GET", "/healthz")
+    assert b1["request_id"] != b2["request_id"]
+    _, b3 = stack.gateway.handle(
+        "GET",
+        "/addtpu/namespace/default/pod/workload/tpu/1/isEntireMount/false")
+    assert len(b3["request_id"]) == 12

@@ -79,23 +79,28 @@ class TPUAllocator:
     # -- slave pod spec (ref allocator.go:190-235 newGPUSlavePod) --------------
 
     def new_slave_pod(self, owner: objects.Pod, tpu_num: int,
-                      entire: bool) -> objects.Pod:
+                      entire: bool, txn_id: str = "") -> objects.Pod:
         owner_name = objects.name(owner)
         pod_name = (owner_name + consts.SLAVE_POD_INFIX
                     + secrets.token_hex(3))
         mount_type = (consts.MountType.ENTIRE if entire
                       else consts.MountType.SINGLE)
+        labels = {
+            consts.SLAVE_POD_LABEL_KEY: consts.SLAVE_POD_LABEL_VALUE,
+            consts.OWNER_POD_LABEL_KEY: owner_name,
+            consts.OWNER_NAMESPACE_LABEL_KEY: objects.namespace(owner),
+            consts.OWNER_UID_LABEL_KEY: objects.uid(owner),
+            consts.MOUNT_TYPE_LABEL_KEY: mount_type.value,
+        }
+        if txn_id:
+            labels[consts.TXN_LABEL_KEY] = txn_id
         return {
             "apiVersion": "v1",
             "kind": "Pod",
             "metadata": {
                 "name": pod_name,
                 "namespace": self.settings.pool_namespace,
-                "labels": {
-                    consts.SLAVE_POD_LABEL_KEY: consts.SLAVE_POD_LABEL_VALUE,
-                    consts.OWNER_POD_LABEL_KEY: owner_name,
-                    consts.MOUNT_TYPE_LABEL_KEY: mount_type.value,
-                },
+                "labels": labels,
                 # GC with the owner (ref allocator.go:204-213). Cross-namespace
                 # ownerRefs are not honoured by the k8s GC, so this only takes
                 # effect when the pool namespace equals the owner's; the
@@ -138,7 +143,8 @@ class TPUAllocator:
 
     def get_available_tpus(
             self, owner: objects.Pod, total_tpus: int,
-            tpus_per_pod: int) -> tuple[list[TPUChip], list[str]]:
+            tpus_per_pod: int,
+            txn_id: str = "") -> tuple[list[TPUChip], list[str]]:
         """Allocate ``total_tpus`` chips on the owner's node via slave pods of
         ``tpus_per_pod`` chips each. Returns (chips, slave_pod_names).
 
@@ -152,7 +158,8 @@ class TPUAllocator:
         created: list[str] = []
         try:
             for _ in range(num_pods):
-                spec = self.new_slave_pod(owner, tpus_per_pod, entire)
+                spec = self.new_slave_pod(owner, tpus_per_pod, entire,
+                                          txn_id=txn_id)
                 self.kube.create_pod(self.settings.pool_namespace, spec)
                 created.append(objects.name(spec))
             self._wait_running(created)
@@ -235,11 +242,29 @@ class TPUAllocator:
         except PodNotFoundError:
             return None
 
+    # -- slave pod resolution --------------------------------------------------
+
+    def slave_pod_names(self, owner_name: str, owner_namespace: str,
+                        txn_id: str | None = None) -> set[str]:
+        """Names of slave pods owned by exactly (namespace, name), via the
+        labels stamped at creation. The reference matched by name *prefix*
+        only (collector.go:155-159), which conflates same-named owners in
+        different namespaces on one node. ``txn_id`` narrows to one slice
+        transaction's pods."""
+        selector = (f"{consts.OWNER_POD_LABEL_KEY}={owner_name},"
+                    f"{consts.OWNER_NAMESPACE_LABEL_KEY}={owner_namespace}")
+        if txn_id:
+            selector += f",{consts.TXN_LABEL_KEY}={txn_id}"
+        return {objects.name(p)
+                for p in self.kube.list_pods(self.settings.pool_namespace,
+                                             label_selector=selector)}
+
     # -- removal resolution (ref allocator.go:102-127 GetRemoveGPU) ------------
 
     def get_removable_tpus(
-            self, owner_name: str,
-            uuids: Iterable[str]) -> tuple[list[TPUChip], list[str]]:
+            self, owner_name: str, uuids: Iterable[str],
+            owner_namespace: str = "default",
+            txn_id: str | None = None) -> tuple[list[TPUChip], list[str]]:
         """Resolve which chips may be detached. Only chips held by this pod's
         slave pods are removable (allocator.go:113-120) — chips the pod got
         through its own spec came from kubelet and must not be touched.
@@ -247,14 +272,16 @@ class TPUAllocator:
         ``uuids`` may be any subset; empty means "all removable". Unknown or
         non-removable ids raise :class:`DeviceNotFoundError` (the reference
         silently returned nothing on any count mismatch,
-        allocator.go:122-124). Returns (chips, slave_pod_names_holding_them).
+        allocator.go:122-124). ``txn_id`` restricts to chips attached by one
+        slice transaction. Returns (chips, slave_pod_names_holding_them).
         """
+        slave_names = self.slave_pod_names(owner_name, owner_namespace,
+                                           txn_id)
         removable = {
             c.uuid: c
-            for c in self.collector.get_pod_tpu_resources(
-                owner_name, "")          # namespace only matters for own chips
+            for c in self.collector.get_pod_tpu_resources(owner_name, "")
             if c.namespace == self.settings.pool_namespace
-            and c.pod_name.startswith(owner_name + consts.SLAVE_POD_INFIX)}
+            and c.pod_name in slave_names}
         wanted = list(uuids) or list(removable)
         missing = [u for u in wanted if u not in removable]
         if missing:
@@ -304,7 +331,8 @@ class TPUAllocator:
 
     # -- mount type (ref allocator.go:159-187 GetMountType) --------------------
 
-    def get_mount_type(self, owner_name: str) -> consts.MountType:
+    def get_mount_type(self, owner_name: str,
+                       owner_namespace: str = "default") -> consts.MountType:
         """What kind of mount does this pod currently have? Read from the
         mount-type label stamped on its slave pods at creation (the reference
         guessed by comparing slave-pod count to chip count,
@@ -313,7 +341,9 @@ class TPUAllocator:
         try:
             slaves = self.kube.list_pods(
                 self.settings.pool_namespace,
-                label_selector=f"{consts.OWNER_POD_LABEL_KEY}={owner_name}")
+                label_selector=(
+                    f"{consts.OWNER_POD_LABEL_KEY}={owner_name},"
+                    f"{consts.OWNER_NAMESPACE_LABEL_KEY}={owner_namespace}"))
         except K8sApiError:
             return consts.MountType.UNKNOWN
         if not slaves:

@@ -117,6 +117,22 @@ class TPUCollector:
                     out.append(c)
             return out
 
+    def get_pod_tpu_resources_exact(
+            self, pod_name: str, namespace: str,
+            slave_names: set[str]) -> list[TPUChip]:
+        """Like :meth:`get_pod_tpu_resources`, but slave pods are given by
+        exact name (resolved from owner labels by the allocator) instead of
+        the name-prefix convention — immune to same-named owners in other
+        namespaces sharing the node."""
+        self.update_status()
+        with self._lock:
+            return [c for c in self._chips.values()
+                    if c.state is DeviceState.ALLOCATED
+                    and ((c.pod_name == pod_name
+                          and c.namespace == namespace)
+                         or (c.namespace == self.pool_namespace
+                             and c.pod_name in slave_names))]
+
     def get_slave_pod_names(self, pod_name: str) -> list[str]:
         """Distinct slave-pod names currently holding chips for this pod."""
         self.update_status()

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import dataclasses
+import uuid as uuid_mod
 
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.log import get_logger
@@ -60,59 +61,54 @@ class SliceCoordinator:
                request_id: str | None = None
                ) -> tuple[bool, list[PodResult], bool]:
         """Entire-mount ``tpus_per_host`` chips to every (namespace, pod).
-        Returns (ok, per-pod results, rollback_clean). On any failure the
-        transaction is rolled back:
+        Returns (ok, per-pod results, rollback_clean).
 
-        - SUCCESS hosts: detach exactly the device_ids this transaction
-          attached (earlier mounts on the pod must survive).
-        - ERROR hosts whose failure was transport-level (lost reply/timeout):
-          the worker may have attached chips we never learned about. Since a
-          slice attach is an entire-mount — and entire-mounts are only
-          permitted on pods with no existing mounts (util.go:207-226 policy)
-          — every slave-held chip on such a pod belongs to this transaction,
-          so a detach-all is safe and is attempted. Policy rejections
-          (FAILED_PRECONDITION) attached nothing and are skipped.
+        The whole transaction carries a txn id that workers stamp on the
+        slave pods they create. On any failure, EVERY pod gets a
+        txn-targeted detach — this is exactly right regardless of what we
+        observed per pod:
 
-        ``rollback_clean`` is False if any rollback detach itself failed
+        - attach succeeded (reply seen or lost in transit): its slave pods
+          carry the txn label and are removed; chips from other
+          mounts/transactions are untouched.
+        - attach never happened (policy rejection, PodNotFound, worker
+          down): no pod carries the txn label, the detach returns
+          TPU_NOT_FOUND, which counts as clean.
+
+        ``rollback_clean`` is False only if a rollback detach itself failed
         (chips may be leaked; the per-pod results say where to look).
         """
+        txn_id = "txn-" + uuid_mod.uuid4().hex[:12]
         results = self._fan_out(
             pods,
             lambda ns, name: self._attach_one(ns, name, tpus_per_host,
-                                              request_id))
+                                              request_id, txn_id))
         ok = all(r.result == "SUCCESS" for r in results)
         rollback_clean = True
         if not ok:
-            to_roll: list[tuple[str, str, list[str] | None]] = []
-            for r in results:
-                if r.result == "SUCCESS":
-                    to_roll.append((r.namespace, r.pod, r.device_ids))
-                elif (r.result == "ERROR"
-                      and "FAILED_PRECONDITION" not in r.message):
-                    to_roll.append((r.namespace, r.pod, None))  # detach all
-            if to_roll:
-                logger.warning("slice attach failed; rolling back %d hosts",
-                               len(to_roll))
-                uuid_map = {(ns, name): uuids for ns, name, uuids in to_roll}
-                rollback = self._fan_out(
-                    [(ns, name) for ns, name, _ in to_roll],
-                    lambda ns, name: self._detach_one(
-                        ns, name, force=True, uuids=uuid_map[(ns, name)],
-                        request_id=request_id))
-                for r in rollback:
-                    if r.result not in ("SUCCESS", "TPU_NOT_FOUND"):
-                        rollback_clean = False
-                        logger.error("slice rollback left %s/%s attached: %s",
-                                     r.namespace, r.pod, r.message)
+            logger.warning("slice %s attach failed; rolling back %d hosts",
+                           txn_id, len(pods))
+            rollback = self._fan_out(
+                pods,
+                lambda ns, name: self._detach_one(
+                    ns, name, force=True, txn_id=txn_id,
+                    request_id=request_id))
+            for r in rollback:
+                if r.result not in ("SUCCESS", "TPU_NOT_FOUND",
+                                    "POD_NOT_FOUND"):
+                    rollback_clean = False
+                    logger.error("slice rollback left %s/%s attached: %s",
+                                 r.namespace, r.pod, r.message)
         return ok, results, rollback_clean
 
     def _attach_one(self, namespace: str, pod: str, tpu_num: int,
-                    request_id: str | None = None) -> PodResult:
+                    request_id: str | None = None,
+                    txn_id: str = "") -> PodResult:
         try:
             resp = self.gateway._call_worker(
                 namespace, pod,
                 lambda w: w.add_tpu(pod, namespace, tpu_num, True,
-                                    request_id=request_id))
+                                    request_id=request_id, txn_id=txn_id))
             result = consts.AddResult(resp.result)
             out = PodResult(namespace, pod, result.name,
                             device_ids=list(resp.device_ids))
@@ -136,12 +132,14 @@ class SliceCoordinator:
 
     def _detach_one(self, namespace: str, pod: str, force: bool,
                     uuids: list[str] | None = None,
-                    request_id: str | None = None) -> PodResult:
+                    request_id: str | None = None,
+                    txn_id: str = "") -> PodResult:
         try:
             resp = self.gateway._call_worker(
                 namespace, pod,
                 lambda w: w.remove_tpu(pod, namespace, uuids or [], force,
-                                       request_id=request_id))
+                                       request_id=request_id,
+                                       txn_id=txn_id))
             result = consts.RemoveResult(resp.result)
             out = PodResult(namespace, pod, result.name)
         except Exception as e:

@@ -195,6 +195,7 @@ class WorkerRig:
         os.makedirs(os.path.join(fake_host.proc_root, str(pid)),
                     exist_ok=True)
 
+        self._actuator_kind = actuator
         if actuator == "recording":
             self.actuator = RecordingActuator()
         elif actuator == "procroot":
@@ -209,6 +210,24 @@ class WorkerRig:
                                       self.sim.settings)
         self.service = TPUMountService(self.allocator, self.mounter,
                                        self.sim.kube, self.sim.settings)
+
+    def provision_container(self, pod: objects.Pod,
+                            pid: int | None = None) -> str:
+        """Create the fixture cgroup dir + live PID for another pod's
+        container (the rig's own target pod is provisioned in __init__).
+        Returns the cgroup dir."""
+        pid = pid or (self.pid + 1 + len(os.listdir(self.host.proc_root)))
+        cid = objects.container_ids(pod)[0]
+        cgroup_dir = self.cgroups.container_dir(pod, cid)
+        os.makedirs(cgroup_dir, exist_ok=True)
+        with open(os.path.join(cgroup_dir, "cgroup.procs"), "w") as f:
+            f.write(f"{pid}\n")
+        os.makedirs(os.path.join(self.host.proc_root, str(pid)),
+                    exist_ok=True)
+        if self._actuator_kind == "procroot":
+            os.makedirs(os.path.join(self.host.proc_root, str(pid), "root",
+                                     "dev"), exist_ok=True)
+        return cgroup_dir
 
     def close(self) -> None:
         self.sim.close()

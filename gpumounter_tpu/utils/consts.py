@@ -46,6 +46,13 @@ SLAVE_POD_LABEL_VALUE = "tpu-pool"
 # (allocator.go:181-187, acknowledged TODO). We store it explicitly instead.
 MOUNT_TYPE_LABEL_KEY = "tpumounter.io/mount-type"
 OWNER_POD_LABEL_KEY = "tpumounter.io/owner-pod"
+OWNER_NAMESPACE_LABEL_KEY = "tpumounter.io/owner-namespace"
+# Owner UID: a same-named recreated owner must NOT adopt stale slave pods.
+OWNER_UID_LABEL_KEY = "tpumounter.io/owner-uid"
+# Stamped when the mount is part of a multi-host slice transaction, so a
+# rollback can target exactly the chips that transaction attached even when
+# the attach reply was lost.
+TXN_LABEL_KEY = "tpumounter.io/txn-id"
 SLAVE_POD_IMAGE = "registry.k8s.io/pause:3.9"
 
 # --- Environment variables (ref: CGROUP_DRIVER cgroup.go:78, GPU_POOL_NAMESPACE

@@ -44,7 +44,8 @@ def _add_handler(service: TPUMountService):
         try:
             outcome = service.add_tpu(request.pod_name, request.namespace,
                                       request.tpu_num,
-                                      request.is_entire_mount)
+                                      request.is_entire_mount,
+                                      txn_id=request.txn_id)
         except MountPolicyError as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         except TPUMounterError as e:
@@ -67,7 +68,8 @@ def _remove_handler(service: TPUMountService):
                     list(request.uuids), request.force)
         try:
             outcome = service.remove_tpu(request.pod_name, request.namespace,
-                                         list(request.uuids), request.force)
+                                         list(request.uuids), request.force,
+                                         txn_id=request.txn_id)
         except TPUMounterError as e:
             logger.exception("[rid=%s] RemoveTPU internal failure", rid)
             context.abort(grpc.StatusCode.INTERNAL, str(e))
@@ -229,19 +231,22 @@ class WorkerClient:
 
     def add_tpu(self, pod_name: str, namespace: str, tpu_num: int,
                 is_entire_mount: bool,
-                request_id: str | None = None) -> pb.AddTPUResponse:
+                request_id: str | None = None,
+                txn_id: str = "") -> pb.AddTPUResponse:
         return self._add(
             pb.AddTPURequest(pod_name=pod_name, namespace=namespace,
                              tpu_num=tpu_num,
-                             is_entire_mount=is_entire_mount),
+                             is_entire_mount=is_entire_mount,
+                             txn_id=txn_id),
             timeout=self.timeout_s, metadata=self._metadata(request_id))
 
     def remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
                    force: bool,
-                   request_id: str | None = None) -> pb.RemoveTPUResponse:
+                   request_id: str | None = None,
+                   txn_id: str = "") -> pb.RemoveTPUResponse:
         return self._remove(
             pb.RemoveTPURequest(pod_name=pod_name, namespace=namespace,
-                                uuids=uuids, force=force),
+                                uuids=uuids, force=force, txn_id=txn_id),
             timeout=self.timeout_s, metadata=self._metadata(request_id))
 
     def tpu_status(self, pod_name: str, namespace: str,

@@ -91,6 +91,8 @@ def main() -> None:
     # the kubelet socket is unavailable) — the nodeSelector guarantees TPU
     # nodes, so a broken stack here is a deploy error worth crashing on.
     service = build_stack(settings)
+    from gpumounter_tpu.worker.reconciler import OrphanReconciler
+    reconciler = OrphanReconciler(service.kube, settings).start()
     tls = load_tls_config()
     if tls:
         logger.info("worker gRPC TLS enabled (mTLS=%s)",
@@ -103,6 +105,7 @@ def main() -> None:
     try:
         server.wait_for_termination()
     finally:
+        reconciler.stop()
         health.shutdown()
 
 

@@ -1,0 +1,130 @@
+"""Orphaned-slave-pod reconciler.
+
+The reference GCs slave pods via an OwnerReference to the target pod
+(``allocator.go:204-213``) — but Kubernetes ignores cross-namespace owner
+references, and slave pods live in the pool namespace while targets live
+anywhere, so that GC silently never fires for the common case (the reference
+also shipped mismatched namespaces, SURVEY.md §8). Chips held by a slave pod
+whose owner died would stay allocated forever.
+
+This reconciler closes the leak: every interval, list this node's slave pods
+and delete any whose owner pod is gone or terminal (Succeeded/Failed). No
+actuation rollback is needed — the owner's container is gone, taking its
+cgroup and mount namespace with it; deleting the slave pod releases the
+scheduler accounting, which is the part that outlives the owner.
+
+State is re-derived from the cluster on every pass (owner labels stamped at
+creation + pod liveness), so the reconciler is restart-safe with no local
+persistence — the same ground-truth-re-derivation property SURVEY.md §5
+credits the reference's collector with.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from gpumounter_tpu.k8s import objects
+from gpumounter_tpu.k8s.client import KubeClient
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.config import Settings
+from gpumounter_tpu.utils.errors import K8sApiError, PodNotFoundError
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("worker.reconciler")
+
+_TERMINAL_PHASES = ("Succeeded", "Failed")
+
+
+class OrphanReconciler:
+    def __init__(self, kube: KubeClient, settings: Settings | None = None,
+                 interval_s: float = 30.0):
+        self.kube = kube
+        self.settings = settings or Settings()
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- one pass --------------------------------------------------------------
+
+    def _is_ours(self, slave: objects.Pod) -> bool:
+        """Restrict to this node's slave pods when NODE_NAME is set (each
+        DaemonSet worker owns its node; unset = single-node test rigs)."""
+        if not self.settings.node_name:
+            return True
+        selector = (slave.get("spec", {}).get("nodeSelector", {}) or {})
+        return selector.get("kubernetes.io/hostname") == \
+            self.settings.node_name
+
+    def _owner_alive(self, slave: objects.Pod) -> bool:
+        labels = objects.labels(slave)
+        owner = labels.get(consts.OWNER_POD_LABEL_KEY)
+        owner_ns = labels.get(consts.OWNER_NAMESPACE_LABEL_KEY)
+        if not owner or not owner_ns:
+            # pre-label-schema pod or hand-made: leave it alone
+            return True
+        try:
+            pod = self.kube.get_pod(owner_ns, owner)
+        except PodNotFoundError:
+            return False
+        # A same-named RECREATED owner (StatefulSet pattern) is not the pod
+        # these chips were mounted into — compare UIDs when stamped.
+        owner_uid = labels.get(consts.OWNER_UID_LABEL_KEY)
+        if owner_uid and objects.uid(pod) != owner_uid:
+            return False
+        return objects.phase(pod) not in _TERMINAL_PHASES
+
+    def scan_once(self) -> list[str]:
+        """Delete orphaned slave pods; returns their names."""
+        try:
+            slaves = self.kube.list_pods(
+                self.settings.pool_namespace,
+                label_selector=(f"{consts.SLAVE_POD_LABEL_KEY}="
+                                f"{consts.SLAVE_POD_LABEL_VALUE}"))
+        except K8sApiError as e:
+            logger.warning("reconcile list failed: %s", e)
+            return []
+        deleted = []
+        for slave in slaves:
+            if not self._is_ours(slave):
+                continue
+            try:
+                if self._owner_alive(slave):
+                    continue
+            except K8sApiError as e:
+                logger.warning("owner check for %s failed: %s",
+                               objects.name(slave), e)
+                continue        # apiserver blip ≠ dead owner
+            name = objects.name(slave)
+            logger.info("deleting orphaned slave pod %s (owner %s/%s gone)",
+                        name,
+                        objects.labels(slave).get(
+                            consts.OWNER_NAMESPACE_LABEL_KEY),
+                        objects.labels(slave).get(consts.OWNER_POD_LABEL_KEY))
+            try:
+                self.kube.delete_pod(self.settings.pool_namespace, name)
+                deleted.append(name)
+            except K8sApiError as e:
+                logger.warning("delete orphan %s failed: %s", name, e)
+        return deleted
+
+    # -- background loop -------------------------------------------------------
+
+    def start(self) -> "OrphanReconciler":
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="orphan-reconciler")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scan_once()
+            except Exception:
+                logger.exception("reconcile pass failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -76,15 +76,15 @@ class TPUMountService:
     # -- AddTPU (ref server.go:35-100) -----------------------------------------
 
     def add_tpu(self, pod_name: str, namespace: str, tpu_num: int,
-                is_entire_mount: bool) -> AddOutcome:
+                is_entire_mount: bool, txn_id: str = "") -> AddOutcome:
         with REGISTRY.attach_latency.time():
             outcome = self._add_tpu(pod_name, namespace, tpu_num,
-                                    is_entire_mount)
+                                    is_entire_mount, txn_id)
         REGISTRY.attach_results.inc(result=outcome.result.name)
         return outcome
 
     def _add_tpu(self, pod_name: str, namespace: str, tpu_num: int,
-                 is_entire_mount: bool) -> AddOutcome:
+                 is_entire_mount: bool, txn_id: str = "") -> AddOutcome:
         if tpu_num <= 0:
             raise MountPolicyError(f"tpu_num must be >= 1, got {tpu_num}")
         try:
@@ -99,7 +99,7 @@ class TPUMountService:
                 message=f"pod {namespace}/{pod_name} is "
                         f"{objects.phase(pod) or 'unknown'}, not Running")
 
-        current = self.allocator.get_mount_type(pod_name)
+        current = self.allocator.get_mount_type(pod_name, namespace)
         if not can_mount(current, is_entire_mount):
             raise MountPolicyError(
                 f"pod {namespace}/{pod_name} has mount type {current.value}; "
@@ -112,7 +112,7 @@ class TPUMountService:
         per_pod = tpu_num if is_entire_mount else 1
         try:
             chips, slaves = self.allocator.get_available_tpus(
-                pod, tpu_num, per_pod)
+                pod, tpu_num, per_pod, txn_id=txn_id)
         except InsufficientTPUError as e:
             return AddOutcome(consts.AddResult.INSUFFICIENT_TPU,
                               message=str(e))
@@ -120,8 +120,9 @@ class TPUMountService:
             return AddOutcome(consts.AddResult.INSUFFICIENT_TPU,
                               message=f"allocation timed out: {e}")
 
-        all_after = self.allocator.collector.get_pod_tpu_resources(
-            pod_name, namespace)
+        all_after = self.allocator.collector.get_pod_tpu_resources_exact(
+            pod_name, namespace,
+            self.allocator.slave_pod_names(pod_name, namespace))
         try:
             self.mounter.mount_chips(pod, chips, all_after)
         except TPUMounterError as e:
@@ -144,14 +145,15 @@ class TPUMountService:
     # -- RemoveTPU (ref server.go:102-180) -------------------------------------
 
     def remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
-                   force: bool) -> RemoveOutcome:
+                   force: bool, txn_id: str = "") -> RemoveOutcome:
         with REGISTRY.detach_latency.time():
-            outcome = self._remove_tpu(pod_name, namespace, uuids, force)
+            outcome = self._remove_tpu(pod_name, namespace, uuids, force,
+                                       txn_id)
         REGISTRY.detach_results.inc(result=outcome.result.name)
         return outcome
 
     def _remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
-                    force: bool) -> RemoveOutcome:
+                    force: bool, txn_id: str = "") -> RemoveOutcome:
         try:
             pod = self.kube.get_pod(namespace, pod_name)
         except PodNotFoundError:
@@ -160,8 +162,9 @@ class TPUMountService:
                 message=f"pod {namespace}/{pod_name} not found")
 
         try:
-            chips, holders = self.allocator.get_removable_tpus(pod_name,
-                                                               uuids)
+            chips, holders = self.allocator.get_removable_tpus(
+                pod_name, uuids, owner_namespace=namespace,
+                txn_id=txn_id or None)
         except DeviceNotFoundError as e:
             return RemoveOutcome(consts.RemoveResult.TPU_NOT_FOUND,
                                  message=str(e))
@@ -170,8 +173,9 @@ class TPUMountService:
                 consts.RemoveResult.TPU_NOT_FOUND,
                 message=f"no removable chips on {namespace}/{pod_name}")
 
-        all_chips = self.allocator.collector.get_pod_tpu_resources(
-            pod_name, namespace)
+        all_chips = self.allocator.collector.get_pod_tpu_resources_exact(
+            pod_name, namespace,
+            self.allocator.slave_pod_names(pod_name, namespace))
 
         # Whole-slave-pod granularity: removing part of a slave pod's chips
         # would desync scheduler accounting (see module docstring).
@@ -203,14 +207,14 @@ class TPUMountService:
                                             list[ChipStatus]]:
         """Raises PodNotFoundError for unknown pods (gRPC NOT_FOUND)."""
         pod = self.kube.get_pod(namespace, pod_name)
-        chips = self.allocator.collector.get_pod_tpu_resources(pod_name,
-                                                               namespace)
-        mount_type = self.allocator.get_mount_type(pod_name)
-        prefix = pod_name + consts.SLAVE_POD_INFIX
+        mount_type = self.allocator.get_mount_type(pod_name, namespace)
+        slave_names = self.allocator.slave_pod_names(pod_name, namespace)
+        chips = self.allocator.collector.get_pod_tpu_resources_exact(
+            pod_name, namespace, slave_names)
         out = []
         for chip in chips:
             held_by_slave = (chip.namespace == self.settings.pool_namespace
-                             and chip.pod_name.startswith(prefix))
+                             and chip.pod_name in slave_names)
             out.append(ChipStatus(
                 device_id=chip.uuid,
                 device_path=chip.container_path,

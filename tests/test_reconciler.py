@@ -1,0 +1,171 @@
+"""Orphan reconciliation + worker-restart recovery: state is re-derived from
+the cluster (kubelet listing + slave labels), never from worker memory —
+SURVEY.md §5's recoverability property, made explicit and tested."""
+
+import time
+
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.worker.reconciler import OrphanReconciler
+from gpumounter_tpu.worker.service import TPUMountService
+
+from tests.helpers import WorkerRig
+
+
+def test_orphan_deleted_when_owner_gone(fake_host):
+    rig = WorkerRig(fake_host)
+    rig.service.add_tpu("workload", "default", 2, False)
+    assert len(rig.sim.slave_pods()) == 2
+
+    rig.sim.kube.delete_pod("default", "workload")
+    reconciler = OrphanReconciler(rig.sim.kube, rig.sim.settings)
+    deleted = reconciler.scan_once()
+    assert len(deleted) == 2
+    assert rig.sim.slave_pods() == []
+    assert rig.sim.podresources.assignments == {}    # chips released
+
+
+def test_orphan_deleted_when_owner_terminal(fake_host):
+    rig = WorkerRig(fake_host)
+    rig.service.add_tpu("workload", "default", 1, True)
+    rig.sim.kube.set_pod_status("default", "workload", phase="Succeeded")
+    deleted = OrphanReconciler(rig.sim.kube, rig.sim.settings).scan_once()
+    assert len(deleted) == 1
+    assert rig.sim.slave_pods() == []
+
+
+def test_live_owner_keeps_slaves(fake_host):
+    rig = WorkerRig(fake_host)
+    rig.service.add_tpu("workload", "default", 2, False)
+    deleted = OrphanReconciler(rig.sim.kube, rig.sim.settings).scan_once()
+    assert deleted == []
+    assert len(rig.sim.slave_pods()) == 2
+
+
+def test_other_nodes_slaves_untouched(fake_host):
+    rig = WorkerRig(fake_host)
+    rig.service.add_tpu("workload", "default", 1, False)
+    rig.sim.kube.delete_pod("default", "workload")
+    # this worker believes it runs on another node
+    rig.sim.settings.node_name = "node-elsewhere"
+    reconciler = OrphanReconciler(rig.sim.kube, rig.sim.settings)
+    assert reconciler.scan_once() == []
+    assert len(rig.sim.slave_pods()) == 1
+    # the node's own worker would clean it
+    rig.sim.settings.node_name = "node-a"
+    assert len(reconciler.scan_once()) == 1
+
+
+def test_unlabelled_pool_pods_left_alone(fake_host):
+    rig = WorkerRig(fake_host)
+    rig.sim.kube.put_pod({
+        "metadata": {"name": "hand-made", "namespace":
+                     rig.sim.settings.pool_namespace,
+                     "labels": {consts.SLAVE_POD_LABEL_KEY:
+                                consts.SLAVE_POD_LABEL_VALUE}},
+        "spec": {}, "status": {"phase": "Running"},
+    })
+    assert OrphanReconciler(rig.sim.kube, rig.sim.settings).scan_once() == []
+
+
+def test_background_loop_runs(fake_host):
+    rig = WorkerRig(fake_host)
+    rig.service.add_tpu("workload", "default", 1, False)
+    rig.sim.kube.delete_pod("default", "workload")
+    reconciler = OrphanReconciler(rig.sim.kube, rig.sim.settings,
+                                  interval_s=0.05).start()
+    try:
+        deadline = time.time() + 3
+        while time.time() < deadline and rig.sim.slave_pods():
+            time.sleep(0.02)
+        assert rig.sim.slave_pods() == []
+    finally:
+        reconciler.stop()
+
+
+def test_recreated_owner_does_not_adopt_stale_slaves(fake_host):
+    """StatefulSet pattern: owner dies and is recreated under the same name
+    with a new UID — the old slave pods are still orphans."""
+    rig = WorkerRig(fake_host)
+    rig.service.add_tpu("workload", "default", 1, False)
+    rig.sim.kube.delete_pod("default", "workload")
+    # recreated immediately with a fresh UID
+    from gpumounter_tpu.testing.sim import make_target_pod
+    reborn = make_target_pod(uid="uid-reborn")
+    rig.sim.kube.put_pod(reborn)
+    rig.provision_container(reborn)
+    deleted = OrphanReconciler(rig.sim.kube, rig.sim.settings).scan_once()
+    assert len(deleted) == 1
+    assert rig.sim.slave_pods() == []
+    # and the reborn pod can mount fresh
+    out = rig.service.add_tpu("workload", "default", 1, True)
+    assert out.result is consts.AddResult.SUCCESS
+
+
+def test_same_pod_name_other_namespace_not_conflated(fake_host):
+    """default/workload and team-b/workload share the node; team-b's mount
+    must be invisible to default's mount-type/status/removal resolution."""
+    rig = WorkerRig(fake_host)
+    team_b = rig.sim.add_target_pod(namespace="team-b", uid="uid-team-b")
+    rig.provision_container(team_b)
+    assert rig.service.add_tpu("workload", "team-b", 2, True).result is \
+        consts.AddResult.SUCCESS
+
+    # default/workload sees no mount and can entire-mount the rest
+    assert rig.service.tpu_status("workload", "default")[0] is \
+        consts.MountType.NONE
+    out = rig.service.remove_tpu("workload", "default", [], False)
+    assert out.result is consts.RemoveResult.TPU_NOT_FOUND
+    assert rig.service.add_tpu("workload", "default", 2, True).result is \
+        consts.AddResult.SUCCESS
+    # each namespace's status shows exactly its own chips
+    _, chips_default = rig.service.tpu_status("workload", "default")
+    _, chips_teamb = rig.service.tpu_status("workload", "team-b")
+    assert len(chips_default) == 2 and len(chips_teamb) == 2
+    assert {c.device_id for c in chips_default}.isdisjoint(
+        {c.device_id for c in chips_teamb})
+
+
+def test_txn_scoped_removal(fake_host):
+    """remove_tpu(txn_id=...) touches only that transaction's chips."""
+    rig = WorkerRig(fake_host)
+    rig.service.add_tpu("workload", "default", 1, False)            # no txn
+    rig.service.add_tpu("workload", "default", 1, False,
+                        txn_id="txn-abc")
+    out = rig.service.remove_tpu("workload", "default", [], False,
+                                 txn_id="txn-abc")
+    assert out.result is consts.RemoveResult.SUCCESS
+    # the non-txn mount survives
+    mount_type, chips = rig.service.tpu_status("workload", "default")
+    assert mount_type is consts.MountType.SINGLE
+    assert len(chips) == 1
+    # unknown txn is an idempotent no-op
+    out = rig.service.remove_tpu("workload", "default", [], False,
+                                 txn_id="txn-ghost")
+    assert out.result is consts.RemoveResult.TPU_NOT_FOUND
+
+
+def test_worker_restart_can_detach_previous_workers_mounts(fake_host):
+    """A NEW worker stack (fresh service objects, same cluster/host state)
+    must be able to detach chips a previous worker attached — nothing about
+    a mount may live only in worker memory."""
+    rig = WorkerRig(fake_host)
+    added = rig.service.add_tpu("workload", "default", 2, False)
+    assert added.result is consts.AddResult.SUCCESS
+
+    # "restart": rebuild allocator/mounter/service from scratch over the
+    # same simulated cluster and host tree
+    from gpumounter_tpu.allocator import TPUAllocator
+    from gpumounter_tpu.actuation.mount import TPUMounter
+    fresh_allocator = TPUAllocator(rig.sim.collector, rig.sim.kube,
+                                   rig.sim.settings)
+    fresh_mounter = TPUMounter(rig.cgroups, rig.actuator,
+                               rig.sim.enumerator, rig.host)
+    fresh_service = TPUMountService(fresh_allocator, fresh_mounter,
+                                    rig.sim.kube, rig.sim.settings)
+
+    assert fresh_service.tpu_status("workload", "default")[0] is \
+        consts.MountType.SINGLE
+    out = fresh_service.remove_tpu("workload", "default",
+                                   [c.uuid for c in added.chips], False)
+    assert out.result is consts.RemoveResult.SUCCESS
+    assert rig.sim.slave_pods() == []

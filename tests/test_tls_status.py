@@ -2,7 +2,6 @@
 /tpustatus route, and request-id tracing."""
 
 import datetime
-import subprocess
 
 import grpc
 import pytest

@@ -1,25 +1,38 @@
-"""Benchmark: hot-attach latency through the full control plane.
+"""Benchmark: hot-attach latency through the full control plane, plus a
+real-chip JAX metric when TPU hardware is present.
 
-Drives the complete AddTPU/RemoveTPU path — HTTP master gateway → gRPC
-worker → allocator (slave pods through a scripted scheduler) → real cgroup-v1
-device-permission writes + device-node actuation on a fixture host tree, with
-the collector reading a real gRPC unix-socket kubelet — and reports the p50
-attach latency for a 4-chip entire-mount.
+Control-plane measurement drives the complete AddTPU/RemoveTPU path — HTTP
+master gateway → gRPC worker → allocator (slave pods through a scripted
+scheduler) → real cgroup-v1 device-permission writes + device-node actuation
+on a fixture host tree, with the collector reading a real gRPC unix-socket
+kubelet. Two configurations are measured:
 
-Baseline: the north-star target is < 3 s p50 for a 4-chip host attach
-(BASELINE.json; the reference publishes no numbers — BASELINE.md). The
-dominant real-world cost the reference pays is its unthrottled slave-pod
-poll loop (allocator.go:237-283); this framework's watch-based allocator is
-the component under test here. ``vs_baseline`` is target/measured (>1 ⇒
-faster than target).
+- **overhead**: scheduler delay 0 — the framework's own cost per attach
+  (every socket/file real, the cluster instantaneous);
+- **e2e**: a 1.0 s injected scheduler+device-plugin delay per slave pod —
+  the realistic dominant cost the reference pays unthrottled-polling for
+  (``allocator.go:237-283``); our watch-based allocator should add only
+  the overhead number on top of it.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The headline metric is the **e2e p50** (honest, delay included); p99 and
+the bare overhead are reported alongside. The reference publishes no
+numbers (BASELINE.md) — the target is the BASELINE.json north star: < 3 s
+p50 for a 4-chip entire-mount.
+
+When a real TPU backend initialises (the bench host's chip), the JAX
+selftest (:mod:`gpumounter_tpu.jaxcheck.tpu_selftest`) runs in a subprocess
+and its hardware evidence — train-step ms on the chip, pallas-vs-oracle
+parity error, backend re-init time — is embedded under ``"tpu"``.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
+import shutil
 import statistics
 import sys
 import tempfile
@@ -29,11 +42,11 @@ import urllib.request
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_P50_S = 3.0
-CYCLES = 25
 CHIPS = 4
+SCHED_DELAY_S = 1.0     # injected scheduler+kubelet cost for the e2e config
 
 
-def main() -> None:
+def measure_attach_cycle(schedule_delay_s: float, cycles: int) -> list[float]:
     from gpumounter_tpu.testing.sim import LiveStack, WorkerRig
     from gpumounter_tpu.utils.config import HostPaths
 
@@ -46,7 +59,8 @@ def main() -> None:
         os.makedirs(d)
 
     rig = WorkerRig(host, n_chips=CHIPS, actuator="procroot",
-                    use_kubelet_socket=True)
+                    use_kubelet_socket=True,
+                    schedule_delay_s=schedule_delay_s)
     stack = LiveStack(rig)
     attach = (f"{stack.base}/addtpu/namespace/default/pod/workload"
               f"/tpu/{CHIPS}/isEntireMount/true")
@@ -54,7 +68,7 @@ def main() -> None:
               "/force/false")
     try:
         latencies = []
-        for _ in range(CYCLES):
+        for _ in range(cycles):
             t0 = time.monotonic()
             with urllib.request.urlopen(attach) as resp:
                 body = json.loads(resp.read())
@@ -66,16 +80,58 @@ def main() -> None:
                 method="POST")
             with urllib.request.urlopen(req) as resp:
                 assert json.loads(resp.read())["result"] == "SUCCESS"
+        return latencies
     finally:
         stack.close()
+        shutil.rmtree(root, ignore_errors=True)
 
-    p50 = statistics.median(latencies)
-    print(json.dumps({
-        "metric": "hot_attach_p50_latency_4chip_entire_mount",
+
+def tpu_metrics() -> dict | None:
+    """Real-chip selftest metrics. None means no TPU backend on this host;
+    a hung/crashed selftest is reported as {"ok": false, "error": ...} so
+    hardware *failure* is never conflated with hardware *absence*."""
+    from gpumounter_tpu.jaxcheck import tpu_selftest
+    rc, report, error = tpu_selftest.run_in_subprocess()
+    if rc == tpu_selftest.EXIT_NO_TPU:
+        return None
+    if report is None:
+        return {"ok": False, "error": error}
+    out = {"ok": report.get("ok", False),
+           "backend": report.get("devices", {}).get("backend"),
+           "device_count": report.get("devices", {}).get("device_count")}
+    if isinstance(report.get("training"), dict):
+        out["train_step_ms"] = report["training"].get("step_ms")
+        out["final_loss"] = report["training"].get("final_loss")
+    if isinstance(report.get("pallas_parity"), dict):
+        out["pallas_err_vs_oracle"] = \
+            report["pallas_parity"].get("err_pallas_vs_oracle")
+    if isinstance(report.get("backend_reinit"), dict):
+        out["backend_reinit_s"] = report["backend_reinit"].get("reinit_s")
+    return out
+
+
+def main() -> None:
+    overhead = measure_attach_cycle(0.0, cycles=25)
+    e2e = measure_attach_cycle(SCHED_DELAY_S, cycles=25)
+    e2e_sorted = sorted(e2e)
+    p50 = statistics.median(e2e)
+    # nearest-rank p99 (== max at this sample size; honest about basis via
+    # the "cycles" field)
+    p99 = e2e_sorted[math.ceil(0.99 * len(e2e_sorted)) - 1]
+    result = {
+        "metric": "hot_attach_e2e_p50_latency_4chip_entire_mount",
         "value": round(p50, 4),
         "unit": "s",
-        "vs_baseline": round(BASELINE_P50_S / p50, 1),
-    }))
+        "vs_baseline": round(BASELINE_P50_S / p50, 2),
+        "e2e_p99_s": round(p99, 4),
+        "overhead_p50_s": round(statistics.median(overhead), 4),
+        "injected_schedule_delay_s": SCHED_DELAY_S,
+        "cycles": {"overhead": len(overhead), "e2e": len(e2e)},
+    }
+    tpu = tpu_metrics()
+    if tpu is not None:
+        result["tpu"] = tpu
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -113,9 +113,14 @@ def validate_collectives(n_devices: int | None = None) -> dict[str, Any]:
             "ppermute_ok": ppermute_ok, "ok": allreduce_ok and ppermute_ok}
 
 
-def validate_training(n_steps: int = 4) -> dict[str, Any]:
+def validate_training(n_steps: int = 4,
+                      timed_steps: int = 0) -> dict[str, Any]:
     """Run the flagship sharded train step over all devices; loss must be
-    finite and decreasing — compute is real, not just enumerable."""
+    finite and decreasing — compute is real, not just enumerable.
+
+    ``timed_steps`` > 0 additionally times that many post-compile steps
+    (synchronised via ``block_until_ready``) and reports ``step_ms`` — the
+    real-chip bench metric."""
     from gpumounter_tpu.jaxcheck import model as model_lib
     from gpumounter_tpu.jaxcheck import train as train_lib
 
@@ -137,9 +142,20 @@ def validate_training(n_steps: int = 4) -> dict[str, Any]:
     final_loss = float(loss)
     elapsed = time.monotonic() - t0
     ok = (np.isfinite(final_loss) and final_loss < first_loss)
-    return {"mesh": dict(mesh.shape) if mesh else None,
-            "first_loss": first_loss, "final_loss": final_loss,
-            "steps": n_steps, "elapsed_s": round(elapsed, 3), "ok": bool(ok)}
+    report = {"mesh": dict(mesh.shape) if mesh else None,
+              "first_loss": first_loss, "final_loss": final_loss,
+              "steps": n_steps, "elapsed_s": round(elapsed, 3),
+              "ok": bool(ok)}
+    if timed_steps > 0:
+        jax.block_until_ready(loss)     # everything above is compiled+done
+        t0 = time.perf_counter()
+        for _ in range(timed_steps):
+            state, loss = step(state, tokens)
+        jax.block_until_ready(loss)
+        step_ms = (time.perf_counter() - t0) / timed_steps * 1e3
+        report["step_ms"] = round(step_ms, 3)
+        report["ok"] = bool(report["ok"] and np.isfinite(step_ms))
+    return report
 
 
 def run_probe(expected: int | None = None,

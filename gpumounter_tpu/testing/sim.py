@@ -166,7 +166,7 @@ class WorkerRig:
 
     def __init__(self, fake_host, n_chips=4, pid=4242, actuator="recording",
                  use_kubelet_socket=False, node="node-a",
-                 pod_name="workload"):
+                 pod_name="workload", schedule_delay_s=0.0):
         from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
         from gpumounter_tpu.actuation.mount import TPUMounter
         from gpumounter_tpu.actuation.nsenter import (ProcRootActuator,
@@ -175,7 +175,7 @@ class WorkerRig:
         from gpumounter_tpu.worker.service import TPUMountService
 
         self.sim = ClusterSim(
-            n_chips=n_chips, node=node,
+            n_chips=n_chips, node=node, schedule_delay_s=schedule_delay_s,
             kubelet_socket_path=(fake_host.kubelet_socket
                                  if use_kubelet_socket else None))
         self.sim.settings.host = fake_host

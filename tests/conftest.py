@@ -8,6 +8,13 @@ don't touch JAX at all.
 import os
 import sys
 
+# Stash the pre-pin values so TPU-gated tests (test_tpu_hardware.py) can
+# launch subprocesses with the host's real JAX environment restored.
+os.environ.setdefault("GPUMOUNTER_ORIG_JAX_PLATFORMS",
+                      os.environ.get("JAX_PLATFORMS", ""))
+os.environ.setdefault("GPUMOUNTER_ORIG_XLA_FLAGS",
+                      os.environ.get("XLA_FLAGS", ""))
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

@@ -33,6 +33,7 @@ import secrets
 import time
 from collections.abc import Iterable
 
+from gpumounter_tpu.allocator import topology
 from gpumounter_tpu.collector.collector import TPUCollector
 from gpumounter_tpu.device.model import TPUChip
 from gpumounter_tpu.k8s import objects
@@ -79,7 +80,9 @@ class TPUAllocator:
     # -- slave pod spec (ref allocator.go:190-235 newGPUSlavePod) --------------
 
     def new_slave_pod(self, owner: objects.Pod, tpu_num: int,
-                      entire: bool, txn_id: str = "") -> objects.Pod:
+                      entire: bool, txn_id: str = "",
+                      extra_labels: dict[str, str] | None = None
+                      ) -> objects.Pod:
         owner_name = objects.name(owner)
         pod_name = (owner_name + consts.SLAVE_POD_INFIX
                     + secrets.token_hex(3))
@@ -92,6 +95,7 @@ class TPUAllocator:
             consts.OWNER_UID_LABEL_KEY: objects.uid(owner),
             consts.MOUNT_TYPE_LABEL_KEY: mount_type.value,
         }
+        labels.update(extra_labels or {})
         if txn_id:
             labels[consts.TXN_LABEL_KEY] = txn_id
         return {
@@ -154,12 +158,21 @@ class TPUAllocator:
         allocator.go:66-74).
         """
         entire = tpus_per_pod > 1
+        # Topology-aware validation (SURVEY.md §7 hard part 3): an entire
+        # mount must form a valid ICI group on the owner's node. Raises
+        # TopologyError (→ FAILED_PRECONDITION → 412) BEFORE any slave pod
+        # exists; nodes without TPU labels are unconstrained.
+        topo = self.node_topology_of(owner)
+        if entire:
+            topology.validate_entire_mount(topo, tpus_per_pod)
+        topo_labels = topo.slave_pod_labels() if topo else {}
         num_pods = math.ceil(total_tpus / tpus_per_pod)
         created: list[str] = []
         try:
             for _ in range(num_pods):
                 spec = self.new_slave_pod(owner, tpus_per_pod, entire,
-                                          txn_id=txn_id)
+                                          txn_id=txn_id,
+                                          extra_labels=topo_labels)
                 self.kube.create_pod(self.settings.pool_namespace, spec)
                 created.append(objects.name(spec))
             self._wait_running(created)
@@ -181,10 +194,30 @@ class TPUAllocator:
                     f"slave pod {name} is Running but kubelet reports no "
                     f"{self.settings.resource_name} devices for it")
             chips.extend(got)
+        if topo:
+            for chip in chips:
+                chip.accelerator = topo.accelerator
+                chip.topology = topo.topology
         logger.info("allocated %d chips via %d slave pods: %s",
                     len(chips), len(created),
                     [c.uuid for c in chips])
         return chips, created
+
+    def node_topology_of(self, owner: objects.Pod) -> "topology.NodeTopology | None":
+        """The owner's node's advertised TPU topology; None when the node
+        has no TPU labels or cannot be read (a node GET failure must not
+        take down allocation on non-GKE/test clusters — it only disables
+        topology enforcement, and says so in the log)."""
+        node_name = objects.node_name(owner)
+        if not node_name:
+            return None
+        try:
+            node = self.kube.get_node(node_name)
+        except K8sApiError as e:
+            logger.info("node %s unreadable (%s); topology enforcement off",
+                        node_name, e)
+            return None
+        return topology.node_topology(node)
 
     # Watch streams start at "now" on a real apiserver (no resourceVersion is
     # requested), so state changes can land between a get-sweep and the watch

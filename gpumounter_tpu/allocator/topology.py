@@ -1,0 +1,129 @@
+"""Topology-aware TPU allocation (SURVEY.md §7 hard part 3).
+
+The reference's ``isEntireMount`` batches N arbitrary GPUs into one slave pod
+(``pkg/server/gpu-mount/server.go:62-66``); GPUs are interchangeable, so any
+N works. TPU chips are NOT interchangeable: they sit on an ICI mesh whose
+shape GKE advertises through node labels
+(``cloud.google.com/gke-tpu-accelerator``, ``cloud.google.com/gke-tpu-topology``),
+and the device plugin allocates in host-aligned groups. A 3-chip "entire"
+mount of a 4-chip v5e host would schedule but yield chips that cannot form a
+usable ICI mesh — so entire-mount requests are validated here against the
+node's advertised topology *before* any slave pod is created.
+
+Rules (matching GKE's own allocation granularity):
+
+- **multi-host slice nodes** (topology spans more than one host): the device
+  plugin only hands out whole hosts — ``tpu_num`` must equal the host's chip
+  count exactly.
+- **single-host nodes**: sub-host groups are allowed when they match a valid
+  sub-mesh — ``tpu_num`` must divide the host chip count and be a power of
+  two (v5e sub-host topologies are 1x1, 2x2, 2x4, ...).
+- nodes without TPU labels (non-GKE, CPU test nodes, fake clusters) are not
+  constrained — behaviour degrades to the reference's count-only semantics.
+
+``chips_per_host`` comes from the node's allocatable ``google.com/tpu`` —
+ground truth from the device plugin, not inferred from machine-type tables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+from gpumounter_tpu.k8s import objects
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.errors import TopologyError
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("allocator.topology")
+
+Node = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeTopology:
+    """What one node advertises about its TPU slice."""
+
+    accelerator: str        # e.g. tpu-v5-lite-podslice
+    topology: str           # e.g. "2x4" / "2x2x2"
+    chips_per_host: int     # allocatable google.com/tpu on this node
+    total_chips: int        # product of the topology dims (whole slice)
+
+    @property
+    def num_hosts(self) -> int:
+        if self.chips_per_host <= 0:
+            return 0
+        return max(1, self.total_chips // self.chips_per_host)
+
+    @property
+    def multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+    def slave_pod_labels(self) -> dict[str, str]:
+        """Labels stamped on slave pods so a mount's topology is readable
+        from the pool namespace without a node round-trip."""
+        return {
+            consts.CHIP_TOPOLOGY_LABEL_KEY: self.topology,
+            consts.CHIP_ACCELERATOR_LABEL_KEY: self.accelerator,
+        }
+
+
+def parse_topology_product(topology: str) -> int:
+    """``"2x4"`` → 8, ``"2x2x2"`` → 8; 0 when unparseable."""
+    try:
+        dims = [int(d) for d in topology.lower().split("x")]
+    except ValueError:
+        return 0
+    if not dims or any(d <= 0 for d in dims):
+        return 0
+    return math.prod(dims)
+
+
+def node_topology(node: Node | None) -> NodeTopology | None:
+    """The node's advertised TPU topology, or None when the node carries no
+    GKE TPU labels (⇒ no topology constraints apply)."""
+    if not node:
+        return None
+    labels = node.get("metadata", {}).get("labels", {}) or {}
+    accelerator = labels.get(consts.LABEL_TPU_ACCELERATOR, "")
+    topology = labels.get(consts.LABEL_TPU_TOPOLOGY, "")
+    if not accelerator and not topology:
+        return None
+    status = node.get("status", {}) or {}
+    alloc = (status.get("allocatable") or status.get("capacity") or {})
+    try:
+        chips = int(alloc.get(consts.TPU_RESOURCE_NAME, 0))
+    except (TypeError, ValueError):
+        chips = 0
+    return NodeTopology(accelerator=accelerator, topology=topology,
+                        chips_per_host=chips,
+                        total_chips=parse_topology_product(topology))
+
+
+def aligned_group_sizes(topo: NodeTopology) -> list[int]:
+    """Entire-mount sizes this node can serve as a valid ICI group."""
+    if topo.chips_per_host <= 0:
+        return []
+    if topo.multi_host:
+        return [topo.chips_per_host]
+    return [n for n in range(1, topo.chips_per_host + 1)
+            if topo.chips_per_host % n == 0 and (n & (n - 1)) == 0]
+
+
+def validate_entire_mount(topo: NodeTopology | None, tpu_num: int) -> None:
+    """Raises :class:`TopologyError` when an entire-mount of ``tpu_num``
+    chips cannot form a valid ICI group on this node. No-op for nodes
+    without topology info or without a readable chip count."""
+    if topo is None or topo.chips_per_host <= 0:
+        return
+    valid = aligned_group_sizes(topo)
+    if tpu_num in valid:
+        return
+    kind = (f"multi-host slice node ({topo.num_hosts} hosts × "
+            f"{topo.chips_per_host} chips)" if topo.multi_host
+            else f"single-host node ({topo.chips_per_host} chips)")
+    raise TopologyError(
+        f"entire-mount of {tpu_num} chips is not topology-aligned on this "
+        f"{kind}, accelerator={topo.accelerator} topology={topo.topology}; "
+        f"valid sizes: {valid}")

@@ -12,8 +12,10 @@ hardware, not style:
   reports for ``google.com/tpu``, normally the chip index) plus the PCI
   address. ``uuid`` keeps the reference's field name for API parity and holds
   the stable external ID.
-- TPU chips have ICI topology coordinates (from sysfs/GKE labels) used for
-  topology-aligned entire-mounts; NVIDIA had no equivalent.
+- TPU chips belong to an ICI mesh whose shape GKE advertises via node labels;
+  the allocator stamps the node's ``accelerator``/``topology`` onto each chip
+  at allocation time (see ``allocator/topology.py``) so downstream layers can
+  reason about mesh validity. NVIDIA had no equivalent.
 """
 
 from __future__ import annotations
@@ -73,6 +75,10 @@ class TPUChip:
     state: DeviceState = DeviceState.FREE
     pod_name: str = ""          # set when ALLOCATED (ref nvidia.go:15-16)
     namespace: str = ""
+    # ICI mesh identity, stamped by the allocator from the node's GKE TPU
+    # labels at allocation time ("" when the node advertises none).
+    accelerator: str = ""       # e.g. tpu-v5-lite-podslice
+    topology: str = ""          # e.g. "2x4"
 
     @property
     def container_path(self) -> str:

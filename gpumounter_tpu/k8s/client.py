@@ -71,6 +71,11 @@ class KubeClient(abc.ABC):
                    timeout_s: float = 60.0) -> Iterator[WatchEvent]:
         """Stream events for up to ``timeout_s``; iterator ends at deadline."""
 
+    @abc.abstractmethod
+    def get_node(self, name: str) -> dict[str, Any]:
+        """Node object (for TPU topology labels / allocatable). Raises
+        :class:`K8sApiError` (status 404 for unknown nodes)."""
+
 
 # -- production client ---------------------------------------------------------
 
@@ -171,6 +176,9 @@ class InClusterKubeClient(KubeClient):
             if e.status != 404:
                 raise
 
+    def get_node(self, name: str) -> dict[str, Any]:
+        return self._request("GET", f"/api/v1/nodes/{name}")
+
     def watch_pods(self, namespace: str, label_selector: str | None = None,
                    field_selector: str | None = None,
                    timeout_s: float = 60.0) -> Iterator[WatchEvent]:
@@ -228,6 +236,7 @@ class FakeKubeClient(KubeClient):
     def __init__(self):
         self._lock = threading.Condition()
         self._pods: dict[tuple[str, str], objects.Pod] = {}
+        self._nodes: dict[str, dict[str, Any]] = {}
         self._events: list[tuple[str, objects.Pod]] = []
         self.on_create: list[Callable[[objects.Pod], None]] = []
         self.on_delete: list[Callable[[objects.Pod], None]] = []
@@ -246,6 +255,17 @@ class FakeKubeClient(KubeClient):
             event = "MODIFIED" if key in self._pods else "ADDED"
             self._pods[key] = pod
             self._record(event, pod)
+
+    def put_node(self, node: dict[str, Any]) -> None:
+        with self._lock:
+            self._nodes[node.get("metadata", {}).get("name", "")] = node
+
+    def get_node(self, name: str) -> dict[str, Any]:
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise K8sApiError(404, f"node {name} not found")
+            return json.loads(json.dumps(node))
 
     def set_pod_status(self, namespace: str, name: str,
                        **status: Any) -> None:

@@ -32,7 +32,8 @@ from gpumounter_tpu.k8s.client import KubeClient
 from gpumounter_tpu.master.discovery import (WorkerDirectory,
                                              WorkerNotFoundError)
 from gpumounter_tpu.utils import consts
-from gpumounter_tpu.utils.errors import K8sApiError, PodNotFoundError
+from gpumounter_tpu.utils.errors import (K8sApiError, PodNotFoundError,
+                                         TopologyError)
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 from gpumounter_tpu.worker.grpc_server import WorkerClient
@@ -190,8 +191,12 @@ class MasterGateway:
                     f"tpusPerHost must be a positive integer, got {tpus!r}")
         except ValueError as e:
             return 400, {"result": "BadRequest", "message": str(e)}
-        ok, results, rollback_clean = self._slice_coordinator().attach(
-            pods, tpus, request_id=rid)
+        try:
+            ok, results, rollback_clean = self._slice_coordinator().attach(
+                pods, tpus, request_id=rid)
+        except TopologyError as e:
+            # pre-fan-out rejection: no host was touched
+            return 412, {"result": "TopologyMismatch", "message": str(e)}
         return (200 if ok else 503), {
             "result": "SUCCESS" if ok else "SliceAttachFailed",
             "rolled_back": (not ok) and rollback_clean,

@@ -22,7 +22,11 @@ import concurrent.futures
 import dataclasses
 import uuid as uuid_mod
 
+from gpumounter_tpu.allocator import topology
+from gpumounter_tpu.k8s import objects
 from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.errors import (K8sApiError, PodNotFoundError,
+                                         TopologyError)
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 
@@ -77,7 +81,13 @@ class SliceCoordinator:
 
         ``rollback_clean`` is False only if a rollback detach itself failed
         (chips may be leaked; the per-pod results say where to look).
+
+        Raises :class:`TopologyError` before any fan-out when the target
+        hosts cannot form one valid slice (mixed accelerator/topology,
+        two pods sharing a host, or a per-host chip count that isn't the
+        hosts' whole-host size).
         """
+        self.validate_slice_topology(pods, tpus_per_host)
         txn_id = "txn-" + uuid_mod.uuid4().hex[:12]
         results = self._fan_out(
             pods,
@@ -116,6 +126,75 @@ class SliceCoordinator:
             out = PodResult(namespace, pod, "ERROR", message=str(e))
         REGISTRY.attach_results.inc(result=f"slice_{out.result}")
         return out
+
+    # -- slice topology validation (SURVEY.md §7 hard part 5) ------------------
+
+    def validate_slice_topology(self, pods: list[tuple[str, str]],
+                                tpus_per_host: int) -> None:
+        """All target hosts must advertise ONE slice topology for the
+        attached chips to form a usable multi-host ICI mesh. Pods/nodes
+        that cannot be resolved are left for the per-pod attach to report
+        precisely; pods on label-less nodes (test/non-GKE) are
+        unconstrained. Raises :class:`TopologyError` on any violation."""
+        node_of: dict[tuple[str, str], str] = {}
+        topos: dict[str, topology.NodeTopology] = {}
+        for ns, name in pods:
+            try:
+                pod = self.gateway.kube.get_pod(ns, name)
+            except PodNotFoundError:
+                continue        # per-pod attach will report POD_NOT_FOUND
+            except K8sApiError as e:
+                logger.warning(
+                    "slice topology check: pod %s/%s unreadable (%s); "
+                    "skipping its checks", ns, name, e)
+                continue
+            node_name = objects.node_name(pod)
+            if not node_name:
+                continue
+            node_of[(ns, name)] = node_name
+            try:
+                node = self.gateway.kube.get_node(node_name)
+            except K8sApiError as e:
+                if e.status != 404:     # 404 = unlabelled/unknown is normal
+                    logger.warning(
+                        "slice topology check: node %s unreadable (%s); "
+                        "topology enforcement off for it", node_name, e)
+                continue
+            topo = topology.node_topology(node)
+            if topo:
+                topos[node_name] = topo
+
+        owners: dict[str, tuple[str, str]] = {}
+        for key, node_name in node_of.items():
+            other = owners.setdefault(node_name, key)
+            if other != key:
+                raise TopologyError(
+                    f"pods {other[0]}/{other[1]} and {key[0]}/{key[1]} are "
+                    f"both on node {node_name}: a slice needs one pod per "
+                    "host")
+
+        if not topos:
+            return
+        shapes = {(t.accelerator, t.topology) for t in topos.values()}
+        if len(shapes) > 1:
+            detail = {n: f"{t.accelerator}/{t.topology}"
+                      for n, t in sorted(topos.items())}
+            raise TopologyError(
+                f"target hosts advertise different slice topologies {detail}"
+                " — they cannot form one ICI mesh")
+        for node_name, topo in topos.items():
+            if topo.chips_per_host > 0 and tpus_per_host != topo.chips_per_host:
+                raise TopologyError(
+                    f"slice attach needs whole hosts: node {node_name} has "
+                    f"{topo.chips_per_host} chips/host "
+                    f"(topology {topo.topology}), got tpusPerHost="
+                    f"{tpus_per_host}")
+        topo = next(iter(topos.values()))
+        if topo.multi_host and len(pods) != topo.num_hosts:
+            logger.warning(
+                "slice attach targets %d pods but topology %s spans %d "
+                "hosts — the resulting mesh will be partial",
+                len(pods), topo.topology, topo.num_hosts)
 
     # -- detach ----------------------------------------------------------------
 

@@ -45,6 +45,25 @@ def make_target_pod(name="workload", namespace="default", node="node-a",
     }
 
 
+def make_tpu_node(name="node-a", accelerator="tpu-v5-lite-podslice",
+                  topology="2x2", chips=4):
+    """A Node object with GKE TPU labels + allocatable, as the allocator's
+    topology reads see it. ``accelerator=None`` gives a label-less node
+    (no topology enforcement)."""
+    node = {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {}},
+        "status": {"allocatable": {consts.TPU_RESOURCE_NAME: str(chips)}},
+    }
+    if accelerator is not None:
+        node["metadata"]["labels"] = {
+            consts.LABEL_TPU_ACCELERATOR: accelerator,
+            consts.LABEL_TPU_TOPOLOGY: topology,
+        }
+    return node
+
+
 def worker_pod(node, ip, name="w1", grpc_port: int | None = None):
     """A Running tpu-mounter-worker pod as the master's discovery sees it.
     ``grpc_port`` sets the per-pod port-override annotation (local stacks

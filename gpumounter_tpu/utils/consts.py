@@ -77,11 +77,16 @@ STATUS_DELETED = "SuccessfullyDeleted"
 STATUS_FAILED_DELETE = "FailedDeleted"
 
 # --- GKE TPU topology node labels ---------------------------------------------
-# Used for topology-aware entire-mount: attach whole hosts / aligned chip
+# Read for topology-aware entire-mount: attach whole hosts / aligned chip
 # groups so the resulting ICI mesh is valid (SURVEY.md §7 "Topology-aware
-# allocation"). These are the standard GKE TPU nodepool labels.
+# allocation"). These are the standard GKE TPU nodepool labels; see
+# allocator/topology.py for the validation rules.
 LABEL_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
 LABEL_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+# Stamped (our namespace) onto slave pods at creation so a mount's topology
+# is readable from the pool namespace without a node round-trip.
+CHIP_TOPOLOGY_LABEL_KEY = "tpumounter.io/tpu-topology"
+CHIP_ACCELERATOR_LABEL_KEY = "tpumounter.io/tpu-accelerator"
 
 
 class MountType(str, enum.Enum):

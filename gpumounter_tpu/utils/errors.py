@@ -45,6 +45,13 @@ class MountPolicyError(TPUMounterError):
     (ref ``pkg/util/util.go:207-226`` CanMount)."""
 
 
+class TopologyError(MountPolicyError):
+    """The requested chip count cannot form a valid ICI group on the target
+    node's advertised TPU topology (no reference analog — GPUs are
+    interchangeable, TPU chips are mesh-positional). Subclasses
+    MountPolicyError so it rides the same FAILED_PRECONDITION→412 mapping."""
+
+
 class ActuationError(TPUMounterError):
     """Host-side actuation (cgroup write / BPF attach / nsenter) failed."""
 

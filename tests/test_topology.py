@@ -1,0 +1,214 @@
+"""Topology-aware allocation (SURVEY.md §7 hard part 3).
+
+Entire-mounts must form valid ICI groups on the target node's advertised GKE
+TPU topology; multi-host slice attaches must target hosts that advertise ONE
+slice shape. Misaligned requests get a precise 412 *before* any slave pod is
+created."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.allocator import topology
+from gpumounter_tpu.testing.sim import (LiveStack, MultiNodeStack,
+                                        WorkerRig, make_tpu_node)
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.errors import TopologyError
+from tests.test_slice import _host, _post
+
+
+# -- unit: parsing / validation rules -----------------------------------------
+
+
+def test_parse_topology_product():
+    assert topology.parse_topology_product("2x4") == 8
+    assert topology.parse_topology_product("2x2x2") == 8
+    assert topology.parse_topology_product("16x16") == 256
+    assert topology.parse_topology_product("") == 0
+    assert topology.parse_topology_product("bogus") == 0
+    assert topology.parse_topology_product("0x4") == 0
+
+
+def test_node_topology_reads_labels_and_allocatable():
+    topo = topology.node_topology(make_tpu_node(
+        accelerator="tpu-v5-lite-podslice", topology="2x4", chips=8))
+    assert topo.accelerator == "tpu-v5-lite-podslice"
+    assert topo.topology == "2x4"
+    assert topo.chips_per_host == 8
+    assert topo.total_chips == 8
+    assert topo.num_hosts == 1 and not topo.multi_host
+
+
+def test_node_topology_multi_host():
+    topo = topology.node_topology(make_tpu_node(
+        accelerator="tpu-v5p-slice", topology="2x2x4", chips=4))
+    assert topo.total_chips == 16
+    assert topo.num_hosts == 4 and topo.multi_host
+    assert topology.aligned_group_sizes(topo) == [4]   # whole hosts only
+
+
+def test_node_topology_none_for_unlabelled_nodes():
+    assert topology.node_topology(make_tpu_node(accelerator=None)) is None
+    assert topology.node_topology(None) is None
+
+
+def test_aligned_group_sizes_single_host():
+    topo = topology.node_topology(make_tpu_node(topology="2x4", chips=8))
+    assert topology.aligned_group_sizes(topo) == [1, 2, 4, 8]
+    topo4 = topology.node_topology(make_tpu_node(topology="2x2", chips=4))
+    assert topology.aligned_group_sizes(topo4) == [1, 2, 4]
+
+
+def test_validate_entire_mount():
+    topo = topology.node_topology(make_tpu_node(topology="2x2", chips=4))
+    topology.validate_entire_mount(topo, 4)          # whole host
+    topology.validate_entire_mount(topo, 2)          # aligned sub-group
+    topology.validate_entire_mount(None, 3)          # no topology info: free
+    with pytest.raises(TopologyError) as exc:
+        topology.validate_entire_mount(topo, 3)      # the VERDICT scenario
+    assert "valid sizes: [1, 2, 4]" in str(exc.value)
+
+    multi = topology.node_topology(make_tpu_node(
+        accelerator="tpu-v5p-slice", topology="2x2x4", chips=4))
+    with pytest.raises(TopologyError):
+        topology.validate_entire_mount(multi, 2)     # sub-host on multi-host
+
+
+# -- allocator/service: labelled fake nodes -----------------------------------
+
+
+@pytest.fixture
+def rig(tmp_path, fake_host):
+    r = WorkerRig(fake_host, n_chips=4)
+    yield r
+    r.close()
+
+
+def test_misaligned_entire_mount_rejected_before_slave_pods(rig):
+    rig.sim.kube.put_node(make_tpu_node(name="node-a", topology="2x2",
+                                        chips=4))
+    with pytest.raises(TopologyError):
+        rig.service.add_tpu("workload", "default", 3, True)
+    assert rig.sim.slave_pods() == []                # nothing was created
+
+
+def test_aligned_entire_mount_stamps_topology(rig):
+    rig.sim.kube.put_node(make_tpu_node(name="node-a", topology="2x2",
+                                        chips=4))
+    outcome = rig.service.add_tpu("workload", "default", 4, True)
+    assert outcome.result == consts.AddResult.SUCCESS
+    for chip in outcome.chips:
+        assert chip.accelerator == "tpu-v5-lite-podslice"
+        assert chip.topology == "2x2"
+    slaves = rig.sim.slave_pods()
+    assert len(slaves) == 1
+    labels = slaves[0]["metadata"]["labels"]
+    assert labels[consts.CHIP_TOPOLOGY_LABEL_KEY] == "2x2"
+    assert labels[consts.CHIP_ACCELERATOR_LABEL_KEY] == \
+        "tpu-v5-lite-podslice"
+
+
+def test_unlabelled_node_unconstrained(rig):
+    rig.sim.kube.put_node(make_tpu_node(name="node-a", accelerator=None))
+    outcome = rig.service.add_tpu("workload", "default", 3, True)
+    assert outcome.result == consts.AddResult.SUCCESS
+
+
+def test_missing_node_unconstrained(rig):
+    # no put_node at all: node GET 404s, enforcement off (non-GKE clusters)
+    outcome = rig.service.add_tpu("workload", "default", 3, True)
+    assert outcome.result == consts.AddResult.SUCCESS
+
+
+def test_single_mounts_not_topology_constrained(rig):
+    rig.sim.kube.put_node(make_tpu_node(name="node-a", topology="2x2",
+                                        chips=4))
+    outcome = rig.service.add_tpu("workload", "default", 3, False)
+    assert outcome.result == consts.AddResult.SUCCESS
+    # single-chip slave pods still carry the topology stamp
+    for pod in rig.sim.slave_pods():
+        assert pod["metadata"]["labels"][consts.CHIP_TOPOLOGY_LABEL_KEY] \
+            == "2x2"
+
+
+# -- HTTP: precise 412 through the full stack ---------------------------------
+
+
+def test_misaligned_mount_is_412_over_http(fake_host):
+    rig = WorkerRig(fake_host, n_chips=4)
+    rig.sim.kube.put_node(make_tpu_node(name="node-a", topology="2x2",
+                                        chips=4))
+    stack = LiveStack(rig)
+    try:
+        url = (f"{stack.base}/addtpu/namespace/default/pod/workload"
+               "/tpu/3/isEntireMount/true")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url)
+        assert exc.value.code == 412
+        body = json.loads(exc.value.read())
+        assert "topology-aligned" in body["message"]
+        assert rig.sim.slave_pods() == []
+    finally:
+        stack.close()
+
+
+# -- slice-level verification --------------------------------------------------
+
+
+SLICE = {"pods": [{"namespace": "default", "pod": "workload-0"},
+                  {"namespace": "default", "pod": "workload-1"}],
+         "tpusPerHost": 4}
+
+
+@pytest.fixture
+def stack(tmp_path):
+    s = MultiNodeStack([_host(tmp_path, 0), _host(tmp_path, 1)], n_chips=4)
+    yield s
+    s.close()
+
+
+def test_slice_attach_mismatched_topologies_412(stack):
+    stack.master_kube.put_node(make_tpu_node(
+        name="node-0", accelerator="tpu-v5p-slice", topology="2x2x4",
+        chips=4))
+    stack.master_kube.put_node(make_tpu_node(
+        name="node-1", accelerator="tpu-v5-lite-podslice", topology="2x2",
+        chips=4))
+    status, body = _post(f"{stack.base}/addtpuslice", SLICE)
+    assert status == 412
+    assert body["result"] == "TopologyMismatch"
+    assert "different slice topologies" in body["message"]
+    for rig in stack.rigs:
+        assert rig.sim.slave_pods() == []            # nothing fanned out
+
+
+def test_slice_attach_wrong_per_host_count_412(stack):
+    for i in range(2):
+        stack.master_kube.put_node(make_tpu_node(
+            name=f"node-{i}", accelerator="tpu-v5p-slice", topology="2x2x2",
+            chips=4))
+    req = dict(SLICE, tpusPerHost=2)
+    status, body = _post(f"{stack.base}/addtpuslice", req)
+    assert status == 412
+    assert "whole hosts" in body["message"]
+
+
+def test_slice_attach_two_pods_one_host_412(stack):
+    # move workload-1 onto node-0 in the master's view
+    pod = stack.master_kube.get_pod("default", "workload-1")
+    pod["spec"]["nodeName"] = "node-0"
+    stack.master_kube.put_pod(pod)
+    status, body = _post(f"{stack.base}/addtpuslice", SLICE)
+    assert status == 412
+    assert "one pod per host" in body["message"]
+
+
+def test_slice_attach_matching_topologies_succeeds(stack):
+    for i in range(2):
+        stack.master_kube.put_node(make_tpu_node(
+            name=f"node-{i}", accelerator="tpu-v5p-slice", topology="2x2x2",
+            chips=4))
+    status, body = _post(f"{stack.base}/addtpuslice", SLICE)
+    assert status == 200 and body["result"] == "SUCCESS"

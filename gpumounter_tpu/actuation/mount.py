@@ -56,12 +56,17 @@ class TPUMounter:
 
     # -- helpers ---------------------------------------------------------------
 
-    def _target_container_id(self, pod: objects.Pod) -> str:
+    def _target_container_ids(self, pod: objects.Pod) -> list[str]:
+        """ALL running containers. The reference actuated and busy-checked
+        only the first container (util.go:50) — in a multi-container pod a
+        device holder in the second container was invisible to the busy
+        pre-check, so detach could yank a device in use (SURVEY.md §8 says
+        don't replicate)."""
         ids = objects.container_ids(pod)
         if not ids:
             raise ActuationError(
                 f"pod {objects.name(pod)} has no running containers")
-        return ids[0]
+        return ids
 
     def _live_pid(self, pod: objects.Pod, container_id: str) -> int:
         """First PID of the container cgroup that is still alive
@@ -84,26 +89,55 @@ class TPUMounter:
             paths.append(companion.container_path)
         return list(dict.fromkeys(paths))
 
+    def _all_container_pids(self, pod: objects.Pod) -> list[int]:
+        """Union of every container's cgroup PIDs (a holder may live in any
+        container of the pod). Containers whose cgroup is gone (terminated
+        sidecar) are skipped."""
+        pids: list[int] = []
+        for container_id in self._target_container_ids(pod):
+            try:
+                pids.extend(self.cgroups.get_pids(pod, container_id))
+            except CgroupError:
+                continue
+        return sorted(set(pids))
+
+    def _actuatable_containers(self, pod: objects.Pod) -> list[tuple[str, int]]:
+        """(container_id, live_pid) for every container that can be
+        actuated. Terminated containers keep their containerID in pod
+        status but have no cgroup/processes — they are skipped, and only
+        if NO container is actuatable does this raise (a completed sidecar
+        must not block attach/detach for the main container)."""
+        out: list[tuple[str, int]] = []
+        for container_id in self._target_container_ids(pod):
+            try:
+                out.append((container_id,
+                            self._live_pid(pod, container_id)))
+            except (CgroupError, ActuationError):
+                logger.debug("container %s of %s has no live cgroup/PID; "
+                             "skipping actuation for it", container_id,
+                             objects.name(pod))
+        if not out:
+            raise ActuationError(
+                f"no actuatable container in pod {objects.name(pod)}: all "
+                "containers' cgroups/processes are gone")
+        return out
+
     def pod_device_processes(self, pod: objects.Pod,
                              chip: TPUChip) -> list[int]:
-        """PIDs inside the pod's container holding this chip open
-        (ref util.go:152-196: cgroup PIDs ∩ device holders)."""
-        container_id = self._target_container_id(pod)
+        """PIDs inside ANY of the pod's containers holding this chip open
+        (ref util.go:152-196: cgroup PIDs ∩ device holders — but across all
+        containers, not just the first)."""
         try:
-            pids = self.cgroups.get_pids(pod, container_id)
-        except CgroupError:
+            pids = self._all_container_pids(pod)
+        except ActuationError:
             return []
         return self.enumerator.device_open_pids(pids,
                                                 self._node_paths(chip))
 
     def _busy_map(self, pod: objects.Pod,
                   chips: list[TPUChip]) -> dict[str, list[int]]:
-        """uuid -> holder PIDs, reading the container's cgroup.procs once."""
-        container_id = self._target_container_id(pod)
-        try:
-            pids = self.cgroups.get_pids(pod, container_id)
-        except CgroupError:
-            return {}
+        """uuid -> holder PIDs, reading every container's cgroup.procs once."""
+        pids = self._all_container_pids(pod)
         busy: dict[str, list[int]] = {}
         for chip in chips:
             holders = self.enumerator.device_open_pids(
@@ -125,16 +159,16 @@ class TPUMounter:
         Ref util.go:17-71 MountGPU, per chip: cgroup allow -> pick PID ->
         mknod. Companion nodes (VFIO) ride along.
         """
-        container_id = self._target_container_id(pod)
-        self.cgroups.sync_device_access(pod, container_id, all_chips_after)
-        pid = self._live_pid(pod, container_id)
-        for chip in new_chips:
-            self.actuator.create_device_node(
-                pid, chip.container_path, chip.major, chip.minor)
-            for companion in chip.companions:
+        for container_id, pid in self._actuatable_containers(pod):
+            self.cgroups.sync_device_access(pod, container_id,
+                                            all_chips_after)
+            for chip in new_chips:
                 self.actuator.create_device_node(
-                    pid, companion.container_path, companion.major,
-                    companion.minor)
+                    pid, chip.container_path, chip.major, chip.minor)
+                for companion in chip.companions:
+                    self.actuator.create_device_node(
+                        pid, companion.container_path, companion.major,
+                        companion.minor)
         logger.info("mounted %d chips into %s/%s",
                     len(new_chips), objects.namespace(pod), objects.name(pod))
 
@@ -149,23 +183,22 @@ class TPUMounter:
         rm device file -> (force) kill holders. Busy without force raises
         :class:`DeviceBusyError` with the holder PIDs.
         """
-        container_id = self._target_container_id(pod)
         busy = self._busy_map(pod, chips)
         if busy and not force:
             uuid, pids = next(iter(busy.items()))
             raise DeviceBusyError(uuid, pids)
 
-        self.cgroups.revoke_device_access(pod, container_id, chips,
-                                          remaining_chips)
-        pid = self._live_pid(pod, container_id)
         remaining_companions = {c.host_path for chip in remaining_chips
                                 for c in chip.companions}
-        for chip in chips:
-            self.actuator.remove_device_node(pid, chip.container_path)
-            for companion in chip.companions:
-                if companion.host_path not in remaining_companions:
-                    self.actuator.remove_device_node(
-                        pid, companion.container_path)
+        for container_id, pid in self._actuatable_containers(pod):
+            self.cgroups.revoke_device_access(pod, container_id, chips,
+                                              remaining_chips)
+            for chip in chips:
+                self.actuator.remove_device_node(pid, chip.container_path)
+                for companion in chip.companions:
+                    if companion.host_path not in remaining_companions:
+                        self.actuator.remove_device_node(
+                            pid, companion.container_path)
         if force and busy:
             all_pids = sorted({p for pids in busy.values() for p in pids})
             self.actuator.kill_processes(all_pids)

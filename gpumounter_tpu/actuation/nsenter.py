@@ -145,9 +145,12 @@ class NsenterActuator(ContainerNsActuator):
     def create_device_node(self, pid: int, device_path: str, major: int,
                            minor: int,
                            mode: int = consts.DEVICE_FILE_MODE) -> None:
-        # ref namespace.go:167-177 AddGPUDeviceFile
+        # ref namespace.go:167-177 AddGPUDeviceFile — but idempotent: an
+        # existing node short-circuits (EEXIST must not fail the resume
+        # path), matching ProcRootActuator's behaviour.
         self._run_in_mount_ns(
-            pid, f"mknod -m {mode:o} {device_path} c {major} {minor}")
+            pid, f"test -e {device_path} || "
+                 f"mknod -m {mode:o} {device_path} c {major} {minor}")
 
     def remove_device_node(self, pid: int, device_path: str) -> None:
         # ref namespace.go:179-189 RemoveGPUDeviceFile

@@ -57,6 +57,13 @@ class KubeClient(abc.ABC):
         ...
 
     @abc.abstractmethod
+    def list_pods_with_version(
+            self, namespace: str, label_selector: str | None = None
+    ) -> tuple[list[objects.Pod], str]:
+        """(pods, list resourceVersion) — the version to start a watch from
+        so no event between the LIST and the watch is lost."""
+
+    @abc.abstractmethod
     def create_pod(self, namespace: str, pod: objects.Pod) -> objects.Pod:
         ...
 
@@ -68,8 +75,14 @@ class KubeClient(abc.ABC):
     @abc.abstractmethod
     def watch_pods(self, namespace: str, label_selector: str | None = None,
                    field_selector: str | None = None,
-                   timeout_s: float = 60.0) -> Iterator[WatchEvent]:
-        """Stream events for up to ``timeout_s``; iterator ends at deadline."""
+                   timeout_s: float = 60.0,
+                   resource_version: str | None = None
+                   ) -> Iterator[WatchEvent]:
+        """Stream events for up to ``timeout_s``; iterator ends at deadline.
+
+        ``resource_version`` starts the stream from a LIST's version (no
+        lost-event window). An expired version raises
+        :class:`K8sApiError` with status 410 — re-LIST and restart."""
 
     @abc.abstractmethod
     def get_node(self, name: str) -> dict[str, Any]:
@@ -155,12 +168,18 @@ class InClusterKubeClient(KubeClient):
 
     def list_pods(self, namespace: str,
                   label_selector: str | None = None) -> list[objects.Pod]:
+        return self.list_pods_with_version(namespace, label_selector)[0]
+
+    def list_pods_with_version(
+            self, namespace: str, label_selector: str | None = None
+    ) -> tuple[list[objects.Pod], str]:
         query = {}
         if label_selector:
             query["labelSelector"] = label_selector
         out = self._request("GET", f"/api/v1/namespaces/{namespace}/pods",
                             query=query)
-        return out.get("items", [])
+        return (out.get("items", []),
+                out.get("metadata", {}).get("resourceVersion", ""))
 
     def create_pod(self, namespace: str, pod: objects.Pod) -> objects.Pod:
         return self._request("POST", f"/api/v1/namespaces/{namespace}/pods",
@@ -181,13 +200,17 @@ class InClusterKubeClient(KubeClient):
 
     def watch_pods(self, namespace: str, label_selector: str | None = None,
                    field_selector: str | None = None,
-                   timeout_s: float = 60.0) -> Iterator[WatchEvent]:
+                   timeout_s: float = 60.0,
+                   resource_version: str | None = None
+                   ) -> Iterator[WatchEvent]:
         query = {"watch": "true",
                  "timeoutSeconds": str(max(1, int(timeout_s)))}
         if label_selector:
             query["labelSelector"] = label_selector
         if field_selector:
             query["fieldSelector"] = field_selector
+        if resource_version:
+            query["resourceVersion"] = resource_version
         resp = self._request("GET", f"/api/v1/namespaces/{namespace}/pods",
                              query=query, stream=True,
                              timeout=timeout_s + 5.0)
@@ -202,7 +225,15 @@ class InClusterKubeClient(KubeClient):
                         logger.warning("unparseable watch line: %r",
                                        line[:200])
                         continue
-                    yield event.get("type", ""), event.get("object", {})
+                    etype = event.get("type", "")
+                    obj = event.get("object", {})
+                    if etype == "ERROR":
+                        # e.g. 410 Gone: the resourceVersion is too old;
+                        # callers re-LIST and restart the watch.
+                        raise K8sApiError(int(obj.get("code", 0) or 0),
+                                          obj.get("message",
+                                                  "watch error event"))
+                    yield etype, obj
         except OSError as e:
             # Mid-stream network failure: surface a typed error so callers'
             # cleanup paths (allocator rollback) engage instead of a raw
@@ -276,7 +307,12 @@ class FakeKubeClient(KubeClient):
             self._record("MODIFIED", pod)
 
     def _record(self, event_type: str, pod: objects.Pod) -> None:
-        self._events.append((event_type, json.loads(json.dumps(pod))))
+        copy = json.loads(json.dumps(pod))
+        # Event index is the resourceVersion: monotonically increasing,
+        # stamped on the event object like a real apiserver.
+        copy.setdefault("metadata", {})["resourceVersion"] = \
+            str(len(self._events) + 1)
+        self._events.append((event_type, copy))
         self._lock.notify_all()
 
     # -- KubeClient ------------------------------------------------------------
@@ -290,11 +326,17 @@ class FakeKubeClient(KubeClient):
 
     def list_pods(self, namespace: str,
                   label_selector: str | None = None) -> list[objects.Pod]:
+        return self.list_pods_with_version(namespace, label_selector)[0]
+
+    def list_pods_with_version(
+            self, namespace: str, label_selector: str | None = None
+    ) -> tuple[list[objects.Pod], str]:
         with self._lock:
-            return [json.loads(json.dumps(p))
+            pods = [json.loads(json.dumps(p))
                     for (ns, _), p in self._pods.items()
                     if ns == namespace
                     and _match_label_selector(p, label_selector)]
+            return pods, str(len(self._events))
 
     def create_pod(self, namespace: str, pod: objects.Pod) -> objects.Pod:
         pod = json.loads(json.dumps(pod))
@@ -333,11 +375,18 @@ class FakeKubeClient(KubeClient):
 
     def watch_pods(self, namespace: str, label_selector: str | None = None,
                    field_selector: str | None = None,
-                   timeout_s: float = 60.0) -> Iterator[WatchEvent]:
-        # Replays the full event log then follows new events — equivalent to
-        # a real watch started from resourceVersion=0.
+                   timeout_s: float = 60.0,
+                   resource_version: str | None = None
+                   ) -> Iterator[WatchEvent]:
+        # Replays the event log from ``resource_version`` (default: from the
+        # beginning, equivalent to resourceVersion=0) then follows new
+        # events. Event index == resourceVersion, matching
+        # list_pods_with_version.
         deadline = time.monotonic() + timeout_s
-        cursor = 0
+        try:
+            cursor = int(resource_version or 0)
+        except ValueError:
+            cursor = 0
         field_name = None
         if field_selector and field_selector.startswith("metadata.name="):
             field_name = field_selector.split("=", 1)[1]

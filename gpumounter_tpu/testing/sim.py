@@ -231,22 +231,25 @@ class WorkerRig:
                                        self.sim.kube, self.sim.settings)
 
     def provision_container(self, pod: objects.Pod,
-                            pid: int | None = None) -> str:
-        """Create the fixture cgroup dir + live PID for another pod's
-        container (the rig's own target pod is provisioned in __init__).
-        Returns the cgroup dir."""
-        pid = pid or (self.pid + 1 + len(os.listdir(self.host.proc_root)))
-        cid = objects.container_ids(pod)[0]
-        cgroup_dir = self.cgroups.container_dir(pod, cid)
-        os.makedirs(cgroup_dir, exist_ok=True)
-        with open(os.path.join(cgroup_dir, "cgroup.procs"), "w") as f:
-            f.write(f"{pid}\n")
-        os.makedirs(os.path.join(self.host.proc_root, str(pid)),
-                    exist_ok=True)
-        if self._actuator_kind == "procroot":
-            os.makedirs(os.path.join(self.host.proc_root, str(pid), "root",
-                                     "dev"), exist_ok=True)
-        return cgroup_dir
+                            pid: int | None = None) -> dict[str, int]:
+        """Create fixture cgroup dirs + one live PID per container of the
+        pod (the rig's own target pod's first container is provisioned in
+        __init__). Returns {container_id: pid}."""
+        next_pid = pid or (self.pid + 1 + len(os.listdir(self.host.proc_root)))
+        out: dict[str, int] = {}
+        for cid in objects.container_ids(pod):
+            cgroup_dir = self.cgroups.container_dir(pod, cid)
+            os.makedirs(cgroup_dir, exist_ok=True)
+            with open(os.path.join(cgroup_dir, "cgroup.procs"), "w") as f:
+                f.write(f"{next_pid}\n")
+            os.makedirs(os.path.join(self.host.proc_root, str(next_pid)),
+                        exist_ok=True)
+            if self._actuator_kind == "procroot":
+                os.makedirs(os.path.join(self.host.proc_root, str(next_pid),
+                                         "root", "dev"), exist_ok=True)
+            out[cid] = next_pid
+            next_pid += 1
+        return out
 
     def close(self) -> None:
         self.sim.close()
@@ -264,6 +267,7 @@ class LiveStack:
         self.rig = rig
         self.grpc_server, grpc_port = build_server(rig.service, port=0,
                                                    address="127.0.0.1")
+        self.grpc_port = grpc_port
         self.grpc_server.start()
         self.master_kube = FakeKubeClient()
         self.master_kube.put_pod(worker_pod(rig.sim.node, "127.0.0.1"))

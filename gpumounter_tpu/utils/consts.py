@@ -53,6 +53,11 @@ OWNER_UID_LABEL_KEY = "tpumounter.io/owner-uid"
 # rollback can target exactly the chips that transaction attached even when
 # the attach reply was lost.
 TXN_LABEL_KEY = "tpumounter.io/txn-id"
+# Stamped with the caller's x-request-id: a retried AddTPU (gateway retry
+# after UNAVAILABLE, lost reply) adopts the prior attempt's slave pods
+# instead of allocating a second set — idempotence keyed on cluster state,
+# which survives worker restarts (an in-memory dedupe cache would not).
+REQUEST_ID_LABEL_KEY = "tpumounter.io/request-id"
 SLAVE_POD_IMAGE = "registry.k8s.io/pause:3.9"
 
 # --- Environment variables (ref: CGROUP_DRIVER cgroup.go:78, GPU_POOL_NAMESPACE

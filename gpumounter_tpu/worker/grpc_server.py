@@ -45,7 +45,8 @@ def _add_handler(service: TPUMountService):
             outcome = service.add_tpu(request.pod_name, request.namespace,
                                       request.tpu_num,
                                       request.is_entire_mount,
-                                      txn_id=request.txn_id)
+                                      txn_id=request.txn_id,
+                                      request_id=rid if rid != "-" else "")
         except MountPolicyError as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         except TPUMounterError as e:

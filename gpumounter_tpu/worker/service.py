@@ -21,6 +21,7 @@ outcomes; the gRPC adapter maps them onto the proto enums. Deliberate deltas:
 from __future__ import annotations
 
 import dataclasses
+import threading
 
 from gpumounter_tpu.actuation.mount import TPUMounter, can_mount
 from gpumounter_tpu.allocator import TPUAllocator
@@ -72,19 +73,46 @@ class TPUMountService:
         self.mounter = mounter
         self.kube = kube
         self.settings = settings or Settings()
+        # Per-request fencing: a gateway retry can arrive while the original
+        # handler is still executing in this process (UNAVAILABLE from a
+        # connection blip, not a worker death). Serialising same-request_id
+        # AddTPUs makes the retry's adoption LIST see the COMPLETE slave-pod
+        # set of the original instead of a mid-create subset. Bounded LRU —
+        # ids are per-HTTP-request, stale entries are harmless.
+        self._request_locks: dict[tuple[str, str, str], threading.Lock] = {}
+        self._request_locks_guard = threading.Lock()
+
+    def _request_lock(self, namespace: str, pod_name: str,
+                      request_id: str) -> threading.Lock:
+        key = (namespace, pod_name, request_id)
+        with self._request_locks_guard:
+            lock = self._request_locks.get(key)
+            if lock is None:
+                if len(self._request_locks) >= 1024:
+                    self._request_locks.pop(next(iter(self._request_locks)))
+                lock = self._request_locks[key] = threading.Lock()
+            return lock
 
     # -- AddTPU (ref server.go:35-100) -----------------------------------------
 
     def add_tpu(self, pod_name: str, namespace: str, tpu_num: int,
-                is_entire_mount: bool, txn_id: str = "") -> AddOutcome:
+                is_entire_mount: bool, txn_id: str = "",
+                request_id: str = "") -> AddOutcome:
         with REGISTRY.attach_latency.time():
-            outcome = self._add_tpu(pod_name, namespace, tpu_num,
-                                    is_entire_mount, txn_id)
+            if request_id:
+                with self._request_lock(namespace, pod_name, request_id):
+                    outcome = self._add_tpu(pod_name, namespace, tpu_num,
+                                            is_entire_mount, txn_id,
+                                            request_id)
+            else:
+                outcome = self._add_tpu(pod_name, namespace, tpu_num,
+                                        is_entire_mount, txn_id, request_id)
         REGISTRY.attach_results.inc(result=outcome.result.name)
         return outcome
 
     def _add_tpu(self, pod_name: str, namespace: str, tpu_num: int,
-                 is_entire_mount: bool, txn_id: str = "") -> AddOutcome:
+                 is_entire_mount: bool, txn_id: str = "",
+                 request_id: str = "") -> AddOutcome:
         if tpu_num <= 0:
             raise MountPolicyError(f"tpu_num must be >= 1, got {tpu_num}")
         try:
@@ -99,12 +127,25 @@ class TPUMountService:
                 message=f"pod {namespace}/{pod_name} is "
                         f"{objects.phase(pod) or 'unknown'}, not Running")
 
-        current = self.allocator.get_mount_type(pod_name, namespace)
-        if not can_mount(current, is_entire_mount):
-            raise MountPolicyError(
-                f"pod {namespace}/{pod_name} has mount type {current.value}; "
-                f"{'entire' if is_entire_mount else 'single'}-mount denied "
-                "(ref util.go:207-226)")
+        # Idempotent retry: when a prior attempt of this exact request
+        # already created slave pods (worker died / reply lost before the
+        # caller saw it), this call is a RESUME — the policy check already
+        # passed for the original attempt, and re-running it would self-deny
+        # (the prior attempt's pods make the pod look entire-mounted).
+        adopt = (self.allocator.request_slave_pods(pod_name, namespace,
+                                                   request_id)
+                 if request_id else set())
+        if adopt:
+            logger.info("AddTPU resume of request %s for %s/%s",
+                        request_id, namespace, pod_name)
+        else:
+            current = self.allocator.get_mount_type(pod_name, namespace)
+            if not can_mount(current, is_entire_mount):
+                raise MountPolicyError(
+                    f"pod {namespace}/{pod_name} has mount type "
+                    f"{current.value}; "
+                    f"{'entire' if is_entire_mount else 'single'}-mount "
+                    "denied (ref util.go:207-226)")
 
         # entire ⇒ one slave pod holding all N chips (atomic, topology-aligned
         # on GKE whole-host granularity); single ⇒ N one-chip slave pods
@@ -112,7 +153,8 @@ class TPUMountService:
         per_pod = tpu_num if is_entire_mount else 1
         try:
             chips, slaves = self.allocator.get_available_tpus(
-                pod, tpu_num, per_pod, txn_id=txn_id)
+                pod, tpu_num, per_pod, txn_id=txn_id,
+                request_id=request_id, adopt=adopt)
         except InsufficientTPUError as e:
             return AddOutcome(consts.AddResult.INSUFFICIENT_TPU,
                               message=str(e))

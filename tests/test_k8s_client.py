@@ -222,3 +222,23 @@ def test_incluster_requires_env_when_no_host():
     finally:
         if old is not None:
             os.environ["KUBERNETES_SERVICE_HOST"] = old
+
+
+def test_fake_list_version_seeds_watch_resume():
+    """watch_pods(resource_version=rv_from_list) delivers exactly the events
+    recorded after the LIST — the no-lost-event contract the allocator's
+    wait loops rely on."""
+    kube = FakeKubeClient()
+    kube.put_pod({"metadata": {"name": "a", "namespace": "ns"},
+                  "status": {"phase": "Pending"}})
+    pods, rv = kube.list_pods_with_version("ns")
+    assert len(pods) == 1 and rv == "1"
+    kube.set_pod_status("ns", "a", phase="Running")       # event after LIST
+    events = list(kube.watch_pods("ns", timeout_s=0.3, resource_version=rv))
+    assert [(t, p["status"]["phase"]) for t, p in events] == \
+        [("MODIFIED", "Running")]
+    # each event object carries its resourceVersion like a real apiserver
+    assert events[0][1]["metadata"]["resourceVersion"] == "2"
+    # and a fresh watch without a version still replays history
+    all_events = list(kube.watch_pods("ns", timeout_s=0.3))
+    assert len(all_events) == 2

@@ -1,0 +1,114 @@
+"""Multi-container pods: actuation and busy detection must cover EVERY
+container, not just the first.
+
+The reference used pids[0] of the first container (util.go:50), so a device
+holder living in a second container was invisible to the busy pre-check and
+detach could yank a device in active use — SURVEY.md §8 lists this as a
+quirk not to replicate."""
+
+import pytest
+
+from gpumounter_tpu.testing.sim import WorkerRig, make_target_pod
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.errors import DeviceBusyError
+
+CID_MAIN = "containerd://" + "ab" * 32
+CID_SIDE = "containerd://" + "cd" * 32
+
+
+def make_two_container_pod(name="multi", uid="uid-multi"):
+    pod = make_target_pod(name=name, container_id=CID_MAIN, uid=uid)
+    pod["spec"]["containers"].append({"name": "side", "resources": {}})
+    pod["status"]["containerStatuses"].append(
+        {"name": "side", "containerID": CID_SIDE})
+    return pod
+
+
+@pytest.fixture
+def rig(fake_host):
+    r = WorkerRig(fake_host, n_chips=4)
+    yield r
+    r.close()
+
+
+@pytest.fixture
+def multi_pod(rig):
+    pod = make_two_container_pod()
+    rig.sim.kube.put_pod(pod)
+    pids = rig.provision_container(pod)
+    return pod, pids
+
+
+def test_mount_actuates_every_container(rig, multi_pod):
+    pod, pids = multi_pod
+    outcome = rig.service.add_tpu("multi", "default", 2, True)
+    assert outcome.result == consts.AddResult.SUCCESS
+    created_pids = {entry[0] for entry in rig.actuator.created}
+    assert created_pids == set(pids.values())        # nodes in BOTH containers
+    # and both containers' cgroups got device access
+    for cid in (CID_MAIN, CID_SIDE):
+        allow = rig.cgroups.container_dir(pod, cid) + "/devices.allow"
+        with open(allow) as f:
+            assert "c 120:" in f.read()
+
+
+def test_holder_in_second_container_blocks_detach(rig, multi_pod):
+    pod, pids = multi_pod
+    outcome = rig.service.add_tpu("multi", "default", 2, True)
+    chip = outcome.chips[0]
+    side_pid = pids[CID_SIDE]
+    rig.sim.enumerator.busy_pids = {chip.device_path: [side_pid]}
+
+    result = rig.service.remove_tpu("multi", "default", [], force=False)
+    assert result.result == consts.RemoveResult.TPU_BUSY
+    assert result.busy_pids == [side_pid]
+    assert len(rig.sim.slave_pods()) == 1            # nothing detached
+
+
+def test_pod_device_processes_sees_all_containers(rig, multi_pod):
+    pod, pids = multi_pod
+    outcome = rig.service.add_tpu("multi", "default", 1, True)
+    chip = outcome.chips[0]
+    rig.sim.enumerator.busy_pids = {
+        chip.device_path: [pids[CID_MAIN], pids[CID_SIDE]]}
+    holders = rig.mounter.pod_device_processes(pod, chip)
+    assert sorted(holders) == sorted(pids.values())
+
+
+def test_force_detach_kills_holder_in_second_container(rig, multi_pod):
+    pod, pids = multi_pod
+    outcome = rig.service.add_tpu("multi", "default", 2, True)
+    chip = outcome.chips[0]
+    side_pid = pids[CID_SIDE]
+    rig.sim.enumerator.busy_pids = {chip.device_path: [side_pid]}
+
+    result = rig.service.remove_tpu("multi", "default", [], force=True)
+    assert result.result == consts.RemoveResult.SUCCESS
+    assert (side_pid, 9) in rig.actuator.killed
+    # device nodes removed from both containers
+    removed_pids = {entry[0] for entry in rig.actuator.removed}
+    assert removed_pids == set(pids.values())
+
+
+def test_dead_sidecar_does_not_block_actuation(rig):
+    """A terminated sidecar keeps its containerID in pod status but has no
+    cgroup: actuation must skip it and serve the live container (a completed
+    init-style sidecar must not break AddTPU)."""
+    pod = make_two_container_pod(name="deadside", uid="uid-deadside")
+    rig.sim.kube.put_pod(pod)
+    # provision ONLY the main container's cgroup; the sidecar is dead
+    import os
+    from gpumounter_tpu.k8s import objects
+    cid = CID_MAIN
+    cgroup_dir = rig.cgroups.container_dir(pod, cid)
+    os.makedirs(cgroup_dir, exist_ok=True)
+    with open(os.path.join(cgroup_dir, "cgroup.procs"), "w") as f:
+        f.write("7777\n")
+    os.makedirs(os.path.join(rig.host.proc_root, "7777"), exist_ok=True)
+
+    outcome = rig.service.add_tpu("deadside", "default", 2, True)
+    assert outcome.result == consts.AddResult.SUCCESS
+    assert {entry[0] for entry in rig.actuator.created} == {7777}
+
+    result = rig.service.remove_tpu("deadside", "default", [], force=False)
+    assert result.result == consts.RemoveResult.SUCCESS

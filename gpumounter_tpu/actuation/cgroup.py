@@ -19,7 +19,8 @@ from __future__ import annotations
 
 import os
 
-from gpumounter_tpu.actuation.bpf import BpfGate, rules_for_chips
+from gpumounter_tpu.actuation.bpf import (BpfGate, container_device_rules,
+                                          rules_for_chips)
 from gpumounter_tpu.device.model import TPUChip
 from gpumounter_tpu.k8s import objects
 from gpumounter_tpu.utils import consts
@@ -196,14 +197,34 @@ class CgroupDeviceController:
         cgroup_dir = self._v2_cgroup_dir(pod, container_id)
         if not os.path.isdir(cgroup_dir):
             raise CgroupError(f"container cgroup not found: {cgroup_dir}")
+        # The replacement program must preserve every device the runtime
+        # already granted this container (spec devices, device plugins, GKE
+        # extras) — assumed-runc-defaults alone would silently revoke them.
+        # Ground truth is the container's live /dev, read through procfs.
+        observed: list = []
+        try:
+            for pid in self.get_pids(pod, container_id):
+                if os.path.isdir(os.path.join(self.host.proc_root,
+                                              str(pid))):
+                    observed = container_device_rules(self.host.proc_root,
+                                                      pid)
+                    break
+            else:
+                logger.warning(
+                    "no live PID in container %s; v2 sync proceeds with "
+                    "defaults+chips only", container_id)
+        except CgroupError as e:
+            logger.warning("cannot read container PIDs (%s); v2 sync "
+                           "proceeds with defaults+chips only", e)
         try:
             if self._gate is None:
                 self._gate = BpfGate()
-            rc = self._gate.sync(cgroup_dir, rules_for_chips(chips))
+            rc = self._gate.sync(cgroup_dir,
+                                 rules_for_chips(chips, observed=observed))
         except OSError as e:
             raise CgroupError(
                 f"BPF device-gate sync on {cgroup_dir} failed ({e}); "
                 "is this a cgroup2 mount and does the worker have CAP_BPF + "
                 "CAP_SYS_ADMIN?") from e
-        logger.debug("v2 sync %s -> rc=%d (%d chips)", cgroup_dir, rc,
-                     len(chips))
+        logger.debug("v2 sync %s -> rc=%d (%d chips, %d observed rules)",
+                     cgroup_dir, rc, len(chips), len(observed))

@@ -71,6 +71,7 @@ struct bpf_insn {
 #define BPF_CMD_PROG_DETACH 9
 #define BPF_CMD_PROG_QUERY 16
 #define BPF_CMD_PROG_GET_FD_BY_ID 13
+#define BPF_CMD_OBJ_GET_INFO_BY_FD 15
 // attach flags
 #define BPF_F_ALLOW_MULTI (1u << 1)
 #define BPF_F_REPLACE (1u << 2)
@@ -118,6 +119,24 @@ struct bpf_attr_query {
 
 struct bpf_attr_get_fd_by_id {
   uint32_t id;
+};
+
+struct bpf_attr_obj_info {
+  uint32_t bpf_fd;
+  uint32_t info_len;
+  uint64_t info;
+};
+
+// Leading fields of struct bpf_prog_info (kernel tolerates a truncated
+// info_len and fills only what fits) — enough for xlated read-back.
+struct bpf_prog_info_min {
+  uint32_t type;
+  uint32_t id;
+  uint8_t tag[8];
+  uint32_t jited_prog_len;
+  uint32_t xlated_prog_len;
+  uint64_t jited_prog_insns;
+  uint64_t xlated_prog_insns;
 };
 
 static long sys_bpf(int cmd, void* attr, unsigned int size) {
@@ -337,6 +356,121 @@ int bpfgate_sync(const char* cgroup_path, const DeviceRule* rules,
   return rc;
 }
 
-int bpfgate_abi_version(void) { return 1; }
+// Number of device programs attached to the cgroup, or negative errno.
+int bpfgate_attached_count(const char* cgroup_path) {
+  if (!cgroup_path) return -EINVAL;
+  int cg_fd = open(cgroup_path, O_RDONLY | O_DIRECTORY);
+  if (cg_fd < 0) return -errno;
+  bpf_attr_query q{};
+  q.target_fd = static_cast<uint32_t>(cg_fd);
+  q.attach_type = BPF_CGROUP_DEVICE;
+  q.prog_cnt = 0;  // count-only query
+  long rc = sys_bpf(BPF_CMD_PROG_QUERY, &q, sizeof(q));
+  int e = errno;
+  close(cg_fd);
+  if (rc < 0 && e != ENOSPC) return -e;
+  return static_cast<int>(q.prog_cnt);
+}
+
+// Read back the xlated instructions of attached program `index` on the
+// cgroup. CGROUP_DEVICE programs have no ctx-access rewriting, so the
+// xlated stream is directly interpretable (used for preservation checks and
+// the kernel-proven tests). Returns instruction count, or negative errno
+// (-ENOENT when index is out of range, -E2BIG when out is too small).
+// Requires CAP_SYS_ADMIN/CAP_PERFMON for xlated visibility.
+int bpfgate_read_attached(const char* cgroup_path, int index, bpf_insn* out,
+                          int max_insns) {
+  if (!cgroup_path || !out || index < 0) return -EINVAL;
+  int cg_fd = open(cgroup_path, O_RDONLY | O_DIRECTORY);
+  if (cg_fd < 0) return -errno;
+
+  uint32_t prog_ids[16] = {0};
+  bpf_attr_query q{};
+  q.target_fd = static_cast<uint32_t>(cg_fd);
+  q.attach_type = BPF_CGROUP_DEVICE;
+  q.prog_ids = reinterpret_cast<uint64_t>(prog_ids);
+  q.prog_cnt = 16;
+  if (sys_bpf(BPF_CMD_PROG_QUERY, &q, sizeof(q)) < 0) {
+    int e = errno;
+    close(cg_fd);
+    return -e;
+  }
+  close(cg_fd);
+  if (static_cast<uint32_t>(index) >= q.prog_cnt) return -ENOENT;
+
+  bpf_attr_get_fd_by_id get{};
+  get.id = prog_ids[index];
+  long prog_fd = sys_bpf(BPF_CMD_PROG_GET_FD_BY_ID, &get, sizeof(get));
+  if (prog_fd < 0) return -errno;
+
+  bpf_prog_info_min info{};
+  bpf_attr_obj_info oi{};
+  oi.bpf_fd = static_cast<uint32_t>(prog_fd);
+  oi.info_len = sizeof(info);
+  oi.info = reinterpret_cast<uint64_t>(&info);
+  if (sys_bpf(BPF_CMD_OBJ_GET_INFO_BY_FD, &oi, sizeof(oi)) < 0) {
+    int e = errno;
+    close(static_cast<int>(prog_fd));
+    return -e;
+  }
+  int n = static_cast<int>(info.xlated_prog_len / sizeof(bpf_insn));
+  if (n > max_insns) {
+    close(static_cast<int>(prog_fd));
+    return -E2BIG;
+  }
+  std::vector<bpf_insn> buf(n);
+  bpf_prog_info_min info2{};
+  info2.xlated_prog_len = static_cast<uint32_t>(n * sizeof(bpf_insn));
+  info2.xlated_prog_insns = reinterpret_cast<uint64_t>(buf.data());
+  oi.info_len = sizeof(info2);
+  oi.info = reinterpret_cast<uint64_t>(&info2);
+  if (sys_bpf(BPF_CMD_OBJ_GET_INFO_BY_FD, &oi, sizeof(oi)) < 0) {
+    int e = errno;
+    close(static_cast<int>(prog_fd));
+    return -e;
+  }
+  close(static_cast<int>(prog_fd));
+  n = static_cast<int>(info2.xlated_prog_len / sizeof(bpf_insn));
+  memcpy(out, buf.data(), n * sizeof(bpf_insn));
+  return n;
+}
+
+// Attach a fresh allowlist program the way a container runtime would
+// (BPF_F_ALLOW_MULTI, no replace). Used by the kernel-proven tests to stand
+// up a "runc-attached" baseline on a scratch cgroup; production code only
+// ever replaces via bpfgate_sync. Returns 1 or negative errno.
+int bpfgate_attach(const char* cgroup_path, const DeviceRule* rules,
+                   int n_rules) {
+  if (!cgroup_path || (!rules && n_rules > 0)) return -EINVAL;
+  int cg_fd = open(cgroup_path, O_RDONLY | O_DIRECTORY);
+  if (cg_fd < 0) return -errno;
+  std::vector<bpf_insn> p = build_program(rules, n_rules);
+  bpf_attr_prog_load load{};
+  load.prog_type = BPF_PROG_TYPE_CGROUP_DEVICE;
+  load.insn_cnt = static_cast<uint32_t>(p.size());
+  load.insns = reinterpret_cast<uint64_t>(p.data());
+  static const char license[] = "Apache-2.0";
+  load.license = reinterpret_cast<uint64_t>(license);
+  load.expected_attach_type = BPF_CGROUP_DEVICE;
+  snprintf(load.prog_name, sizeof(load.prog_name), "runtime_dev");
+  long prog_fd = sys_bpf(BPF_CMD_PROG_LOAD, &load, sizeof(load));
+  if (prog_fd < 0) {
+    int e = errno;
+    close(cg_fd);
+    return -e;
+  }
+  bpf_attr_attach att{};
+  att.target_fd = static_cast<uint32_t>(cg_fd);
+  att.attach_bpf_fd = static_cast<uint32_t>(prog_fd);
+  att.attach_type = BPF_CGROUP_DEVICE;
+  att.attach_flags = BPF_F_ALLOW_MULTI;
+  int rc = 1;
+  if (sys_bpf(BPF_CMD_PROG_ATTACH, &att, sizeof(att)) < 0) rc = -errno;
+  close(static_cast<int>(prog_fd));
+  close(cg_fd);
+  return rc;
+}
+
+int bpfgate_abi_version(void) { return 2; }
 
 }  // extern "C"

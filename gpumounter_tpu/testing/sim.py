@@ -91,13 +91,19 @@ class ClusterSim:
 
     def __init__(self, n_chips=4, node="node-a", schedule_delay_s=0.0,
                  settings: Settings | None = None,
-                 kubelet_socket_path: str | None = None):
+                 kubelet_socket_path: str | None = None,
+                 kubelet_lag_s: float = 0.0):
         self.node = node
         self.settings = settings or Settings()
         self.enumerator = FakeEnumerator(make_chips(n_chips))
         self.podresources = FakePodResourcesClient()
         self.kube = FakeKubeClient()
         self.schedule_delay_s = schedule_delay_s
+        # When >0, the PodResources listing trails the Running transition by
+        # this long — the real kubelet's asynchronous device-plugin
+        # assignment (the allocator must tolerate it with bounded retries).
+        self.kubelet_lag_s = kubelet_lag_s
+        self._pending_assign: dict[tuple[str, str], list[str]] = {}
         self._lock = threading.Lock()
         self.kube.on_create.append(self._schedule)
         self.kube.on_delete.append(self._release)
@@ -131,6 +137,8 @@ class ClusterSim:
             for resources in containers.values()
             for ids in resources.values()
             for device_id in ids}
+        assigned |= {u for uuids in self._pending_assign.values()
+                     for u in uuids}
         return [c.uuid for c in self.enumerator.chips
                 if c.uuid not in assigned]
 
@@ -142,6 +150,7 @@ class ClusterSim:
             self.kube.set_pod_status(objects.namespace(pod),
                                      objects.name(pod), phase="Running")
             return
+        key = (objects.namespace(pod), objects.name(pod))
         with self._lock:
             free = self._free_uuids()
             if len(free) < want:
@@ -151,13 +160,29 @@ class ClusterSim:
                     conditions=[{"type": "PodScheduled", "status": "False",
                                  "reason": "Unschedulable"}])
                 return
-            self.podresources.assign(objects.namespace(pod),
-                                     objects.name(pod), free[:want])
+            if self.kubelet_lag_s > 0:
+                # reserve now, surface in PodResources only after the lag
+                self._pending_assign[key] = free[:want]
+                timer = threading.Timer(self.kubelet_lag_s,
+                                        self._apply_pending, args=(key,))
+                timer.daemon = True
+                timer.start()
+            else:
+                self.podresources.assign(key[0], key[1], free[:want])
         self.kube.set_pod_status(
             objects.namespace(pod), objects.name(pod), phase="Running",
             conditions=[{"type": "PodScheduled", "status": "True"}])
 
+    def _apply_pending(self, key: tuple[str, str]) -> None:
+        with self._lock:
+            uuids = self._pending_assign.pop(key, None)
+        if uuids:
+            self.podresources.assign(key[0], key[1], uuids)
+
     def _release(self, pod: objects.Pod) -> None:
+        with self._lock:
+            self._pending_assign.pop(
+                (objects.namespace(pod), objects.name(pod)), None)
         self.podresources.unassign(objects.namespace(pod), objects.name(pod))
 
     # -- conveniences ----------------------------------------------------------
@@ -185,7 +210,8 @@ class WorkerRig:
 
     def __init__(self, fake_host, n_chips=4, pid=4242, actuator="recording",
                  use_kubelet_socket=False, node="node-a",
-                 pod_name="workload", schedule_delay_s=0.0):
+                 pod_name="workload", schedule_delay_s=0.0,
+                 kubelet_lag_s=0.0):
         from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
         from gpumounter_tpu.actuation.mount import TPUMounter
         from gpumounter_tpu.actuation.nsenter import (ProcRootActuator,
@@ -195,6 +221,7 @@ class WorkerRig:
 
         self.sim = ClusterSim(
             n_chips=n_chips, node=node, schedule_delay_s=schedule_delay_s,
+            kubelet_lag_s=kubelet_lag_s,
             kubelet_socket_path=(fake_host.kubelet_socket
                                  if use_kubelet_socket else None))
         self.sim.settings.host = fake_host

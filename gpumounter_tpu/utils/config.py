@@ -45,6 +45,11 @@ class Settings:
     # Watch deadline for slave-pod create/delete state machines. Replaces the
     # reference's unbounded busy-polls (allocator.go:247-282, :296-317).
     allocation_timeout_s: float = 120.0
+    # On a real node the kubelet's PodResources listing can lag a slave
+    # pod's Running transition by a beat (device-plugin assignment is
+    # asynchronous); chip collection retries within this bound before
+    # declaring the allocation failed.
+    kubelet_lag_timeout_s: float = 10.0
     host: HostPaths = dataclasses.field(default_factory=HostPaths)
 
     @classmethod
@@ -62,4 +67,6 @@ class Settings:
         s.node_name = env.get("NODE_NAME", "")
         if t := env.get("TPU_ALLOCATION_TIMEOUT_S"):
             s.allocation_timeout_s = float(t)
+        if t := env.get("TPU_KUBELET_LAG_TIMEOUT_S"):
+            s.kubelet_lag_timeout_s = float(t)
         return s

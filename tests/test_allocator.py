@@ -224,3 +224,35 @@ def test_wait_running_recovers_from_410_gone():
     kube.after_list = lambda: kube.set_pod_status("tpu-pool", "s1",
                                                   phase="Running")
     alloc._wait_running(["s1"])
+
+
+# -- kubelet PodResources lag (VERDICT weak #4) --------------------------------
+
+
+def test_kubelet_lag_tolerated_with_bounded_retry(fake_host):
+    """The PodResources listing trails the Running transition by 0.8s (the
+    real device plugin is asynchronous): allocation must retry and
+    succeed, not raise InsufficientTPU on the first empty read."""
+    from tests.helpers import WorkerRig
+    rig = WorkerRig(fake_host, n_chips=4, kubelet_lag_s=0.8)
+    try:
+        outcome = rig.service.add_tpu("workload", "default", 4, True)
+        assert outcome.result == consts.AddResult.SUCCESS
+        assert len(outcome.chips) == 4
+    finally:
+        rig.close()
+
+
+def test_kubelet_lag_beyond_bound_fails_cleanly(fake_host):
+    """Lag past the bound is a failure — with every slave pod this call
+    created cleaned up."""
+    from tests.helpers import WorkerRig
+    rig = WorkerRig(fake_host, n_chips=4, kubelet_lag_s=5.0)
+    rig.sim.settings.kubelet_lag_timeout_s = 0.3
+    try:
+        outcome = rig.service.add_tpu("workload", "default", 4, True)
+        assert outcome.result == consts.AddResult.INSUFFICIENT_TPU
+        assert "reports no" in outcome.message
+        assert rig.sim.slave_pods() == []
+    finally:
+        rig.close()

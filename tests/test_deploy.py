@@ -61,6 +61,27 @@ def test_worker_privileges_and_mounts():
     assert consts.WORKER_GRPC_PORT in ports
 
 
+def test_worker_lands_on_every_tpu_nodepool():
+    """Affinity must be Exists on the accelerator label — a value-pinned
+    nodeSelector would strand v4/v5p/v6e nodes, whose device shapes the
+    enumerator supports (device/enumerator.py), with no worker."""
+    (worker,) = load("tpu-mounter-workers.yaml")
+    spec = worker["spec"]["template"]["spec"]
+    assert "nodeSelector" not in spec
+    terms = (spec["affinity"]["nodeAffinity"]
+             ["requiredDuringSchedulingIgnoredDuringExecution"]
+             ["nodeSelectorTerms"])
+    exprs = [e for t in terms for e in t["matchExpressions"]]
+    accel = [e for e in exprs
+             if e["key"] == "cloud.google.com/gke-tpu-accelerator"]
+    assert accel and all(e["operator"] == "Exists"
+                         and "values" not in e for e in accel)
+    # and the taint toleration stays, or no TPU node will admit it
+    assert any(t.get("key") == "google.com/tpu"
+               and t.get("operator") == "Exists"
+               for t in spec["tolerations"])
+
+
 def test_service_targets_master_port():
     (svc,) = load("tpu-mounter-svc.yaml")
     assert svc["spec"]["ports"][0]["targetPort"] == consts.MASTER_HTTP_PORT

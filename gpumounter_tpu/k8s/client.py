@@ -8,11 +8,17 @@ is all the control plane needs: pod get/list/create/delete plus **watch**
 streams. Watches are what replace the reference's unbounded apiserver
 busy-polls (``allocator.go:247-282``) with event-driven waits.
 
-Two implementations of one interface:
+Three implementations of one interface:
 
 - :class:`InClusterKubeClient` — production; reads the serviceaccount token /
   CA / namespace like client-go's ``rest.InClusterConfig`` and talks HTTPS to
   ``$KUBERNETES_SERVICE_HOST``.
+- :class:`KubeconfigKubeClient` — dev / out-of-cluster; parses the
+  current-context of ``$KUBECONFIG`` / ``~/.kube/config`` (server + CA, bearer
+  token or client cert). The reference only stubbed this path with a
+  hardcoded placeholder (``pkg/config/config.go:18-28``); here it is real.
+  :func:`default_kube_client` picks between the two the way client-go's
+  ``clientcmd`` fallback chain does.
 - :class:`FakeKubeClient` — tests; an in-memory pod store with a pluggable
   "scheduler" hook so tests can script kubelet/scheduler behaviour
   (pod goes Running, goes Unschedulable, never schedules, ...).
@@ -90,42 +96,22 @@ class KubeClient(abc.ABC):
         :class:`K8sApiError` (status 404 for unknown nodes)."""
 
 
-# -- production client ---------------------------------------------------------
+# -- production clients --------------------------------------------------------
 
 
-class InClusterKubeClient(KubeClient):
-    """Talks to the apiserver with the pod's serviceaccount credentials.
+class RestKubeClient(KubeClient):
+    """Shared REST/watch machinery; subclasses supply endpoint + credentials.
 
-    Mirrors client-go in-cluster config: host/port from
-    ``KUBERNETES_SERVICE_HOST/PORT``, bearer token + CA from the mounted
-    serviceaccount volume (ref ``pkg/config/config.go:18-28``).
+    Subclasses set ``self.base`` (URL) and ``self._ssl`` (context or None) and
+    implement :meth:`_token` (empty string ⇒ no Authorization header, e.g.
+    client-cert auth carried by the ssl context instead).
     """
 
-    def __init__(self, host: str | None = None,
-                 sa_dir: str = SERVICEACCOUNT_DIR):
-        if host is None:
-            khost = os.environ.get("KUBERNETES_SERVICE_HOST")
-            kport = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
-            if not khost:
-                raise K8sApiError(
-                    0, "KUBERNETES_SERVICE_HOST unset: not running in-cluster")
-            host = f"https://{khost}:{kport}"
-        self.base = host.rstrip("/")
-        self._sa_dir = sa_dir
-        self._token_path = os.path.join(sa_dir, "token")
-        ca_path = os.path.join(sa_dir, "ca.crt")
-        if os.path.exists(ca_path):
-            self._ssl = ssl.create_default_context(cafile=ca_path)
-        else:  # e.g. test apiserver over plain http
-            self._ssl = None
+    base: str
+    _ssl: ssl.SSLContext | None
 
     def _token(self) -> str:
-        # Re-read every request: serviceaccount tokens are rotated by kubelet.
-        try:
-            with open(self._token_path) as f:
-                return f.read().strip()
-        except OSError:
-            return ""
+        return ""
 
     def _request(self, method: str, path: str,
                  query: dict[str, str] | None = None,
@@ -239,6 +225,204 @@ class InClusterKubeClient(KubeClient):
             # cleanup paths (allocator rollback) engage instead of a raw
             # ConnectionResetError escaping the iterator.
             raise K8sApiError(0, f"watch stream broken: {e}") from e
+
+
+class InClusterKubeClient(RestKubeClient):
+    """Talks to the apiserver with the pod's serviceaccount credentials.
+
+    Mirrors client-go in-cluster config: host/port from
+    ``KUBERNETES_SERVICE_HOST/PORT``, bearer token + CA from the mounted
+    serviceaccount volume (ref ``pkg/config/config.go:18-28``).
+    """
+
+    def __init__(self, host: str | None = None,
+                 sa_dir: str = SERVICEACCOUNT_DIR):
+        if host is None:
+            khost = os.environ.get("KUBERNETES_SERVICE_HOST")
+            kport = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not khost:
+                raise K8sApiError(
+                    0, "KUBERNETES_SERVICE_HOST unset: not running in-cluster")
+            host = f"https://{khost}:{kport}"
+        self.base = host.rstrip("/")
+        self._sa_dir = sa_dir
+        self._token_path = os.path.join(sa_dir, "token")
+        ca_path = os.path.join(sa_dir, "ca.crt")
+        if os.path.exists(ca_path):
+            self._ssl = ssl.create_default_context(cafile=ca_path)
+        else:  # e.g. test apiserver over plain http
+            self._ssl = None
+
+    def _token(self) -> str:
+        # Re-read every request: serviceaccount tokens are rotated by kubelet.
+        try:
+            with open(self._token_path) as f:
+                return f.read().strip()
+        except OSError:
+            return ""
+
+
+class KubeconfigKubeClient(RestKubeClient):
+    """Out-of-cluster client configured from a kubeconfig file.
+
+    Resolves the ``current-context`` (overridable via ``context``) to a
+    cluster (server URL, CA bundle, optional insecure-skip-tls-verify) and a
+    user (bearer token / tokenFile, or client certificate+key — inline
+    ``*-data`` base64 fields or file paths). Exec plugins / auth-provider
+    refresh flows are out of scope and raise a clear error rather than
+    silently sending unauthenticated requests.
+
+    The reference left this path as a hardcoded placeholder
+    (``pkg/config/config.go:18-28``: "Need fix if out of cluster deploy");
+    this is the real implementation.
+    """
+
+    def __init__(self, path: str | None = None, context: str | None = None):
+        if path is None:
+            # $KUBECONFIG is a colon-separated path list (client-go
+            # semantics); full multi-file merging is out of scope — use the
+            # first entry that exists.
+            env = os.environ.get("KUBECONFIG", "")
+            candidates = [p for p in env.split(os.pathsep) if p] or \
+                [os.path.expanduser("~/.kube/config")]
+            path = next((p for p in candidates if os.path.exists(p)),
+                        candidates[0])
+        try:
+            with open(path) as f:
+                cfg = _load_kubeconfig_yaml(f.read())
+        except OSError as e:
+            raise K8sApiError(0, f"kubeconfig unreadable: {path}: {e}") from e
+        except Exception as e:  # yaml.YAMLError et al: keep the typed contract
+            raise K8sApiError(0, f"kubeconfig unparseable: {path}: {e}") from e
+        if not isinstance(cfg, dict):
+            raise K8sApiError(0, f"kubeconfig {path}: not a mapping")
+        ctx_name = context or cfg.get("current-context")
+        if not ctx_name:
+            raise K8sApiError(0, f"kubeconfig {path}: no current-context")
+        ctx = _named_entry(cfg, "contexts", ctx_name, "context")
+        cluster = _named_entry(cfg, "clusters", ctx.get("cluster"), "cluster")
+        user = _named_entry(cfg, "users", ctx.get("user"), "user") \
+            if ctx.get("user") else {}
+
+        server = cluster.get("server", "")
+        if not server:
+            raise K8sApiError(0, f"kubeconfig {path}: cluster has no server")
+        self.base = server.rstrip("/")
+        self._kubeconfig_path = path
+        self.context_name = ctx_name
+        self.namespace = ctx.get("namespace", "default")
+
+        for key in ("exec", "auth-provider"):
+            if user.get(key):
+                raise K8sApiError(
+                    0, f"kubeconfig {path}: user uses '{key}' auth, which is "
+                       "unsupported — use a token or client certificate")
+
+        self._static_token = user.get("token", "")
+        self._token_file = user.get("tokenFile", "")
+        if self._token_file and not os.path.isabs(self._token_file):
+            # client-go's ResolveLocalPaths: relative to the kubeconfig.
+            self._token_file = os.path.join(
+                os.path.dirname(path), self._token_file)
+
+        self._ssl = None
+        if self.base.startswith("https"):
+            try:
+                with _Materialised(cluster, "certificate-authority",
+                                   path) as ca, \
+                     _Materialised(user, "client-certificate", path) as cert, \
+                     _Materialised(user, "client-key", path) as key:
+                    if cluster.get("insecure-skip-tls-verify"):
+                        self._ssl = ssl._create_unverified_context()
+                    elif ca.file:
+                        self._ssl = ssl.create_default_context(cafile=ca.file)
+                    else:
+                        self._ssl = ssl.create_default_context()
+                    if cert.file:
+                        self._ssl.load_cert_chain(cert.file, key.file or None)
+            except K8sApiError:
+                raise
+            except (OSError, ssl.SSLError) as e:
+                raise K8sApiError(
+                    0, f"kubeconfig {path}: TLS material unusable: {e}") from e
+
+    def _token(self) -> str:
+        if self._static_token:
+            return self._static_token
+        if self._token_file:
+            try:
+                with open(self._token_file) as f:
+                    return f.read().strip()
+            except OSError as e:
+                # Never degrade to anonymous requests (class contract).
+                raise K8sApiError(
+                    0, f"kubeconfig tokenFile unreadable: "
+                       f"{self._token_file}: {e}") from e
+        return ""
+
+
+def _load_kubeconfig_yaml(text: str) -> Any:
+    import yaml  # deferred: only the out-of-cluster path needs it
+    return yaml.safe_load(text)
+
+
+def _named_entry(cfg: dict, section: str, name: str | None,
+                 inner: str) -> dict:
+    for item in cfg.get(section) or []:
+        if isinstance(item, dict) and item.get("name") == name:
+            return item.get(inner) or {}
+    raise K8sApiError(
+        0, f"kubeconfig: no entry named {name!r} in {section!r}")
+
+
+class _Materialised:
+    """Context manager resolving ``<field>`` (a file path, relative to the
+    kubeconfig) or ``<field>-data`` (inline base64) to an on-disk path the
+    ssl module can load. Inline data — which may be a client private key —
+    goes to a mode-0600 temp file that is deleted on exit, so secrets never
+    outlive the ssl-context construction."""
+
+    def __init__(self, entry: dict, field: str, kubeconfig_path: str):
+        self.file = ""
+        self._tmp = None
+        data = entry.get(f"{field}-data")
+        if data:
+            import base64
+            import tempfile
+            try:
+                raw = base64.b64decode(data, validate=True)
+            except Exception as e:
+                raise K8sApiError(
+                    0, f"kubeconfig: bad base64 in {field}-data: {e}") from e
+            self._tmp = tempfile.NamedTemporaryFile(
+                prefix=f"kubeconfig-{field}-", suffix=".pem")
+            self._tmp.write(raw)
+            self._tmp.flush()
+            self.file = self._tmp.name
+        else:
+            p = entry.get(field, "")
+            if p and not os.path.isabs(p):
+                p = os.path.join(os.path.dirname(kubeconfig_path), p)
+            self.file = p
+
+    def __enter__(self) -> "_Materialised":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._tmp is not None:
+            self._tmp.close()  # NamedTemporaryFile: close unlinks
+
+
+def default_kube_client() -> KubeClient:
+    """controller-runtime-style fallback chain: an explicit $KUBECONFIG
+    always wins (every in-cluster pod has KUBERNETES_SERVICE_HOST injected,
+    so the env var must be able to override it), then in-cluster, then
+    ~/.kube/config if present."""
+    if os.environ.get("KUBECONFIG"):
+        return KubeconfigKubeClient()
+    if os.environ.get("KUBERNETES_SERVICE_HOST"):
+        return InClusterKubeClient()
+    return KubeconfigKubeClient()
 
 
 # -- test fake -----------------------------------------------------------------

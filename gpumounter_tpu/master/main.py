@@ -7,7 +7,7 @@ from __future__ import annotations
 
 import time
 
-from gpumounter_tpu.k8s.client import InClusterKubeClient
+from gpumounter_tpu.k8s.client import default_kube_client
 from gpumounter_tpu.master.discovery import WorkerDirectory
 from gpumounter_tpu.master.gateway import MasterGateway
 from gpumounter_tpu.utils.config import Settings
@@ -19,7 +19,7 @@ logger = get_logger("master.main")
 
 def main() -> None:
     settings = Settings.from_env()
-    kube = InClusterKubeClient()
+    kube = default_kube_client()
     directory = WorkerDirectory(kube,
                                 namespace=settings.worker_namespace,
                                 label_selector=settings.worker_label_selector,

@@ -20,7 +20,7 @@ from gpumounter_tpu.allocator import TPUAllocator
 from gpumounter_tpu.collector.collector import TPUCollector
 from gpumounter_tpu.collector.podresources import KubeletPodResourcesClient
 from gpumounter_tpu.device.native_enumerator import best_enumerator
-from gpumounter_tpu.k8s.client import InClusterKubeClient
+from gpumounter_tpu.k8s.client import default_kube_client
 from gpumounter_tpu.utils.config import Settings
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
@@ -71,7 +71,7 @@ def build_stack(settings: Settings) -> TPUMountService:
     collector = TPUCollector(enumerator, podresources,
                              resource_name=settings.resource_name,
                              pool_namespace=settings.pool_namespace)
-    kube = InClusterKubeClient()
+    kube = default_kube_client()
     allocator = TPUAllocator(collector, kube, settings)
     cgroups = CgroupDeviceController(settings.host,
                                      driver=settings.cgroup_driver)

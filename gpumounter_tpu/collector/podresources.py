@@ -66,6 +66,7 @@ class FakePodResourcesClient(PodResourcesClient):
 
     def __init__(self, assignments: dict | None = None):
         self.assignments = assignments or {}
+        self.list_calls = 0        # tests assert O(1) LISTs per RPC
 
     def assign(self, namespace: str, pod: str, device_ids: list[str],
                container: str = "main",
@@ -77,6 +78,7 @@ class FakePodResourcesClient(PodResourcesClient):
         self.assignments.pop((namespace, pod), None)
 
     def list_pods(self) -> pb.ListPodResourcesResponse:
+        self.list_calls += 1
         resp = pb.ListPodResourcesResponse()
         for (ns, pod), containers in self.assignments.items():
             pr = resp.pod_resources.add(name=pod, namespace=ns)

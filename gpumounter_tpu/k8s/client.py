@@ -292,6 +292,8 @@ class KubeconfigKubeClient(RestKubeClient):
                 cfg = _load_kubeconfig_yaml(f.read())
         except OSError as e:
             raise K8sApiError(0, f"kubeconfig unreadable: {path}: {e}") from e
+        except K8sApiError:
+            raise
         except Exception as e:  # yaml.YAMLError et al: keep the typed contract
             raise K8sApiError(0, f"kubeconfig unparseable: {path}: {e}") from e
         if not isinstance(cfg, dict):
@@ -312,7 +314,9 @@ class KubeconfigKubeClient(RestKubeClient):
         self.context_name = ctx_name
         self.namespace = ctx.get("namespace", "default")
 
-        for key in ("exec", "auth-provider"):
+        for key in ("exec", "auth-provider", "username", "password"):
+            # Fail-closed: unsupported auth mechanisms error at construction
+            # instead of silently sending anonymous requests.
             if user.get(key):
                 raise K8sApiError(
                     0, f"kubeconfig {path}: user uses '{key}' auth, which is "
@@ -362,7 +366,12 @@ class KubeconfigKubeClient(RestKubeClient):
 
 
 def _load_kubeconfig_yaml(text: str) -> Any:
-    import yaml  # deferred: only the out-of-cluster path needs it
+    try:
+        import yaml  # deferred: only the out-of-cluster path needs it
+    except ModuleNotFoundError as e:
+        raise K8sApiError(
+            0, "kubeconfig support needs PyYAML (pip install pyyaml); "
+               "the in-cluster path does not") from e
     return yaml.safe_load(text)
 
 

@@ -69,8 +69,10 @@ def test_get_pod_tpu_resources_includes_slave_pods(collector, fake_kubelet):
     fake_kubelet.assign("tpu-pool", "other-slave-pod-ffffff", ["3"])
     chips = collector.get_pod_tpu_resources("train-pod", "default")
     assert sorted(c.uuid for c in chips) == ["0", "1", "2"]
-    assert collector.get_slave_pod_names("train-pod") == [
-        "train-pod-slave-pod-a1b2c3", "train-pod-slave-pod-d4e5f6"]
+    slave_holders = {c.pod_name for c in chips
+                     if c.namespace == "tpu-pool"}
+    assert slave_holders == {"train-pod-slave-pod-a1b2c3",
+                             "train-pod-slave-pod-d4e5f6"}
 
 
 def test_slave_pod_in_wrong_namespace_ignored(collector, fake_kubelet):

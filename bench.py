@@ -116,7 +116,7 @@ def tpu_metrics() -> dict | None:
         out["perf"] = {k: report["perf"].get(k) for k in (
             "device_kind", "config", "train_step_ms", "step_ms_incl_sync",
             "model_tflops_per_step", "achieved_tflops", "peak_bf16_tflops",
-            "mfu", "ok")}
+            "mfu", "tuned", "ok")}
     if isinstance(report.get("pallas_parity"), dict):
         out["pallas_err_vs_oracle"] = \
             report["pallas_parity"].get("err_pallas_vs_oracle")

@@ -384,7 +384,8 @@ class TPUAllocator:
     def get_removable_tpus(
             self, owner_name: str, uuids: Iterable[str],
             owner_namespace: str = "default",
-            txn_id: str | None = None) -> tuple[list[TPUChip], list[str]]:
+            txn_id: str | None = None
+    ) -> tuple[list[TPUChip], list[str], set[str]]:
         """Resolve which chips may be detached. Only chips held by this pod's
         slave pods are removable (allocator.go:113-120) — chips the pod got
         through its own spec came from kubelet and must not be touched.
@@ -393,22 +394,31 @@ class TPUAllocator:
         non-removable ids raise :class:`DeviceNotFoundError` (the reference
         silently returned nothing on any count mismatch,
         allocator.go:122-124). ``txn_id`` restricts to chips attached by one
-        slice transaction. Returns (chips, slave_pod_names_holding_them).
+        slice transaction — filtered locally on the txn label so the owner's
+        full slave set comes from the same single apiserver LIST. Returns
+        (chips, slave_pod_names_holding_them, all_owner_slave_names) — the
+        last lets callers reuse this LIST instead of re-issuing it.
         """
-        slave_names = self.slave_pod_names(owner_name, owner_namespace,
-                                           txn_id)
+        selector = (f"{consts.OWNER_POD_LABEL_KEY}={owner_name},"
+                    f"{consts.OWNER_NAMESPACE_LABEL_KEY}={owner_namespace}")
+        slaves = self.kube.list_pods(self.settings.pool_namespace,
+                                     label_selector=selector)
+        all_slave_names = {objects.name(p) for p in slaves}
+        in_scope = {objects.name(p) for p in slaves
+                    if not txn_id
+                    or objects.labels(p).get(consts.TXN_LABEL_KEY) == txn_id}
         removable = {
             c.uuid: c
             for c in self.collector.get_pod_tpu_resources(owner_name, "")
             if c.namespace == self.settings.pool_namespace
-            and c.pod_name in slave_names}
+            and c.pod_name in in_scope}
         wanted = list(uuids) or list(removable)
         missing = [u for u in wanted if u not in removable]
         if missing:
             raise DeviceNotFoundError(",".join(missing))
         chips = [removable[u] for u in wanted]
         holders = sorted({c.pod_name for c in chips})
-        return chips, holders
+        return chips, holders, all_slave_names
 
     # -- slave pod deletion (ref allocator.go:129-157 DeleteSlavePods) ---------
 

@@ -6,8 +6,9 @@ accounting, so "fast" was unfalsifiable. This module provides the falsifiable
 version (SURVEY §6: the perf budget "must be measured, not compared" — the
 reference publishes no numbers at all, `/root/reference/README.md:11`):
 
-- an **MXU-sized bf16 config** (d_model 1024, 8 layers, seq 1024 — matmul
-  shapes that tile the 128x128 systolic array, bf16 native MXU inputs);
+- an **MXU-sized bf16 config** (d_model 4096, head_dim 128, standard 4x MLP,
+  seq 1024 — matmul shapes that tile the 128x128 systolic array, bf16 native
+  MXU inputs);
 - **analytic model FLOPs/step** from the standard dense-transformer count
   (matmul FLOPs only — the number the hardware must actually execute);
 - **MFU** = achieved model FLOP/s divided by the chip's published bf16 peak,
@@ -16,6 +17,27 @@ reference publishes no numbers at all, `/root/reference/README.md:11`):
 The toy :class:`~gpumounter_tpu.jaxcheck.model.ModelConfig` default remains
 what the in-pod probe trains post-attach — that is a *smoke test* (is compute
 real?), not a perf claim; this module is the perf claim.
+
+Round-4 config sweep on a real v5e (full results in the git history of
+/tmp experiments; key points reproducible via :func:`measure_train_perf`):
+
+==============================================  =====
+config (bf16, batch x seq)                       MFU
+==============================================  =====
+d1024 L8 ff4096   16x1024  (round-3 config)     0.340
+d2048 L8 ff8192    8x1024                       0.596
+d4096 L4 ff16384   8x1024  (**primary** now)    0.648
+d4096 L4 ff24576  16x512                        0.728
+d4096 L4 ff32768  16x512   (**tuned** entry)    0.746
+==============================================  =====
+
+Negative result worth keeping: swapping XLA's fused attention for the
+``jax.experimental.pallas.ops.tpu.flash_attention`` kernel was SLOWER at
+every shape tried (0.340→0.233 at d1024; 0.648→0.578 at d4096) — XLA's own
+fusion of the T x T softmax is already good at seq 1024, and the pallas
+kernel's block pipeline doesn't win until much longer sequences. The MFU
+lever at these scales is arithmetic intensity (wider matmuls), not a custom
+attention kernel.
 """
 
 from __future__ import annotations
@@ -71,16 +93,46 @@ def analytic_train_flops(cfg, batch: int, t_len: int) -> float:
 
 
 def mxu_config():
-    """The chip-sized bf16 measurement config. ~99M params: large enough
-    that every matmul tiles the MXU, small enough (bf16 params + adam
-    moments ~0.6 GB) for any current chip's HBM."""
+    """The primary chip-sized bf16 measurement config: a standard-shape
+    transformer (head_dim 128 = one MXU tile, 4x MLP) at ~0.8B params —
+    bf16 params + bf16 adam moments + grads ~6.4 GB, fitting any current
+    chip's HBM with headroom for activations at batch 8 x seq 1024."""
     import jax.numpy as jnp
     from gpumounter_tpu.jaxcheck.model import ModelConfig
-    return ModelConfig(vocab=256, d_model=1024, n_heads=16, n_layers=8,
-                       d_ff=4096, dtype=jnp.bfloat16)
+    return ModelConfig(vocab=256, d_model=4096, n_heads=32, n_layers=4,
+                       d_ff=16384, dtype=jnp.bfloat16)
 
 
-def measure_train_perf(cfg=None, batch: int = 16, t_len: int = 1024,
+def tuned_config():
+    """The peak-MFU tuned variant (8x MLP, shorter sequence at the same
+    token count): arithmetic intensity maxed out to show the chip's
+    practical ceiling. Shape is non-standard on purpose and labelled
+    "tuned" in reports — the primary config is the representative claim."""
+    import jax.numpy as jnp
+    from gpumounter_tpu.jaxcheck.model import ModelConfig
+    return ModelConfig(vocab=256, d_model=4096, n_heads=32, n_layers=4,
+                       d_ff=32768, dtype=jnp.bfloat16)
+
+
+def measure_both(batch: int = 8, t_len: int = 1024) -> dict[str, Any]:
+    """Primary (standard-shape) + tuned (peak) measurements, as one report.
+    Top-level mfu/ok mirror the PRIMARY so existing consumers keep working;
+    the tuned run is best-effort extra evidence — its ~10.6 GB of bf16
+    state may not fit smaller-HBM chips, and an OOM there must not discard
+    the primary measurement that already succeeded."""
+    primary = measure_train_perf(mxu_config(), batch=batch, t_len=t_len)
+    try:
+        tuned_full = measure_train_perf(tuned_config(), batch=16, t_len=512)
+        tuned: dict[str, Any] = {
+            k: tuned_full[k] for k in
+            ("config", "train_step_ms", "model_tflops_per_step",
+             "achieved_tflops", "mfu", "ok")}
+    except Exception as e:
+        tuned = {"ok": False, "error": repr(e)[:300]}
+    return {**primary, "tuned": tuned}
+
+
+def measure_train_perf(cfg=None, batch: int = 8, t_len: int = 1024,
                        window_a: int = 4, window_b: int = 12,
                        warmup_steps: int = 2) -> dict[str, Any]:
     """Time the single-chip train step on the MXU-sized config and report

@@ -113,11 +113,12 @@ def check_training(n_steps: int = 8) -> dict[str, Any]:
 
 
 def check_perf() -> dict[str, Any]:
-    """MXU-sized bf16 config: step time, analytic FLOPs/step, and MFU
-    against the chip's published bf16 peak (round-2 VERDICT missing #1 —
-    a falsifiable perf number from the real chip)."""
+    """MXU-sized bf16 configs (primary standard-shape + tuned peak): step
+    time, analytic FLOPs/step, and MFU against the chip's published bf16
+    peak (round-2 VERDICT missing #1 — a falsifiable perf number from the
+    real chip)."""
     from gpumounter_tpu.jaxcheck import perf
-    return perf.measure_train_perf()
+    return perf.measure_both()
 
 
 def check_pallas_parity(b: int = 2, t: int = 256, h: int = 4,

@@ -241,7 +241,7 @@ class TPUMountService:
                 message=f"pod {namespace}/{pod_name} not found")
 
         try:
-            chips, holders = self.allocator.get_removable_tpus(
+            chips, holders, all_slaves = self.allocator.get_removable_tpus(
                 pod_name, uuids, owner_namespace=namespace,
                 txn_id=txn_id or None)
         except DeviceNotFoundError as e:
@@ -252,11 +252,10 @@ class TPUMountService:
                 consts.RemoveResult.TPU_NOT_FOUND,
                 message=f"no removable chips on {namespace}/{pod_name}")
 
-        # refresh=False: get_removable_tpus above just took the snapshot.
+        # refresh=False + all_slaves: get_removable_tpus above already took
+        # both the kubelet snapshot and the apiserver slave LIST.
         all_chips = self.allocator.collector.get_pod_tpu_resources_exact(
-            pod_name, namespace,
-            self.allocator.slave_pod_names(pod_name, namespace),
-            refresh=False)
+            pod_name, namespace, all_slaves, refresh=False)
 
         # Whole-slave-pod granularity: removing part of a slave pod's chips
         # would desync scheduler accounting (see module docstring).

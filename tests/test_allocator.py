@@ -89,11 +89,11 @@ def test_removable_resolution_subset_and_unknown(sim, allocator):
     chips, slaves = allocator.get_available_tpus(owner, 2, 1)
     uuids = [c.uuid for c in chips]
 
-    got, holders = allocator.get_removable_tpus("workload", [uuids[0]])
+    got, holders, _ = allocator.get_removable_tpus("workload", [uuids[0]])
     assert [c.uuid for c in got] == [uuids[0]]
     assert len(holders) == 1
 
-    got, holders = allocator.get_removable_tpus("workload", [])
+    got, holders, _ = allocator.get_removable_tpus("workload", [])
     assert sorted(c.uuid for c in got) == sorted(uuids)
     assert holders == sorted(slaves)
 

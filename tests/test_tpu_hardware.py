@@ -46,8 +46,9 @@ def test_tpu_training_loss_decreases(selftest_report):
 def test_tpu_mfu_is_reported_and_plausible(selftest_report):
     """The MXU-sized bf16 perf check (r2 VERDICT missing #1): an analytic
     FLOPs count, a net step time, and an MFU in (0, 1] against the chip's
-    published peak. The 0.2 floor is a regression guard, not the target —
-    the measured figure on v5e is ~0.34."""
+    published peak. The tight floors are v5e regression guards (round-4
+    measured ~0.62-0.65 primary / ~0.75 tuned ON v5e); other generations,
+    where these configs weren't tuned, only get the generic sanity floor."""
     perf = selftest_report["perf"]
     assert perf["ok"], perf
     assert perf["config"]["dtype"] == "bfloat16"
@@ -55,6 +56,10 @@ def test_tpu_mfu_is_reported_and_plausible(selftest_report):
     assert perf["train_step_ms"] > 0
     if perf["peak_bf16_tflops"] is not None:
         assert 0.2 < perf["mfu"] <= 1.0, perf
+        if "v5 lite" in perf["device_kind"].lower():
+            assert 0.5 < perf["mfu"] <= 1.0, perf
+            assert perf["tuned"]["ok"], perf
+            assert perf["mfu"] < perf["tuned"]["mfu"] <= 1.0, perf
 
 
 def test_tpu_pallas_parity_pinned_precision(selftest_report):

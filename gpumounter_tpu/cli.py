@@ -1,0 +1,306 @@
+"""tpumounterctl: operator CLI for the master REST API.
+
+The reference's operator UX is raw curl against the master routes
+(``docs/guide/QuickStart.md:42-97``, routes at
+``cmd/GPUMounter-master/main.go:233-234``). This CLI wraps the same surface
+with three things curl doesn't give you:
+
+- **the retry contract**: ``add`` generates an ``X-Request-Id`` and retries
+  transient failures WITH THE SAME ID, so a lost HTTP reply can never
+  double-attach (the gateway + allocator adoption machinery make the retry
+  a resume — see master/gateway.py retry contract);
+- **typed exit codes** per result enum, so scripts can branch without
+  parsing JSON;
+- human-readable output (``--json`` for the raw payload).
+
+Usage (``python -m gpumounter_tpu.cli`` or the ``tpumounterctl`` entry):
+
+    tpumounterctl add  my-pod -n default --tpus 4 --entire
+    tpumounterctl remove my-pod -n default --uuids 0,1 --force
+    tpumounterctl status my-pod -n default
+    tpumounterctl slice add    -p ns/pod-a -p ns/pod-b --tpus-per-host 4
+    tpumounterctl slice remove -p ns/pod-a -p ns/pod-b --force
+    tpumounterctl health
+
+The master address comes from ``--master`` or ``$TPU_MOUNTER_MASTER``
+(default ``http://127.0.0.1:8080`` — matching a
+``kubectl -n kube-system port-forward svc/tpu-mounter-svc 8080:80``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import uuid
+
+DEFAULT_MASTER = "http://127.0.0.1:8080"
+
+# result string -> exit code (0 success; distinct codes for scriptability;
+# enum values mirror the proto, ref api.proto:11-19,32-41). The gateway
+# emits SCREAMING_SNAKE names from worker enums and CamelCase from its own
+# error paths (PodNotFound before a worker is ever dialled) — map both.
+EXIT_CODES = {
+    "SUCCESS": 0,
+    "INSUFFICIENT_TPU": 3,
+    "InsufficientTPU": 3,
+    "POD_NOT_FOUND": 4,
+    "PodNotFound": 4,
+    "TPU_BUSY": 5,
+    "TPUBusy": 5,
+    "TPU_NOT_FOUND": 6,
+    "TPUNotFound": 6,
+    "TopologyMismatch": 7,
+    "SliceAttachFailed": 8,
+    "SliceDetachIncomplete": 9,
+}
+EXIT_TRANSPORT = 10     # couldn't reach / bad response (2 is argparse's)
+EXIT_OTHER = 1
+
+
+class TransportError(Exception):
+    pass
+
+
+def _request(master: str, method: str, path: str, body: bytes | None = None,
+             headers: dict[str, str] | None = None,
+             timeout: float = 60.0) -> tuple[int, dict]:
+    url = master.rstrip("/") + path
+    req = urllib.request.Request(url, data=body, method=method)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except (json.JSONDecodeError, OSError):
+            return e.code, {"result": f"HTTP{e.code}", "message": str(e)}
+    except (urllib.error.URLError, TimeoutError, OSError) as e:
+        raise TransportError(f"{method} {url}: {e}") from e
+    except json.JSONDecodeError as e:
+        raise TransportError(f"{method} {url}: unparseable response: "
+                             f"{e}") from e
+
+
+def _request_with_retry(master: str, method: str, path: str,
+                        body: bytes | None, request_id: str,
+                        attempts: int, timeout: float) -> tuple[int, dict]:
+    """Same X-Request-Id on every attempt — the whole point of the retry
+    contract: a retry after a lost reply resumes the original request
+    instead of allocating a second chip set."""
+    delay = 0.5
+    for attempt in range(attempts):
+        try:
+            return _request(master, method, path, body,
+                            headers={"X-Request-Id": request_id},
+                            timeout=timeout)
+        except TransportError as e:
+            if attempt == attempts - 1:
+                raise
+            print(f"transient failure ({e}); retrying with the same "
+                  f"request id {request_id}", file=sys.stderr)
+            time.sleep(delay)
+            delay = min(delay * 2, 5.0)
+    raise AssertionError("unreachable")
+
+
+def _emit(payload: dict, as_json: bool, human: str) -> None:
+    print(json.dumps(payload, indent=2) if as_json else human)
+
+
+def _finish(status: int, payload: dict, as_json: bool,
+            human: str) -> int:
+    _emit(payload, as_json, human)
+    result = str(payload.get("result", ""))
+    if result in EXIT_CODES:
+        return EXIT_CODES[result]
+    return 0 if 200 <= status < 300 else EXIT_OTHER
+
+
+def _parse_slice_pods(specs: list[str]) -> list[dict]:
+    pods = []
+    for spec in specs:
+        ns, sep, pod = spec.partition("/")
+        if not sep:
+            ns, pod = "default", spec
+        if not pod or not ns:
+            raise ValueError(f"bad --pod {spec!r}: want [namespace/]name")
+        pods.append({"namespace": ns, "pod": pod})
+    return pods
+
+
+def cmd_add(args) -> int:
+    rid = args.request_id or uuid.uuid4().hex[:12]
+    path = (f"/addtpu/namespace/{urllib.parse.quote(args.namespace)}"
+            f"/pod/{urllib.parse.quote(args.pod)}/tpu/{args.tpus}"
+            f"/isEntireMount/{'true' if args.entire else 'false'}")
+    status, payload = _request_with_retry(
+        args.master, "GET", path, None, rid, args.retries + 1, args.timeout)
+    devices = payload.get("device_paths") or []
+    human = (f"{payload.get('result')}: {len(devices)} chip(s) -> "
+             f"{args.namespace}/{args.pod}"
+             + (f" {devices}" if devices else "")
+             + f"  [request_id {payload.get('request_id', rid)}]")
+    if payload.get("message"):
+        human += f"\n  {payload['message']}"
+    return _finish(status, payload, args.json, human)
+
+
+def cmd_remove(args) -> int:
+    path = (f"/removetpu/namespace/{urllib.parse.quote(args.namespace)}"
+            f"/pod/{urllib.parse.quote(args.pod)}"
+            f"/force/{'true' if args.force else 'false'}")
+    body = urllib.parse.urlencode(
+        {"uuids": args.uuids} if args.uuids else {}).encode()
+    status, payload = _request(args.master, "POST", path, body,
+                               timeout=args.timeout)
+    human = f"{payload.get('result')}: {args.namespace}/{args.pod}"
+    if payload.get("busy_pids"):
+        human += f"\n  busy PIDs: {payload['busy_pids']} (use --force)"
+    if payload.get("message"):
+        human += f"\n  {payload['message']}"
+    return _finish(status, payload, args.json, human)
+
+
+def cmd_status(args) -> int:
+    path = (f"/tpustatus/namespace/{urllib.parse.quote(args.namespace)}"
+            f"/pod/{urllib.parse.quote(args.pod)}")
+    status, payload = _request(args.master, "GET", path,
+                               timeout=args.timeout)
+    lines = [f"{args.namespace}/{args.pod}: "
+             f"mount_type={payload.get('mount_type')}"]
+    for chip in payload.get("chips", []):
+        src = chip.get("slave_pod") or "pod spec"
+        busy = chip.get("busy_pids") or []
+        lines.append(f"  {chip.get('device_id')}  "
+                     f"{chip.get('device_path')}  via {src}"
+                     + (f"  busy:{busy}" if busy else ""))
+    return _finish(status, payload, args.json, "\n".join(lines))
+
+
+def cmd_slice(args) -> int:
+    try:
+        pods = _parse_slice_pods(args.pod)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return EXIT_OTHER
+    if args.slice_action == "add":
+        body = {"pods": pods, "tpusPerHost": args.tpus_per_host}
+        path = "/addtpuslice"
+    else:
+        body = {"pods": pods, "force": args.force}
+        path = "/removetpuslice"
+    rid = args.request_id or uuid.uuid4().hex[:12]
+    status, payload = _request_with_retry(
+        args.master, "POST", path, json.dumps(body).encode(), rid,
+        args.retries + 1, args.timeout)
+    lines = [f"{payload.get('result')}: {len(pods)} host(s)"]
+    for r in payload.get("pods", []):
+        lines.append(f"  {r.get('namespace')}/{r.get('pod')}: "
+                     f"{r.get('result')} "
+                     f"{[d for d in r.get('device_ids', [])]}")
+    if payload.get("rolled_back"):
+        lines.append("  (rolled back cleanly)")
+    return _finish(status, payload, args.json, "\n".join(lines))
+
+
+def cmd_health(args) -> int:
+    try:
+        status, payload = _request(args.master, "GET", "/healthz",
+                                   timeout=args.timeout)
+    except TransportError as e:
+        print(f"unreachable: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    return _finish(status, payload, args.json,
+                   f"master {args.master}: {payload.get('status')}")
+
+
+def _add_common(p: argparse.ArgumentParser, suppress: bool) -> None:
+    """--master/--json/--timeout work both before AND after the subcommand
+    (operators type `tpumounterctl health --master ...`). Subparsers get
+    SUPPRESS defaults so they don't clobber a value parsed at the top level;
+    real defaults live on the top parser."""
+    sup = argparse.SUPPRESS
+    p.add_argument(
+        "--master",
+        default=sup if suppress else os.environ.get("TPU_MOUNTER_MASTER",
+                                                    DEFAULT_MASTER),
+        help="master base URL (env TPU_MOUNTER_MASTER)")
+    p.add_argument("--json", action="store_true",
+                   default=sup if suppress else False,
+                   help="print the raw JSON payload")
+    p.add_argument("--timeout", type=float,
+                   default=sup if suppress else 120.0)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpumounterctl",
+        description="hot-attach/detach TPU chips on running pods")
+    _add_common(parser, suppress=False)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("add", help="attach chips to a running pod")
+    p.add_argument("pod")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--tpus", type=int, default=1)
+    p.add_argument("--entire", action="store_true",
+                   help="one topology-aligned slave pod holding all chips")
+    p.add_argument("--request-id", default="",
+                   help="idempotency key (default: generated)")
+    p.add_argument("--retries", type=int, default=2,
+                   help="transient-failure retries, same request id")
+    p.set_defaults(fn=cmd_add)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser("remove", help="detach chips from a pod")
+    p.add_argument("pod")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--uuids", default="",
+                   help="comma-separated device ids (default: all removable)")
+    p.add_argument("--force", action="store_true",
+                   help="kill holder processes if busy")
+    p.set_defaults(fn=cmd_remove)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser("status", help="chips + busy PIDs of a pod")
+    p.add_argument("pod")
+    p.add_argument("-n", "--namespace", default="default")
+    p.set_defaults(fn=cmd_status)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser("slice", help="multi-host slice transactions")
+    p.add_argument("slice_action", choices=["add", "remove"])
+    p.add_argument("-p", "--pod", action="append", required=True,
+                   metavar="NS/POD", help="repeatable: one entry per host")
+    p.add_argument("--tpus-per-host", type=int, default=4)
+    p.add_argument("--force", action="store_true")
+    p.add_argument("--request-id", default="")
+    p.add_argument("--retries", type=int, default=2)
+    p.set_defaults(fn=cmd_slice)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser("health", help="master liveness")
+    p.set_defaults(fn=cmd_health)
+    _add_common(p, suppress=True)
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except TransportError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,131 @@
+"""tpumounterctl against a live master+worker stack (same rig as test_e2e):
+human output, --json output, exit codes, and the same-request-id retry
+contract on transient transport failures."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from gpumounter_tpu import cli
+from tests.helpers import LiveStack, WorkerRig
+
+
+@pytest.fixture
+def live_stack(fake_host):
+    stack = LiveStack(WorkerRig(fake_host, use_kubelet_socket=True))
+    yield stack.rig, stack.base
+    stack.close()
+
+
+def run_cli(base, *argv):
+    import contextlib
+    import io
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli.main(["--master", base, *argv])
+    return rc, out.getvalue()
+
+
+def test_add_status_remove_roundtrip(live_stack):
+    rig, base = live_stack
+    rc, out = run_cli(base, "add", "workload", "-n", "default",
+                      "--tpus", "4", "--entire")
+    assert rc == 0
+    assert "SUCCESS" in out and "/dev/accel0" in out
+
+    rc, out = run_cli(base, "status", "workload")
+    assert rc == 0
+    assert "mount_type=entire" in out
+    assert out.count("via") == 4
+
+    rc, out = run_cli(base, "remove", "workload", "--uuids", "0,1,2,3")
+    assert rc == 0 and "SUCCESS" in out
+    assert rig.sim.slave_pods() == []
+
+
+def test_json_output_and_exit_codes(live_stack):
+    rig, base = live_stack
+    rc, out = run_cli(base, "--json", "add", "nosuchpod")
+    assert rc == cli.EXIT_CODES["PodNotFound"]
+    assert json.loads(out)["result"] == "PodNotFound"
+
+    rc, out = run_cli(base, "--json", "add", "workload", "--tpus", "99")
+    assert rc == cli.EXIT_CODES["InsufficientTPU"]
+
+    rc, out = run_cli(base, "remove", "workload")
+    assert rc == cli.EXIT_CODES["TPUNotFound"]
+
+    rc, out = run_cli(base, "health")
+    assert rc == 0 and "ok" in out
+
+
+def test_transport_error_exit_code():
+    rc = cli.main(["--master", "http://127.0.0.1:1", "--timeout", "1",
+                   "status", "x"])
+    assert rc == cli.EXIT_TRANSPORT
+
+
+def test_retry_reuses_request_id(live_stack, monkeypatch):
+    """The CLI's whole value-add: a transient failure is retried with the
+    SAME X-Request-Id, which the gateway+allocator turn into a resume —
+    one slave-pod set, not two."""
+    rig, base = live_stack
+    seen_rids = []
+    real_request = cli._request
+    calls = {"n": 0}
+
+    def flaky(master, method, path, body=None, headers=None, timeout=60.0):
+        calls["n"] += 1
+        if headers and "X-Request-Id" in headers:
+            seen_rids.append(headers["X-Request-Id"])
+        if calls["n"] == 1:
+            raise cli.TransportError("connection reset mid-reply")
+        return real_request(master, method, path, body, headers, timeout)
+
+    monkeypatch.setattr(cli, "_request", flaky)
+    monkeypatch.setattr(cli.time, "sleep", lambda s: None)
+    rc, out = run_cli(base, "add", "workload", "--tpus", "2", "--entire")
+    assert rc == 0 and "SUCCESS" in out
+    assert len(seen_rids) == 2 and seen_rids[0] == seen_rids[1]
+    # one slave-pod set despite two attempts
+    assert len(rig.sim.slave_pods()) == 1
+
+
+def test_slice_pod_spec_parsing():
+    assert cli._parse_slice_pods(["ns1/a", "b"]) == [
+        {"namespace": "ns1", "pod": "a"},
+        {"namespace": "default", "pod": "b"}]
+    with pytest.raises(ValueError):
+        cli._parse_slice_pods(["ns1/"])
+    with pytest.raises(ValueError):
+        cli._parse_slice_pods(["/pod"])      # empty namespace
+
+
+def test_slice_add_against_multinode(fake_host, tmp_path):
+    from gpumounter_tpu.testing.sim import MultiNodeStack
+    from gpumounter_tpu.utils.config import HostPaths
+    hosts = []
+    for i in range(2):
+        root = tmp_path / f"host{i}"
+        for d in ("dev", "proc", "sys/fs/cgroup"):
+            (root / d).mkdir(parents=True)
+        hosts.append(HostPaths(
+            dev_root=str(root / "dev"), proc_root=str(root / "proc"),
+            sys_root=str(root / "sys"),
+            cgroup_root=str(root / "sys" / "fs" / "cgroup"),
+            kubelet_socket=str(root / "pr" / "kubelet.sock")))
+    stack = MultiNodeStack(hosts)
+    try:
+        rc, out = run_cli(
+            stack.base, "slice", "add",
+            "-p", "default/workload-0", "-p", "default/workload-1",
+            "--tpus-per-host", "4")
+        assert rc == 0 and "SUCCESS" in out
+        rc, out = run_cli(
+            stack.base, "slice", "remove",
+            "-p", "default/workload-0", "-p", "default/workload-1")
+        assert rc == 0 and "SUCCESS" in out
+    finally:
+        stack.close()

@@ -204,8 +204,13 @@ class CgroupDeviceController:
         path = os.path.join(self._v1_devices_dir(pod, container_id), filename)
         entry = f"c {major}:{minor} {consts.DEVICE_CGROUP_PERMISSIONS}"
         try:
-            with open(path, "w") as f:
-                f.write(entry)
+            # O_APPEND, kernel-equivalent to "w" (the devices files are
+            # write-only ops, not stores). Append is load-bearing for
+            # process-level verification: subprocess boot tests and operators
+            # inspecting a fixture/host tree can only observe grants through
+            # this file, and truncate-mode would erase all but the last op.
+            with open(path, "a") as f:
+                f.write(entry + "\n")
         except OSError as e:
             raise CgroupError(f"write {entry!r} to {path} failed: {e}") from e
         logger.debug("v1 %s <- %s", path, entry)

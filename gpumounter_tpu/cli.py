@@ -96,6 +96,7 @@ def _request_with_retry(master: str, method: str, path: str,
     contract: a retry after a lost reply resumes the original request
     instead of allocating a second chip set."""
     delay = 0.5
+    attempts = max(1, attempts)     # tolerate --retries < 0
     for attempt in range(attempts):
         try:
             return _request(master, method, path, body,

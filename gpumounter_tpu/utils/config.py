@@ -50,6 +50,9 @@ class Settings:
     # asynchronous); chip collection retries within this bound before
     # declaring the allocation failed.
     kubelet_lag_timeout_s: float = 10.0
+    # Accept regular files as chips (BASELINE config 1 / process-level boot
+    # tests on CPU-only hosts). Never set in the shipped DaemonSet.
+    allow_fake_devices: bool = False
     host: HostPaths = dataclasses.field(default_factory=HostPaths)
 
     @classmethod
@@ -69,4 +72,19 @@ class Settings:
             s.allocation_timeout_s = float(t)
         if t := env.get("TPU_KUBELET_LAG_TIMEOUT_S"):
             s.kubelet_lag_timeout_s = float(t)
+        s.allow_fake_devices = env.get("TPU_ALLOW_FAKE_DEVICES") == "1"
+        if p := env.get("TPU_WORKER_GRPC_PORT"):
+            s.worker_grpc_port = int(p)
+        if p := env.get("TPU_MASTER_HTTP_PORT"):
+            s.master_http_port = int(p)
+        # Host roots are env-overridable so DaemonSets that mount the node
+        # filesystem at non-standard paths (/host-sys, /host-proc) — and
+        # process-level boot tests over fixture trees — can remap them.
+        s.host = HostPaths(
+            dev_root=env.get("TPU_DEV_ROOT", s.host.dev_root),
+            proc_root=env.get("TPU_PROC_ROOT", s.host.proc_root),
+            sys_root=env.get("TPU_SYS_ROOT", s.host.sys_root),
+            cgroup_root=env.get("TPU_CGROUP_ROOT", s.host.cgroup_root),
+            kubelet_socket=env.get("TPU_KUBELET_SOCKET",
+                                   s.host.kubelet_socket))
         return s

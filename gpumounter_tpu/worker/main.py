@@ -66,7 +66,8 @@ def start_health_server(port: int) -> ThreadingHTTPServer:
 def build_stack(settings: Settings) -> TPUMountService:
     """Wire the production object graph (ref server.go:22-33 NewGPUMounter →
     NewGPUAllocator → NewGPUCollector; composition instead of embedding)."""
-    enumerator = best_enumerator(settings.host)
+    enumerator = best_enumerator(settings.host,
+                                 allow_fake=settings.allow_fake_devices)
     podresources = KubeletPodResourcesClient(settings.host.kubelet_socket)
     collector = TPUCollector(enumerator, podresources,
                              resource_name=settings.resource_name,

@@ -1,0 +1,224 @@
+"""Process-level boot tests: launch the REAL binaries
+(``python -m gpumounter_tpu.worker.main`` / ``master.main``) as
+subprocesses against a live HTTP apiserver facade + unix-socket kubelet,
+and drive the QuickStart flow through them with ``tpumounterctl``.
+
+This is the layer nothing else covers: Settings.from_env wiring, the
+default_kube_client kubeconfig path inside the binaries, health/readiness
+endpoints, gRPC serving, and clean SIGTERM shutdown — the exact things a
+deploy typo breaks. Everything here runs the production object graph; the
+only fakes are the cluster (FakeKubeClient behind real HTTP) and the chips
+(fixture files, TPU_ALLOW_FAKE_DEVICES=1 — BASELINE config 1 at the
+process level). Device nodes are created by REAL mknod through the
+fixture /proc/<pid>/root (the test runs as root).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.testing.http_apiserver import (HttpApiserver,
+                                                   write_kubeconfig)
+from gpumounter_tpu.testing.sim import ClusterSim, worker_pod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def pick_ports() -> tuple[int, int]:
+    """(grpc_port, master_port) such that grpc_port+1 (the worker health
+    port) is also bindable and all three are distinct — avoids the flake
+    where the OS hands out master_port == grpc_port+1."""
+    for _ in range(50):
+        socks = []
+        try:
+            a = socket.socket()
+            a.bind(("127.0.0.1", 0))
+            grpc_port = a.getsockname()[1]
+            socks.append(a)
+            b = socket.socket()
+            b.bind(("127.0.0.1", grpc_port + 1))
+            socks.append(b)
+            c = socket.socket()
+            c.bind(("127.0.0.1", 0))
+            master_port = c.getsockname()[1]
+            socks.append(c)
+            return grpc_port, master_port
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port triple found")
+
+
+def wait_http(url: str, timeout_s: float = 20.0,
+              expect: int = 200) -> None:
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as resp:
+                if resp.status == expect:
+                    return
+                last = resp.status
+        except Exception as e:
+            last = e
+        time.sleep(0.1)
+    raise AssertionError(f"{url} not up within {timeout_s}s: {last}")
+
+
+@pytest.fixture
+def boot_env(fake_host, tmp_path):
+    """ClusterSim + HTTP apiserver + kubeconfig + fixture container, and
+    the env both binaries boot from."""
+    sim = ClusterSim(n_chips=4, kubelet_socket_path=fake_host.kubelet_socket)
+    sim.settings.host = fake_host
+    # fixture chips on "disk" so the worker subprocess's enumerator sees the
+    # same uuids the sim's scheduler assigns (fake-chip file format of
+    # device/enumerator.py: regular accelN + majmin sidecar)
+    for i in range(4):
+        open(os.path.join(fake_host.dev_root, f"accel{i}"), "w").close()
+        with open(os.path.join(fake_host.dev_root,
+                               f"accel{i}.majmin"), "w") as f:
+            f.write(f"120:{i}")
+    api = HttpApiserver(sim.kube)
+    kubeconfig = write_kubeconfig(str(tmp_path / "kubeconfig"), api.base)
+
+    pod = sim.add_target_pod(name="workload")
+
+    # fixture container: cgroup dir with one live PID + /proc/<pid>/root/dev
+    from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
+    from gpumounter_tpu.k8s import objects
+    cgroups = CgroupDeviceController(fake_host, driver="cgroupfs", version=1)
+    cid = objects.container_ids(pod)[0]
+    cgroup_dir = cgroups.container_dir(pod, cid)
+    os.makedirs(cgroup_dir, exist_ok=True)
+    pid = 4242
+    with open(os.path.join(cgroup_dir, "cgroup.procs"), "w") as f:
+        f.write(f"{pid}\n")
+    os.makedirs(os.path.join(fake_host.proc_root, str(pid), "root", "dev"),
+                exist_ok=True)
+
+    grpc_port, master_port = pick_ports()
+    env = dict(os.environ)
+    env.pop("KUBERNETES_SERVICE_HOST", None)
+    env.update({
+        "KUBECONFIG": kubeconfig,
+        "PYTHONPATH": REPO,
+        "TPU_ALLOW_FAKE_DEVICES": "1",
+        "CGROUP_DRIVER": "cgroupfs",
+        "NODE_NAME": sim.node,
+        "TPU_DEV_ROOT": fake_host.dev_root,
+        "TPU_PROC_ROOT": fake_host.proc_root,
+        "TPU_SYS_ROOT": fake_host.sys_root,
+        "TPU_CGROUP_ROOT": fake_host.cgroup_root,
+        "TPU_KUBELET_SOCKET": fake_host.kubelet_socket,
+        "TPU_WORKER_GRPC_PORT": str(grpc_port),
+        "TPU_MASTER_HTTP_PORT": str(master_port),
+        "TPU_ALLOCATION_TIMEOUT_S": "20",
+        "TPU_KUBELET_LAG_TIMEOUT_S": "5",
+    })
+    procs = []
+
+    def launch(module: str) -> subprocess.Popen:
+        p = subprocess.Popen(
+            [sys.executable, "-m", module], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+        return p
+
+    yield {"sim": sim, "env": env, "launch": launch,
+           "grpc_port": grpc_port, "master_port": master_port,
+           "fake_host": fake_host, "pid": pid, "cgroup_dir": cgroup_dir}
+
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+    api.close()
+    sim.close()
+
+
+def _cli(master_port: int, *argv) -> tuple[int, str]:
+    out = subprocess.run(
+        [sys.executable, "-m", "gpumounter_tpu.cli",
+         "--master", f"http://127.0.0.1:{master_port}", *argv],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": REPO})
+    return out.returncode, out.stdout
+
+
+def test_worker_and_master_binaries_end_to_end(boot_env):
+    b = boot_env
+    worker = b["launch"]("gpumounter_tpu.worker.main")
+    health = f"http://127.0.0.1:{b['grpc_port'] + 1}"
+    wait_http(f"{health}/readyz")
+    assert worker.poll() is None
+
+    # register the (real) worker in discovery, then boot the master
+    b["sim"].kube.put_pod(worker_pod(b["sim"].node, "127.0.0.1",
+                                     grpc_port=b["grpc_port"]))
+    master = b["launch"]("gpumounter_tpu.master.main")
+    wait_http(f"http://127.0.0.1:{b['master_port']}/healthz")
+    assert master.poll() is None
+
+    # QuickStart flow through the full production stack via the CLI
+    rc, out = _cli(b["master_port"], "add", "workload", "-n", "default",
+                   "--tpus", "4", "--entire")
+    assert rc == 0, out
+    assert "SUCCESS" in out
+
+    # real mknod happened inside the fixture container's /dev
+    devdir = os.path.join(b["fake_host"].proc_root, str(b["pid"]),
+                          "root", "dev")
+    nodes = sorted(n for n in os.listdir(devdir) if n.startswith("accel"))
+    assert nodes == ["accel0", "accel1", "accel2", "accel3"]
+    import stat
+    st = os.stat(os.path.join(devdir, "accel0"))
+    assert stat.S_ISCHR(st.st_mode)         # a genuine device node
+
+    # cgroup v1 grant written for every chip
+    with open(os.path.join(b["cgroup_dir"], "devices.allow")) as f:
+        grants = f.read()
+    assert grants.count("c 120:") == 4 and "rw" in grants
+
+    rc, out = _cli(b["master_port"], "status", "workload")
+    assert rc == 0 and "mount_type=entire" in out
+
+    rc, out = _cli(b["master_port"], "remove", "workload",
+                   "--uuids", "0,1,2,3")
+    assert rc == 0, out
+    assert not [n for n in os.listdir(devdir) if n.startswith("accel")]
+    assert b["sim"].slave_pods() == []
+
+    # metrics surfaced on the worker health port
+    with urllib.request.urlopen(f"{health}/metrics") as resp:
+        metrics = resp.read().decode()
+    assert "attach_seconds" in metrics
+
+    # clean shutdown on SIGTERM: default handler (no traceback-exit-1)
+    worker.send_signal(signal.SIGTERM)
+    master.send_signal(signal.SIGTERM)
+    assert worker.wait(timeout=10) in (0, -signal.SIGTERM)
+    assert master.wait(timeout=10) in (0, -signal.SIGTERM)
+
+
+def test_worker_fails_fast_without_kubelet(boot_env, tmp_path):
+    """Ref SURVEY §3.1: the worker exits rather than serve with a broken
+    stack (no kubelet socket ⇒ deploy error)."""
+    b = boot_env
+    b["env"]["TPU_KUBELET_SOCKET"] = str(tmp_path / "absent.sock")
+    worker = b["launch"]("gpumounter_tpu.worker.main")
+    assert worker.wait(timeout=30) != 0

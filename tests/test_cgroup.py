@@ -132,16 +132,18 @@ def test_v1_allow_write(v1_setup):
     pod, ctrl, cid, cdir = v1_setup
     chips = make_chips(2, major=120)
     ctrl.sync_device_access(pod, cid, chips)
-    # last write wins in the fixture file; the real kernel file is write-only
+    # append-mode fixture file preserves every grant (kernel-equivalent:
+    # each write() is an operation either way)
     content = open(os.path.join(cdir, "devices.allow")).read()
-    assert content == "c 120:1 rw"
+    assert content.splitlines() == ["c 120:0 rw", "c 120:1 rw"]
 
 
 def test_v1_deny_write(v1_setup):
     pod, ctrl, cid, cdir = v1_setup
     chips = make_chips(2, major=120)
     ctrl.revoke_device_access(pod, cid, [chips[0]], [chips[1]])
-    assert open(os.path.join(cdir, "devices.deny")).read() == "c 120:0 rw"
+    assert open(os.path.join(cdir, "devices.deny")).read().splitlines() \
+        == ["c 120:0 rw"]
 
 
 def test_v1_missing_cgroup_raises(fake_host):
@@ -314,9 +316,10 @@ def test_v1_allow_covers_companions(fake_host):
                      minor=i, uuid=str(i), companions=(comp,))
              for i in range(2)]
     ctrl.sync_device_access(pod, cid, chips)
-    # fixture file holds the last write; companion written after chips? No —
-    # order is chip0, companion, chip1 (dedup keeps first companion)
-    assert open(os.path.join(cdir, "devices.allow")).read() == "c 511:1 rw"
+    allowed = open(os.path.join(cdir, "devices.allow")).read().splitlines()
+    # both chips AND the shared vfio companion get grants (deduped)
+    assert allowed == ["c 511:0 rw", "c 10:196 rw", "c 511:1 rw"]
     # removing chip0 while chip1 remains must NOT deny the shared companion
     ctrl.revoke_device_access(pod, cid, [chips[0]], [chips[1]])
-    assert open(os.path.join(cdir, "devices.deny")).read() == "c 511:0 rw"
+    assert open(os.path.join(cdir, "devices.deny")).read().splitlines() \
+        == ["c 511:0 rw"]

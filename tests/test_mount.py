@@ -54,8 +54,9 @@ def test_mount_chips_full_path(rig):
     pod, mounter, actuator, enum, cdir = rig
     chips = make_chips(2)
     mounter.mount_chips(pod, chips, chips)
-    # cgroup v1 allow written
-    assert open(os.path.join(cdir, "devices.allow")).read() == "c 120:1 rw"
+    # cgroup v1 allows written for both chips
+    assert open(os.path.join(cdir, "devices.allow")).read().splitlines() \
+        == ["c 120:0 rw", "c 120:1 rw"]
     # device nodes created via the first LIVE pid (4242; 4243 has no /proc dir)
     assert actuator.created == [(4242, "/dev/accel0", 120, 0),
                                 (4242, "/dev/accel1", 120, 1)]
@@ -80,7 +81,8 @@ def test_unmount_clean(rig):
     chips = make_chips(2)
     mounter.mount_chips(pod, chips, chips)
     mounter.unmount_chips(pod, [chips[0]], [chips[1]])
-    assert open(os.path.join(cdir, "devices.deny")).read() == "c 120:0 rw"
+    assert open(os.path.join(cdir, "devices.deny")).read().splitlines() \
+        == ["c 120:0 rw"]
     assert actuator.removed == [(4242, "/dev/accel0")]
     assert actuator.killed == []
 

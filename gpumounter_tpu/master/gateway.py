@@ -48,6 +48,27 @@ _REMOVE_RE = re.compile(
     r"/force/(?P<force>true|false)$")
 _STATUS_RE = re.compile(
     r"^/tpustatus/namespace/(?P<ns>[^/]+)/pod/(?P<pod>[^/]+)$")
+# Drop-in aliases for the reference's exact route shapes
+# (cmd/GPUMounter-master/main.go:233-234: /addgpu/.../gpu/:n/..., /removegpu)
+# so GPUMounter users' scripts work unchanged against this master. Booleans
+# accept everything Go's strconv.ParseBool did (main.go:38,140):
+# 1/0/t/f/T/F/true/false/True/False/TRUE/FALSE.
+_ADD_GPU_RE = re.compile(
+    r"^/addgpu/namespace/(?P<ns>[^/]+)/pod/(?P<pod>[^/]+)"
+    r"/gpu/(?P<num>\d+)/isEntireMount/(?P<entire>[^/]+)$")
+_REMOVE_GPU_RE = re.compile(
+    r"^/removegpu/namespace/(?P<ns>[^/]+)/pod/(?P<pod>[^/]+)"
+    r"/force/(?P<force>[^/]+)$")
+
+_PARSEBOOL = {"1": True, "t": True, "T": True,
+              "true": True, "True": True, "TRUE": True,
+              "0": False, "f": False, "F": False,
+              "false": False, "False": False, "FALSE": False}
+
+
+def _parse_bool(token: str) -> bool | None:
+    """Exactly strconv.ParseBool's accepted set; None = unparseable."""
+    return _PARSEBOOL.get(token)
 
 # Client-supplied X-Request-Id must be usable as a k8s label value (slave
 # pods are stamped with it for idempotent adoption, allocator.py:181-190):
@@ -166,15 +187,27 @@ class MasterGateway:
         parsed = urllib.parse.urlparse(path)
         if parsed.path == "/healthz":
             return 200, {"status": "ok"}
-        match = _ADD_RE.match(parsed.path)
+        match = _ADD_RE.match(parsed.path) or \
+            _ADD_GPU_RE.match(parsed.path)
         if match and method == "GET":
+            entire = _parse_bool(match["entire"])
+            if entire is None:
+                return 400, {"result": "BadRequest",
+                             "message": f"bad isEntireMount value "
+                                        f"{match['entire']!r}"}
             return self._add(match["ns"], match["pod"], int(match["num"]),
-                             match["entire"] == "true", rid)
-        match = _REMOVE_RE.match(parsed.path)
+                             entire, rid)
+        match = _REMOVE_RE.match(parsed.path) or \
+            _REMOVE_GPU_RE.match(parsed.path)
         if match and method == "POST":
+            force = _parse_bool(match["force"])
+            if force is None:
+                return 400, {"result": "BadRequest",
+                             "message": f"bad force value "
+                                        f"{match['force']!r}"}
             uuids = _parse_uuids(body, parsed.query)
             return self._remove(match["ns"], match["pod"], uuids,
-                                match["force"] == "true", rid)
+                                force, rid)
         match = _STATUS_RE.match(parsed.path)
         if match and method == "GET":
             return self._status(match["ns"], match["pod"], rid)

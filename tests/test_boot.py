@@ -24,7 +24,11 @@ import urllib.request
 
 import pytest
 
-from gpumounter_tpu.testing.http_apiserver import (HttpApiserver,
+# the worker does a REAL mknod(S_IFCHR) into the fixture /proc/<pid>/root
+pytestmark = pytest.mark.skipif(os.geteuid() != 0,
+                                reason="boot tests need root (mknod)")
+
+from gpumounter_tpu.testing.http_apiserver import (HttpApiserver,  # noqa: E402
                                                    write_kubeconfig)
 from gpumounter_tpu.testing.sim import ClusterSim, worker_pod
 

@@ -185,3 +185,37 @@ def test_unknown_route_404(stack):
     assert status == 404
     status, _ = gateway.handle("GET", "/healthz")
     assert status == 200
+
+
+def test_reference_route_aliases(stack):
+    """The reference's exact route shapes (/addgpu/.../gpu/:n/...,
+    /removegpu/.../force/:b — cmd/GPUMounter-master/main.go:233-234) are
+    drop-in aliases: a GPUMounter user's scripts work unchanged."""
+    rig, gw = stack
+    status, body = gw.handle(
+        "GET", "/addgpu/namespace/default/pod/workload/gpu/2"
+               "/isEntireMount/true")
+    assert status == 200 and body["result"] == "SUCCESS"
+    assert len(body["device_ids"]) == 2
+    status, body = gw.handle(
+        "POST", "/removegpu/namespace/default/pod/workload/force/false",
+        body=b"uuids=" + ",".join(body["device_ids"]).encode())
+    assert status == 200 and body["result"] == "SUCCESS"
+
+
+def test_reference_alias_parsebool_variants(stack):
+    """strconv.ParseBool parity on alias routes (ref main.go:38,140):
+    1/T/True work; garbage gets 400, not 404."""
+    rig, gw = stack
+    status, body = gw.handle(
+        "GET", "/addgpu/namespace/default/pod/workload/gpu/1"
+               "/isEntireMount/False")
+    assert status == 200 and body["result"] == "SUCCESS"
+    status, body = gw.handle(
+        "POST", "/removegpu/namespace/default/pod/workload/force/0",
+        body=b"uuids=" + body["device_ids"][0].encode())
+    assert status == 200 and body["result"] == "SUCCESS"
+    status, body = gw.handle(
+        "GET", "/addgpu/namespace/default/pod/workload/gpu/1"
+               "/isEntireMount/maybe")
+    assert status == 400 and body["result"] == "BadRequest"

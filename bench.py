@@ -120,6 +120,9 @@ def tpu_metrics() -> dict | None:
     if isinstance(report.get("pallas_parity"), dict):
         out["pallas_err_vs_oracle"] = \
             report["pallas_parity"].get("err_pallas_vs_oracle")
+    if isinstance(report.get("drain_cycle"), dict):
+        out["drain_cycle"] = {k: report["drain_cycle"].get(k) for k in (
+            "abs_err", "drain_restore_s", "ok")}
     if isinstance(report.get("backend_reinit"), dict):
         out["backend_reinit_s"] = report["backend_reinit"].get("reinit_s")
     return out

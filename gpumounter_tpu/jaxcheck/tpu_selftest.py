@@ -168,6 +168,60 @@ def check_pallas_parity(b: int = 2, t: int = 256, h: int = 4,
             "tol": tol, "shape": [b, t, h, d], "ok": bool(ok)}
 
 
+def check_drain_cycle() -> dict[str, Any]:
+    """BASELINE config 4 on hardware: drain → backend re-init (the
+    detach/reattach window) → restore → training continues with the SAME
+    loss a never-interrupted run produces (the step is deterministic given
+    state+tokens, so equality is the strongest possible continuity claim;
+    tolerance only covers recompile-order float noise)."""
+    import tempfile
+
+    import jax
+    import numpy as np
+    from gpumounter_tpu.jaxcheck import probe
+    from gpumounter_tpu.jaxcheck import drain as drain_lib
+    from gpumounter_tpu.jaxcheck import train as train_lib
+    from gpumounter_tpu.jaxcheck.model import ModelConfig
+
+    cfg = ModelConfig()         # toy: this tests the cycle, not perf
+    state = train_lib.init_state(jax.random.PRNGKey(0), cfg, mesh=None)
+    step = train_lib.make_train_step(cfg, mesh=None)
+    tokens = train_lib.make_batch(jax.random.PRNGKey(1), 4, 64, cfg.vocab)
+    for _ in range(3):
+        state, _ = step(state, tokens)
+
+    with tempfile.TemporaryDirectory() as d:
+        # drain BEFORE the reference step: the jitted step donates its input
+        # state, so the checkpoint must be taken while the buffers are live
+        path = f"{d}/drain.ckpt"
+        t0 = time.perf_counter()
+        drain_lib.drain(state, path)
+        drain_s = time.perf_counter() - t0
+
+        # the uninterrupted continuation (reference), consuming the donation
+        ref_state, ref_loss = step(state, tokens)
+        ref_loss = float(ref_loss)
+        del ref_state, state
+        # old-backend arrays are invalid after reinitialize_backend
+        # (probe.py: clear_backends) — hold tokens as host numpy across it
+        tokens = np.asarray(tokens)
+
+        t0 = time.perf_counter()
+        probe.reinitialize_backend()        # the detach/reattach window
+        assert jax.default_backend() == "tpu"
+        state = drain_lib.restore(path)
+        drain_restore_s = drain_s + (time.perf_counter() - t0)
+        step2 = train_lib.make_train_step(cfg, mesh=None)   # fresh backend
+        state, loss = step2(state, tokens)
+        resumed_loss = float(loss)
+
+    err = abs(resumed_loss - ref_loss)
+    ok = bool(np.isfinite(resumed_loss) and err < 1e-3)
+    return {"ref_loss": ref_loss, "resumed_loss": resumed_loss,
+            "abs_err": err, "drain_restore_s": round(drain_restore_s, 3),
+            "ok": ok}
+
+
 def check_backend_reinit() -> dict[str, Any]:
     """reinitialize_backend() against a live TPU backend: device count must
     survive re-enumeration and compute must still work (no libtpu wedge)."""
@@ -200,6 +254,7 @@ def run_selftest(n_steps: int = 8) -> dict[str, Any]:
             ("training", lambda: check_training(n_steps)),
             ("perf", check_perf),
             ("pallas_parity", check_pallas_parity),
+            ("drain_cycle", check_drain_cycle),
             ("backend_reinit", check_backend_reinit),
     ):
         try:
@@ -208,7 +263,7 @@ def run_selftest(n_steps: int = 8) -> dict[str, Any]:
             report[name] = {"ok": False, "error": repr(e)}
     report["ok"] = all(report[k]["ok"] for k in
                        ("collectives", "training", "perf", "pallas_parity",
-                        "backend_reinit"))
+                        "drain_cycle", "backend_reinit"))
     return report
 
 

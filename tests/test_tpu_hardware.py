@@ -79,3 +79,13 @@ def test_tpu_backend_reinit_no_wedge(selftest_report):
     assert br["ok"], br
     assert br["devices_before"] == br["devices_after"]
     assert br["compute_ok"]
+
+
+def test_tpu_drain_cycle_loss_continuity(selftest_report):
+    """BASELINE config 4 on hardware: drain -> backend re-init (the
+    detach/reattach window) -> restore -> the next step's loss equals the
+    uninterrupted run's."""
+    dc = selftest_report["drain_cycle"]
+    assert dc["ok"], dc
+    assert dc["abs_err"] < 1e-3
+    assert dc["drain_restore_s"] > 0

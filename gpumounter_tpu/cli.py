@@ -18,6 +18,7 @@ Usage (``python -m gpumounter_tpu.cli`` or the ``tpumounterctl`` entry):
     tpumounterctl add  my-pod -n default --tpus 4 --entire
     tpumounterctl remove my-pod -n default --uuids 0,1 --force
     tpumounterctl status my-pod -n default
+    tpumounterctl node my-tpu-node
     tpumounterctl slice add    -p ns/pod-a -p ns/pod-b --tpus-per-host 4
     tpumounterctl slice remove -p ns/pod-a -p ns/pod-b --force
     tpumounterctl health
@@ -186,6 +187,26 @@ def cmd_status(args) -> int:
     return _finish(status, payload, args.json, "\n".join(lines))
 
 
+def cmd_node(args) -> int:
+    path = f"/nodestatus/node/{urllib.parse.quote(args.node)}"
+    status, payload = _request(args.master, "GET", path,
+                               timeout=args.timeout)
+    if "free" not in payload:       # error payload: result + message
+        human = f"{payload.get('result')}: {payload.get('message', '')}"
+        return _finish(status, payload, args.json, human)
+    lines = [f"node {payload.get('node', args.node)}: "
+             f"{payload.get('free')}/{payload.get('total')} chips free"]
+    for chip in payload.get("chips", []):
+        holder = (f"{chip.get('namespace')}/{chip.get('pod_name')}"
+                  if chip.get("state") == "ALLOCATED" else "free")
+        extra = " ".join(x for x in (chip.get("accelerator"),
+                                     chip.get("topology")) if x)
+        lines.append(f"  {chip.get('device_id')}  "
+                     f"{chip.get('device_path')}  {holder}"
+                     + (f"  [{extra}]" if extra else ""))
+    return _finish(status, payload, args.json, "\n".join(lines))
+
+
 def cmd_slice(args) -> int:
     try:
         pods = _parse_slice_pods(args.pod)
@@ -275,6 +296,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("pod")
     p.add_argument("-n", "--namespace", default="default")
     p.set_defaults(fn=cmd_status)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser("node", help="node-wide chip inventory (free/used)")
+    p.add_argument("node")
+    p.set_defaults(fn=cmd_node)
     _add_common(p, suppress=True)
 
     p = sub.add_parser("slice", help="multi-host slice transactions")

@@ -30,6 +30,7 @@ from gpumounter_tpu.device.enumerator import Enumerator
 from gpumounter_tpu.device.model import DeviceState, TPUChip
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
 
 logger = get_logger("collector")
 
@@ -70,7 +71,16 @@ class TPUCollector:
         with self._lock:
             # freshly enumerated chips start FREE; allocation state is fully
             # re-derived from the kubelet listing every refresh
+            prev = self._chips
             self._chips = {c.uuid: c for c in self.enumerator.enumerate()}
+            # topology stamps (set by the allocator from node labels) are
+            # static per node — carry them across refreshes so they aren't
+            # lost when the inventory is rebuilt
+            for uuid, chip in self._chips.items():
+                old = prev.get(uuid)
+                if old is not None:
+                    chip.accelerator = chip.accelerator or old.accelerator
+                    chip.topology = chip.topology or old.topology
             for pod in listing.pod_resources:
                 for container in pod.containers:
                     for dev in container.devices:
@@ -87,6 +97,10 @@ class TPUCollector:
                             chip.state = DeviceState.ALLOCATED
                             chip.pod_name = pod.name
                             chip.namespace = pod.namespace
+            free = sum(1 for c in self._chips.values()
+                       if c.state is DeviceState.FREE)
+            REGISTRY.chips.set(free, state="free")
+            REGISTRY.chips.set(len(self._chips) - free, state="allocated")
 
     # -- aggregation -----------------------------------------------------------
 

@@ -48,6 +48,7 @@ _REMOVE_RE = re.compile(
     r"/force/(?P<force>true|false)$")
 _STATUS_RE = re.compile(
     r"^/tpustatus/namespace/(?P<ns>[^/]+)/pod/(?P<pod>[^/]+)$")
+_NODE_STATUS_RE = re.compile(r"^/nodestatus/node/(?P<node>[^/]+)$")
 # Drop-in aliases for the reference's exact route shapes
 # (cmd/GPUMounter-master/main.go:233-234: /addgpu/.../gpu/:n/..., /removegpu)
 # so GPUMounter users' scripts work unchanged against this master. Booleans
@@ -211,6 +212,9 @@ class MasterGateway:
         match = _STATUS_RE.match(parsed.path)
         if match and method == "GET":
             return self._status(match["ns"], match["pod"], rid)
+        match = _NODE_STATUS_RE.match(parsed.path)
+        if match and method == "GET":
+            return self._node_status(match["node"], rid)
         if parsed.path == "/addtpuslice" and method == "POST":
             return self._slice_attach(body, rid)
         if parsed.path == "/removetpuslice" and method == "POST":
@@ -282,6 +286,9 @@ class MasterGateway:
         node = objects.node_name(pod)
         if not node:
             raise PodNotFoundError(namespace, pod_name)
+        return self._call_node_worker(node, fn)
+
+    def _call_node_worker(self, node: str, fn):
         target = self.directory.worker_target(node)
         try:
             return fn(self._client(target))
@@ -334,6 +341,25 @@ class MasterGateway:
                 "slave_pod": c.slave_pod,
                 "busy_pids": list(c.busy_pids),
             } for c in resp.chips],
+        }
+
+    def _node_status(self, node: str, rid: str = "-") -> tuple[int, dict]:
+        resp = self._call_node_worker(
+            node, lambda w: w.node_status(request_id=rid))
+        chips = [{
+            "device_id": c.device_id,
+            "device_path": c.device_path,
+            "state": c.state,
+            "pod_name": c.pod_name,
+            "namespace": c.namespace,
+            "accelerator": c.accelerator,
+            "topology": c.topology,
+        } for c in resp.chips]
+        return 200, {
+            "node": resp.node or node,
+            "free": sum(1 for c in chips if c["state"] == "FREE"),
+            "total": len(chips),
+            "chips": chips,
         }
 
     # -- HTTP server -----------------------------------------------------------

@@ -95,6 +95,8 @@ class ClusterSim:
                  kubelet_lag_s: float = 0.0):
         self.node = node
         self.settings = settings or Settings()
+        # the worker knows its node via the downward-API NODE_NAME env
+        self.settings.node_name = self.settings.node_name or node
         self.enumerator = FakeEnumerator(make_chips(n_chips))
         self.podresources = FakePodResourcesClient()
         self.kube = FakeKubeClient()

@@ -129,6 +129,30 @@ def _fmt_num(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
 
 
+class Gauge:
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help_text = help_text
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] = value
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def render(self) -> Iterator[str]:
+        yield f"# HELP {self.name} {self.help_text}"
+        yield f"# TYPE {self.name} gauge"
+        with self._lock:
+            for labels, value in sorted(self._values.items()):
+                yield (f"{self.name}{_fmt_labels(dict(labels))} "
+                       f"{_fmt_num(value)}")
+
+
 class Registry:
     """Process-wide metric set for one binary (worker or master)."""
 
@@ -143,11 +167,16 @@ class Registry:
             "tpumounter_attach_total", "AddTPU calls by result")
         self.detach_results = Counter(
             "tpumounter_detach_total", "RemoveTPU calls by result")
+        self.chips = Gauge(
+            "tpumounter_node_chips",
+            "Chips on this node by allocation state "
+            "(refreshed on every collector snapshot)")
 
     def render_text(self) -> str:
         lines: list[str] = []
         for metric in (self.attach_latency, self.detach_latency,
-                       self.attach_results, self.detach_results):
+                       self.attach_results, self.detach_results,
+                       self.chips):
             lines.extend(metric.render())
         return "\n".join(lines) + "\n"
 

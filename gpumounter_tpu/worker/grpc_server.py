@@ -103,6 +103,28 @@ def _status_handler(service: TPUMountService):
     return handle
 
 
+def _node_status_handler(service: TPUMountService):
+    def handle(request: pb.TPUNodeStatusRequest,
+               context: grpc.ServicerContext) -> pb.TPUNodeStatusResponse:
+        try:
+            chips = service.node_status()
+        except TPUMounterError as e:
+            logger.exception("TPUNodeStatus internal failure")
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        resp = pb.TPUNodeStatusResponse(
+            node=service.settings.node_name)
+        for chip in chips:
+            resp.chips.add(device_id=chip.uuid,
+                           device_path=chip.device_path,
+                           state=chip.state.value,
+                           pod_name=chip.pod_name,
+                           namespace=chip.namespace,
+                           accelerator=chip.accelerator,
+                           topology=chip.topology)
+        return resp
+    return handle
+
+
 # Workers are dialed by pod IP, which cannot appear in a pre-provisioned
 # cert's SANs; the client instead verifies against this fixed DNS name,
 # which the cert must carry (override with TPU_MOUNTER_TLS_SERVER_NAME).
@@ -188,6 +210,10 @@ def build_server(service: TPUMountService,
             _status_handler(service),
             request_deserializer=pb.TPUStatusRequest.FromString,
             response_serializer=pb.TPUStatusResponse.SerializeToString),
+        "TPUNodeStatus": grpc.unary_unary_rpc_method_handler(
+            _node_status_handler(service),
+            request_deserializer=pb.TPUNodeStatusRequest.FromString,
+            response_serializer=pb.TPUNodeStatusResponse.SerializeToString),
     })
     server.add_generic_rpc_handlers((handler,))
     if tls is not None:
@@ -225,6 +251,10 @@ class WorkerClient:
             f"/{SERVICE_NAME}/TPUStatus",
             request_serializer=pb.TPUStatusRequest.SerializeToString,
             response_deserializer=pb.TPUStatusResponse.FromString)
+        self._node_status = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/TPUNodeStatus",
+            request_serializer=pb.TPUNodeStatusRequest.SerializeToString,
+            response_deserializer=pb.TPUNodeStatusResponse.FromString)
 
     @staticmethod
     def _metadata(request_id: str | None):
@@ -254,6 +284,12 @@ class WorkerClient:
                    request_id: str | None = None) -> pb.TPUStatusResponse:
         return self._status(
             pb.TPUStatusRequest(pod_name=pod_name, namespace=namespace),
+            timeout=self.timeout_s, metadata=self._metadata(request_id))
+
+    def node_status(self, request_id: str | None = None
+                    ) -> pb.TPUNodeStatusResponse:
+        return self._node_status(
+            pb.TPUNodeStatusRequest(),
             timeout=self.timeout_s, metadata=self._metadata(request_id))
 
     def close(self) -> None:

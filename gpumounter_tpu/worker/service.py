@@ -302,6 +302,29 @@ class TPUMountService:
                 busy_pids=self.mounter.pod_device_processes(pod, chip)))
         return mount_type, out
 
+    def node_status(self) -> list[TPUChip]:
+        """Node-wide chip inventory with allocation state (one fresh kubelet
+        snapshot) — the "what's free on this node?" view. No reference
+        analog beyond ssh + nvidia-smi. Accelerator/topology come from the
+        node's GKE labels (authoritative, present even for FREE chips);
+        non-GKE/unlabeled nodes report them empty."""
+        from gpumounter_tpu.allocator import topology as topology_lib
+        from gpumounter_tpu.utils.errors import K8sApiError
+        self.allocator.collector.update_status()
+        chips = self.allocator.collector.chips
+        topo = None
+        if self.settings.node_name:
+            try:
+                node = self.kube.get_node(self.settings.node_name)
+                topo = topology_lib.node_topology(node)
+            except K8sApiError:
+                pass        # unlabeled/unreadable node: fields stay empty
+        if topo:
+            for chip in chips:
+                chip.accelerator = topo.accelerator
+                chip.topology = topo.topology
+        return chips
+
     @staticmethod
     def _partially_covered_holders(chips: list[TPUChip], holders: list[str],
                                    all_chips: list[TPUChip]) -> list[str]:

@@ -129,3 +129,16 @@ def test_slice_add_against_multinode(fake_host, tmp_path):
         assert rc == 0 and "SUCCESS" in out
     finally:
         stack.close()
+
+
+def test_node_inventory_command(live_stack):
+    rig, base = live_stack
+    rc, out = run_cli(base, "node", "node-a")
+    assert rc == 0
+    assert "4/4 chips free" in out
+    run_cli(base, "add", "workload", "--tpus", "1")
+    rc, out = run_cli(base, "node", "node-a")
+    assert rc == 0 and "3/4 chips free" in out
+    assert "tpu-pool/workload-slave-pod-" in out
+    rc, out = run_cli(base, "node", "nope")
+    assert rc == 1 and "WorkerNotFound" in out and "None" not in out

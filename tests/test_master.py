@@ -219,3 +219,34 @@ def test_reference_alias_parsebool_variants(stack):
         "GET", "/addgpu/namespace/default/pod/workload/gpu/1"
                "/isEntireMount/maybe")
     assert status == 400 and body["result"] == "BadRequest"
+
+
+def test_node_status_route(stack):
+    """/nodestatus/node/:node — node-wide inventory with free/total counts,
+    reflecting allocation changes."""
+    rig, gw = stack
+    status, body = gw.handle("GET", "/nodestatus/node/node-a")
+    assert status == 200
+    assert body["free"] == 4 and body["total"] == 4
+    gw.handle("GET",
+              "/addtpu/namespace/default/pod/workload/tpu/2"
+              "/isEntireMount/false")
+    status, body = gw.handle("GET", "/nodestatus/node/node-a")
+    assert body["free"] == 2
+    allocated = [c for c in body["chips"] if c["state"] == "ALLOCATED"]
+    assert len(allocated) == 2
+    assert all(c["namespace"] == "tpu-pool" for c in allocated)
+    status, body = gw.handle("GET", "/nodestatus/node/nope")
+    assert status == 502 and body["result"] == "WorkerNotFound"
+
+
+def test_node_status_reports_gke_topology_labels(stack):
+    """On a labeled GKE node, accelerator/topology come from node labels —
+    present even for FREE chips (no allocation required)."""
+    from gpumounter_tpu.testing.sim import make_tpu_node
+    rig, gw = stack
+    rig.sim.kube.put_node(make_tpu_node(name="node-a"))
+    status, body = gw.handle("GET", "/nodestatus/node/node-a")
+    assert status == 200
+    assert all(c["accelerator"] == "tpu-v5-lite-podslice"
+               and c["topology"] == "2x2" for c in body["chips"])

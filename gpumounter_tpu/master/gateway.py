@@ -344,8 +344,19 @@ class MasterGateway:
         }
 
     def _node_status(self, node: str, rid: str = "-") -> tuple[int, dict]:
-        resp = self._call_node_worker(
-            node, lambda w: w.node_status(request_id=rid))
+        try:
+            resp = self._call_node_worker(
+                node, lambda w: w.node_status(request_id=rid))
+        except WorkerNotFoundError:
+            # Distinguish a typo'd node (client error, 404) from a real
+            # node whose worker is missing (genuine 502).
+            try:
+                self.kube.get_node(node)
+            except K8sApiError as e:
+                if e.status == 404:
+                    return 404, {"result": "NodeNotFound",
+                                 "message": f"node {node} does not exist"}
+            raise
         chips = [{
             "device_id": c.device_id,
             "device_path": c.device_path,

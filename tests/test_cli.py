@@ -141,4 +141,4 @@ def test_node_inventory_command(live_stack):
     assert rc == 0 and "3/4 chips free" in out
     assert "tpu-pool/workload-slave-pod-" in out
     rc, out = run_cli(base, "node", "nope")
-    assert rc == 1 and "WorkerNotFound" in out and "None" not in out
+    assert rc == 1 and "NodeNotFound" in out and "None" not in out

@@ -236,7 +236,12 @@ def test_node_status_route(stack):
     allocated = [c for c in body["chips"] if c["state"] == "ALLOCATED"]
     assert len(allocated) == 2
     assert all(c["namespace"] == "tpu-pool" for c in allocated)
+    # typo'd node (doesn't exist in the cluster): client error, 404
     status, body = gw.handle("GET", "/nodestatus/node/nope")
+    assert status == 404 and body["result"] == "NodeNotFound"
+    # real node with no worker on it: genuine 502
+    gw.kube.put_node({"metadata": {"name": "workerless"}})
+    status, body = gw.handle("GET", "/nodestatus/node/workerless")
     assert status == 502 and body["result"] == "WorkerNotFound"
 
 

@@ -1,0 +1,143 @@
+"""Mixture-of-Experts FFN with expert parallelism (the "ep" axis).
+
+Completes the parallelism-scheme coverage of the validation harness
+(dp/sp/tp live in model.py/train.py, pp in pipeline.py): expert weights are
+sharded one-group-per-device over an ``expert`` mesh axis, and the
+dispatch/combine einsums are written in the Mesh-TensorFlow/GShard style so
+XLA lowers them to all-to-alls over ICI — the EP traffic pattern a real
+MoE training job generates (reference has no compute plane at all; this is
+part of the post-attach JAX validation story, SURVEY §2 parallelism note).
+
+Design (top-1 "switch" routing, GShard-style capacity):
+
+- router: tokens [S, d] -> logits [S, E]; each token goes to its argmax
+  expert, dropped if the expert is over capacity (the standard
+  capacity-factor contract — dropping, not re-routing, keeps shapes
+  static for XLA).
+- dispatch [S, E, C] one-hot tensor; expert inputs [E, C, d] via einsum;
+  per-expert FFN [E, C, d]->[E, C, f]->[E, C, d]; combine back to [S, d]
+  weighted by the router probability.
+- sharding: expert-indexed weights P("expert", ...), expert-indexed
+  activations P(None, "expert", ...) — XLA inserts the all-to-alls at the
+  dispatch/combine boundaries.
+
+Everything is jit-level GSPMD (NamedSharding hints, no shard_map): static
+shapes, einsum-only control flow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 64
+    d_ff: int = 128          # per-expert hidden width
+    n_experts: int = 4
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    def capacity(self, n_tokens: int) -> int:
+        """Per-expert token slots (GShard: tokens/experts * factor,
+        rounded up; >=1 so tiny test shapes stay legal)."""
+        return max(1, math.ceil(n_tokens / self.n_experts
+                                * self.capacity_factor))
+
+
+def init_moe_params(key: jax.Array, cfg: MoEConfig) -> Params:
+    kr, k1, k2 = jax.random.split(key, 3)
+    scale = 1.0 / math.sqrt(cfg.d_model)
+    return {
+        "router": (jax.random.normal(kr, (cfg.d_model, cfg.n_experts),
+                                     jnp.float32) * scale).astype(cfg.dtype),
+        "w1": (jax.random.normal(k1, (cfg.n_experts, cfg.d_model, cfg.d_ff),
+                                 jnp.float32) * scale).astype(cfg.dtype),
+        "w2": (jax.random.normal(k2, (cfg.n_experts, cfg.d_ff, cfg.d_model),
+                                 jnp.float32)
+               / math.sqrt(cfg.d_ff)).astype(cfg.dtype),
+    }
+
+
+def moe_param_shardings(mesh: Mesh, expert_axis: str = "expert") -> Params:
+    """Expert-sharded weights; the router is tiny and replicated."""
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+    return {"router": ns(),
+            "w1": ns(expert_axis, None, None),
+            "w2": ns(expert_axis, None, None)}
+
+
+def moe_ffn(params: Params, x: jax.Array, cfg: MoEConfig) -> jax.Array:
+    """x [..., S, d] -> [..., S, d] (leading dims flattened internally).
+
+    Returns the combined expert outputs; tokens dropped for capacity
+    contribute zero (residual connections make that a no-op update, the
+    standard switch-transformer behavior).
+    """
+    lead = x.shape[:-2]
+    s, d = x.shape[-2], x.shape[-1]
+    xs = x.reshape((-1, d))                          # [S_total, d]
+    n_tokens = xs.shape[0]
+    capacity = cfg.capacity(n_tokens)
+
+    logits = (xs.astype(jnp.float32)
+              @ params["router"].astype(jnp.float32))      # [S, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_index = jnp.argmax(probs, axis=-1)              # [S]
+    expert_gate = jnp.max(probs, axis=-1)                  # [S]
+
+    # position of each token within its expert's capacity buffer
+    expert_onehot = jax.nn.one_hot(expert_index, cfg.n_experts,
+                                   dtype=jnp.int32)        # [S, E]
+    position = jnp.cumsum(expert_onehot, axis=0) * expert_onehot - 1  # [S,E]
+    kept = (position >= 0) & (position < capacity)
+    pos_onehot = jax.nn.one_hot(jnp.where(kept, position, -1), capacity,
+                                dtype=xs.dtype)            # [S, E, C]
+    dispatch = pos_onehot * kept[..., None].astype(xs.dtype)   # [S, E, C]
+    combine = dispatch * expert_gate[:, None, None].astype(xs.dtype)
+
+    # all-to-all boundary: token-sharded -> expert-sharded
+    expert_in = jnp.einsum("sec,sd->ecd", dispatch, xs)    # [E, C, d]
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w1"]))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w2"])   # [E, C, d]
+    # all-to-all boundary: expert-sharded -> token-sharded
+    out = jnp.einsum("sec,ecd->sd", combine, expert_out)   # [S, d]
+    return out.reshape((*lead, s, d))
+
+
+def with_expert_sharding(mesh: Mesh, params: Params,
+                         expert_axis: str = "expert") -> Params:
+    """Place MoE params with expert-sharded weights."""
+    return jax.device_put(params, moe_param_shardings(mesh, expert_axis))
+
+
+def make_moe_train_step(cfg: MoEConfig, mesh: Mesh | None = None,
+                        expert_axis: str = "expert",
+                        data_axis: str = "data"):
+    """Minimal EP training step for the dryrun: token batch [B, S, d]
+    data-sharded on B, expert weights sharded on ``expert_axis``; loss is
+    an L2 to a shifted target so grads flow through router + experts."""
+    def loss_fn(params, x):
+        y = moe_ffn(params, x, cfg)
+        return jnp.mean(jnp.square(y - jnp.roll(x, 1, axis=-2)))
+
+    def step(params, x):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x)
+        params = jax.tree.map(lambda p, g: p - 0.1 * g.astype(p.dtype),
+                              params, grads)
+        return params, loss
+
+    if mesh is None:
+        return jax.jit(step)
+    x_sharding = NamedSharding(mesh, P(data_axis, None, None))
+    return jax.jit(step, in_shardings=(moe_param_shardings(mesh, expert_axis),
+                                       x_sharding))

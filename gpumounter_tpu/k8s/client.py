@@ -95,6 +95,12 @@ class KubeClient(abc.ABC):
         """Node object (for TPU topology labels / allocatable). Raises
         :class:`K8sApiError` (status 404 for unknown nodes)."""
 
+    @abc.abstractmethod
+    def create_event(self, namespace: str,
+                     event: dict[str, Any]) -> dict[str, Any]:
+        """POST a core/v1 Event (attach/detach audit trail on the target
+        pod, surfaced by ``kubectl describe``)."""
+
 
 # -- production clients --------------------------------------------------------
 
@@ -183,6 +189,11 @@ class RestKubeClient(KubeClient):
 
     def get_node(self, name: str) -> dict[str, Any]:
         return self._request("GET", f"/api/v1/nodes/{name}")
+
+    def create_event(self, namespace: str,
+                     event: dict[str, Any]) -> dict[str, Any]:
+        return self._request(
+            "POST", f"/api/v1/namespaces/{namespace}/events", body=event)
 
     def watch_pods(self, namespace: str, label_selector: str | None = None,
                    field_selector: str | None = None,
@@ -466,6 +477,7 @@ class FakeKubeClient(KubeClient):
         self.on_delete: list[Callable[[objects.Pod], None]] = []
         self.created: list[objects.Pod] = []
         self.deleted: list[tuple[str, str]] = []
+        self.events: list[dict[str, Any]] = []
         # When >0, delete_pod keeps the pod visible for this long (simulates
         # graceful termination) before it disappears.
         self.delete_latency_s: float = 0.0
@@ -490,6 +502,14 @@ class FakeKubeClient(KubeClient):
             if node is None:
                 raise K8sApiError(404, f"node {name} not found")
             return json.loads(json.dumps(node))
+
+    def create_event(self, namespace: str,
+                     event: dict[str, Any]) -> dict[str, Any]:
+        event = json.loads(json.dumps(event))
+        event.setdefault("metadata", {}).setdefault("namespace", namespace)
+        with self._lock:
+            self.events.append(event)
+        return event
 
     def set_pod_status(self, namespace: str, name: str,
                        **status: Any) -> None:

@@ -90,8 +90,12 @@ class HttpApiserver:
             def do_POST(self):
                 length = int(self.headers.get("Content-Length") or 0)
                 obj = json.loads(self.rfile.read(length) or b"{}")
-                ns = self.path.strip("/").split("/")[3]
+                parts = self.path.strip("/").split("/")
+                ns = parts[3]
                 try:
+                    if parts[4:5] == ["events"]:
+                        return self._json(
+                            201, outer.kube.create_event(ns, obj))
                     return self._json(201, outer.kube.create_pod(ns, obj))
                 except K8sApiError as e:
                     return self._json(e.status or 500, {"message": str(e)})

@@ -23,6 +23,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import threading
+import time
 
 from gpumounter_tpu.actuation.mount import TPUMounter, can_mount
 from gpumounter_tpu.allocator import TPUAllocator
@@ -114,6 +115,9 @@ class TPUMountService:
         # /dev scan exclusion only protects the revoke's OWN sync, not a
         # concurrent mount's scan of the not-yet-unlinked chip node.
         self._pod_locks = KeyedLocks()
+        # (namespace, pod, reason) -> last emit time for event suppression
+        self._event_times: dict = {}
+        self._event_times_lock = threading.Lock()
 
     def _request_lock(self, namespace: str, pod_name: str, request_id: str):
         return self._request_locks.hold((namespace, pod_name, request_id))
@@ -188,9 +192,12 @@ class TPUMountService:
                 pod, tpu_num, per_pod, txn_id=txn_id,
                 request_id=request_id, adopt=adopt)
         except InsufficientTPUError as e:
+            self._record_event(pod, "TPUAttachFailed", str(e), warning=True)
             return AddOutcome(consts.AddResult.INSUFFICIENT_TPU,
                               message=str(e))
         except AllocationTimeoutError as e:
+            self._record_event(pod, "TPUAttachFailed",
+                               f"allocation timed out: {e}", warning=True)
             return AddOutcome(consts.AddResult.INSUFFICIENT_TPU,
                               message=f"allocation timed out: {e}")
 
@@ -214,10 +221,18 @@ class TPUMountService:
             except TPUMounterError as cleanup_err:
                 logger.warning("rollback unmount incomplete: %s", cleanup_err)
             self.allocator.delete_slave_pods(slaves, wait=False)
+            self._record_event(pod, "TPUAttachFailed",
+                               f"actuation failed, rolled back: {e}",
+                               warning=True)
             raise
         logger.info("AddTPU ok: %d chips -> %s/%s (%s)", len(chips),
                     namespace, pod_name,
                     "entire" if is_entire_mount else "single")
+        self._record_event(
+            pod, "TPUAttached",
+            f"attached {len(chips)} TPU chip(s) "
+            f"({'entire' if is_entire_mount else 'single'}-mount): "
+            f"{[c.uuid for c in chips]}")
         return AddOutcome(consts.AddResult.SUCCESS, chips=chips)
 
     # -- RemoveTPU (ref server.go:102-180) -------------------------------------
@@ -272,11 +287,19 @@ class TPUMountService:
             self.mounter.unmount_chips(pod, chips, remaining, force=force)
         except DeviceBusyError as e:
             # ref server.go:148-153 GPUBusy; holder PIDs surfaced to caller
+            self._record_event(
+                pod, "TPUBusy",
+                f"detach refused: chips held by PIDs {e.pids}",
+                warning=True)
             return RemoveOutcome(consts.RemoveResult.TPU_BUSY,
                                  busy_pids=e.pids, message=str(e))
         self.allocator.delete_slave_pods(holders)
         logger.info("RemoveTPU ok: %d chips off %s/%s (force=%s)",
                     len(chips), namespace, pod_name, force)
+        self._record_event(
+            pod, "TPUDetached",
+            f"detached {len(chips)} TPU chip(s) (force={force}): "
+            f"{[c.uuid for c in chips]}")
         return RemoveOutcome(consts.RemoveResult.SUCCESS)
 
     # -- TPUStatus (observability; no reference analog — their check was a
@@ -301,6 +324,67 @@ class TPUMountService:
                 slave_pod=chip.pod_name if held_by_slave else "",
                 busy_pids=self.mounter.pod_device_processes(pod, chip)))
         return mount_type, out
+
+    # -- k8s Events audit trail (kubectl describe visibility; no reference
+    # analog — their only audit was worker logs) ------------------------------
+
+    # Minimum seconds between identical (pod, reason) events — poor man's
+    # EventRecorder aggregation (our minimal client has no PATCH, so
+    # suppress repeats instead of bumping count): a 1 Hz retry loop against
+    # a full node emits ~2 events/min, not thousands/hour.
+    _EVENT_SUPPRESS_S = 30.0
+
+    def _record_event(self, pod: objects.Pod, reason: str, message: str,
+                      warning: bool = False) -> None:
+        """Best-effort core/v1 Event on the target pod; never fails or
+        delays the RPC — the POST runs in a fire-and-forget thread (a
+        degraded apiserver must not stall a mount that already succeeded),
+        and a cluster that denies events create just loses the audit
+        trail, not the mount."""
+        import datetime
+        import secrets
+        name, namespace = objects.name(pod), objects.namespace(pod)
+        now_mono = time.monotonic()
+        key = (namespace, name, reason)
+        with self._event_times_lock:
+            last = self._event_times.get(key, -1e18)
+            if now_mono - last < self._EVENT_SUPPRESS_S:
+                return
+            self._event_times[key] = now_mono
+            if len(self._event_times) > 4096:    # bound the dedupe table
+                cutoff = now_mono - self._EVENT_SUPPRESS_S
+                self._event_times = {k: t for k, t in
+                                     self._event_times.items() if t > cutoff}
+        now = datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%SZ")
+        # object names cap at 253 chars; keep the 22-char suffix, trim the pod
+        event_name = f"{name[:231]}.tpumounter.{secrets.token_hex(5)}"
+        event = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"name": event_name, "namespace": namespace},
+            "involvedObject": {"apiVersion": "v1", "kind": "Pod",
+                               "name": name, "namespace": namespace,
+                               "uid": objects.uid(pod)},
+            "reason": reason,
+            "message": message[:1024],
+            "type": "Warning" if warning else "Normal",
+            "source": {"component": "tpu-mounter-worker",
+                       "host": self.settings.node_name},
+            "firstTimestamp": now,
+            "lastTimestamp": now,
+            "count": 1,
+        }
+
+        def post():
+            try:
+                self.kube.create_event(namespace, event)
+            except Exception as e:
+                logger.warning("event %s for %s/%s not recorded: %s",
+                               reason, namespace, name, e)
+
+        threading.Thread(target=post, daemon=True,
+                         name="tpumounter-event").start()
 
     def node_status(self) -> list[TPUChip]:
         """Node-wide chip inventory with allocation state (one fresh kubelet

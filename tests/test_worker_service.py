@@ -203,3 +203,59 @@ def test_lag_retry_lists_once_per_round_not_per_pod(fake_host):
     # rounds); allow slack but far below the old per-pod cost (4 pods × 4
     # rounds = 16+)
     assert rig.sim.podresources.list_calls <= 6
+
+
+def test_events_audit_trail(rig):
+    """Attach/detach/busy outcomes post core/v1 Events on the target pod
+    (kubectl-describe visibility). Best-effort: a failing events API must
+    not fail the RPC."""
+    import time as time_mod
+
+    def wait_events(n, timeout=5.0):
+        deadline = time_mod.monotonic() + timeout
+        while time_mod.monotonic() < deadline:
+            if len(rig.sim.kube.events) >= n:
+                return
+            time_mod.sleep(0.01)
+        raise AssertionError(
+            f"only {len(rig.sim.kube.events)} events after {timeout}s")
+
+    out = rig.service.add_tpu("workload", "default", 2,
+                              is_entire_mount=True)
+    assert out.result is consts.AddResult.SUCCESS
+    wait_events(1)
+    events = rig.sim.kube.events
+    assert [e["reason"] for e in events] == ["TPUAttached"]
+    ev = events[0]
+    assert ev["type"] == "Normal"
+    assert ev["involvedObject"]["name"] == "workload"
+    assert ev["source"]["component"] == "tpu-mounter-worker"
+    assert "2 TPU chip(s)" in ev["message"]
+
+    out = rig.service.remove_tpu("workload", "default", [], force=False)
+    assert out.result is consts.RemoveResult.SUCCESS
+    wait_events(2)
+    assert [e["reason"] for e in events] == ["TPUAttached", "TPUDetached"]
+
+    # insufficient → Warning event
+    out = rig.service.add_tpu("workload", "default", 99,
+                              is_entire_mount=False)
+    assert out.result is consts.AddResult.INSUFFICIENT_TPU
+    wait_events(3)
+    assert events[-1]["reason"] == "TPUAttachFailed"
+    assert events[-1]["type"] == "Warning"
+
+    # identical (pod, reason) within the suppression window is not re-posted
+    out = rig.service.add_tpu("workload", "default", 99,
+                              is_entire_mount=False)
+    assert out.result is consts.AddResult.INSUFFICIENT_TPU
+    time_mod.sleep(0.2)
+    assert len(events) == 3
+
+    # events API failure is swallowed
+    def broken(ns, ev):
+        raise RuntimeError("rbac denied")
+    rig.sim.kube.create_event = broken
+    out = rig.service.add_tpu("workload", "default", 1,
+                              is_entire_mount=False)
+    assert out.result is consts.AddResult.SUCCESS

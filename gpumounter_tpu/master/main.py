@@ -18,6 +18,8 @@ logger = get_logger("master.main")
 
 
 def main() -> None:
+    from gpumounter_tpu.utils.log import init_logger
+    init_logger()
     settings = Settings.from_env()
     kube = default_kube_client()
     directory = WorkerDirectory(kube,

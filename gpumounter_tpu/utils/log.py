@@ -18,6 +18,24 @@ _DATEFMT = "%Y-%m-%dT%H:%M:%S%z"  # ISO8601, matching the reference encoder
 _configured = False
 
 
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line (``LOG_FORMAT=json``) for clusters whose
+    log pipeline (Stackdriver/Loki) parses structured stdout; the default
+    stays the human-readable key=value line."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        import json
+        out = {
+            "ts": self.formatTime(record, _DATEFMT),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
 def init_logger(log_dir: str | None = None, filename: str | None = None,
                 level: int = logging.DEBUG) -> None:
     """Configure the root ``tpumounter`` logger (ref log.go:11-29).
@@ -29,7 +47,10 @@ def init_logger(log_dir: str | None = None, filename: str | None = None,
     if _configured:
         return
     root.setLevel(level)
-    fmt = logging.Formatter(_FORMAT, datefmt=_DATEFMT)
+    if os.environ.get("LOG_FORMAT", "").lower() == "json":
+        fmt: logging.Formatter = JsonFormatter()
+    else:
+        fmt = logging.Formatter(_FORMAT, datefmt=_DATEFMT)
 
     stream = logging.StreamHandler(sys.stdout)
     stream.setFormatter(fmt)

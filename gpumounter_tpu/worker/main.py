@@ -82,6 +82,8 @@ def build_stack(settings: Settings) -> TPUMountService:
 
 
 def main() -> None:
+    from gpumounter_tpu.utils.log import init_logger
+    init_logger()
     settings = Settings.from_env()
     logger.info("worker starting: node=%s pool_ns=%s driver=%s",
                 settings.node_name, settings.pool_namespace,

@@ -344,21 +344,28 @@ class TPUMountService:
         import datetime
         import secrets
         name, namespace = objects.name(pod), objects.namespace(pod)
-        now_mono = time.monotonic()
-        key = (namespace, name, reason)
-        with self._event_times_lock:
-            last = self._event_times.get(key, -1e18)
-            if now_mono - last < self._EVENT_SUPPRESS_S:
-                return
-            self._event_times[key] = now_mono
-            if len(self._event_times) > 4096:    # bound the dedupe table
-                cutoff = now_mono - self._EVENT_SUPPRESS_S
-                self._event_times = {k: t for k, t in
-                                     self._event_times.items() if t > cutoff}
+        if warning:
+            # Suppress only failure events: those are what retry loops spam
+            # (1 Hz against a full node). Success events are operator-
+            # initiated and rare — every one belongs in the audit trail.
+            now_mono = time.monotonic()
+            key = (namespace, name, reason)
+            with self._event_times_lock:
+                last = self._event_times.get(key, -1e18)
+                if now_mono - last < self._EVENT_SUPPRESS_S:
+                    return
+                self._event_times[key] = now_mono
+                if len(self._event_times) > 4096:   # bound the dedupe table
+                    cutoff = now_mono - self._EVENT_SUPPRESS_S
+                    self._event_times = {
+                        k: t for k, t in self._event_times.items()
+                        if t > cutoff}
         now = datetime.datetime.now(datetime.timezone.utc).strftime(
             "%Y-%m-%dT%H:%M:%SZ")
-        # object names cap at 253 chars; keep the 22-char suffix, trim the pod
-        event_name = f"{name[:231]}.tpumounter.{secrets.token_hex(5)}"
+        # object names cap at 253 chars; keep the 22-char suffix, trim the
+        # pod part and re-trim to a valid RFC1123 label end
+        event_name = (f"{name[:231].rstrip('-.')}"
+                      f".tpumounter.{secrets.token_hex(5)}")
         event = {
             "apiVersion": "v1",
             "kind": "Event",

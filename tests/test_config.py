@@ -34,3 +34,29 @@ def test_settings_rejects_unknown_cgroup_driver():
 def test_remove_result_wire_parity():
     # ref api.proto:32-41 skips enum tag 3
     assert consts.RemoveResult.TPU_NOT_FOUND == 4
+
+
+def test_json_log_format(monkeypatch, capsys):
+    import logging
+    from gpumounter_tpu.utils import log as log_mod
+    monkeypatch.setenv("LOG_FORMAT", "json")
+    monkeypatch.setattr(log_mod, "_configured", False)
+    root = logging.getLogger("tpumounter")
+    old_handlers = list(root.handlers)
+    for h in old_handlers:
+        root.removeHandler(h)
+    try:
+        log_mod.init_logger()
+        log_mod.get_logger("test").info("hello %s", "world")
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        import json
+        obj = json.loads(out)
+        assert obj["message"] == "hello world"
+        assert obj["level"] == "INFO"
+        assert obj["logger"] == "tpumounter.test"
+    finally:
+        for h in list(root.handlers):
+            root.removeHandler(h)
+        for h in old_handlers:
+            root.addHandler(h)
+        monkeypatch.setattr(log_mod, "_configured", True)

@@ -245,17 +245,27 @@ def test_events_audit_trail(rig):
     assert events[-1]["reason"] == "TPUAttachFailed"
     assert events[-1]["type"] == "Warning"
 
-    # identical (pod, reason) within the suppression window is not re-posted
+    # identical WARNING (pod, reason) within the suppression window is not
+    # re-posted; success events are never suppressed
     out = rig.service.add_tpu("workload", "default", 99,
                               is_entire_mount=False)
     assert out.result is consts.AddResult.INSUFFICIENT_TPU
     time_mod.sleep(0.2)
     assert len(events) == 3
 
-    # events API failure is swallowed
+    # events API failure is swallowed (success events bypass suppression,
+    # so this genuinely exercises the broken client)
+    calls = []
+
     def broken(ns, ev):
+        calls.append(ev["reason"])
         raise RuntimeError("rbac denied")
     rig.sim.kube.create_event = broken
     out = rig.service.add_tpu("workload", "default", 1,
                               is_entire_mount=False)
     assert out.result is consts.AddResult.SUCCESS
+    deadline = time_mod.monotonic() + 5
+    while time_mod.monotonic() < deadline and not calls:
+        time_mod.sleep(0.01)
+    assert calls == ["TPUAttached"]      # the POST ran and raised
+    assert len(events) == 3              # nothing recorded

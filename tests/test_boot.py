@@ -219,6 +219,33 @@ def test_worker_and_master_binaries_end_to_end(boot_env):
     assert master.wait(timeout=10) in (0, -signal.SIGTERM)
 
 
+def test_worker_watch_stream_over_http(boot_env):
+    """With a delayed scheduler, the worker's _wait_running must consume
+    the WATCH STREAM through the HTTP facade (the synchronous-schedule test
+    resolves everything in the initial LIST, so the streaming path of
+    RestKubeClient.watch_pods would otherwise never run cross-process)."""
+    b = boot_env
+    b["sim"].schedule_delay_s = 0.8
+    worker = b["launch"]("gpumounter_tpu.worker.main")
+    wait_http(f"http://127.0.0.1:{b['grpc_port'] + 1}/readyz")
+
+    from gpumounter_tpu.worker.grpc_server import WorkerClient
+    client = WorkerClient(f"127.0.0.1:{b['grpc_port']}")
+    try:
+        t0 = time.monotonic()
+        resp = client.add_tpu("workload", "default", 4,
+                              is_entire_mount=True, request_id="watch-rid")
+        elapsed = time.monotonic() - t0
+        assert resp.result == 0, resp
+        assert len(resp.device_ids) == 4
+        # the schedule delay really gated the attach (watch, not busy-poll)
+        assert elapsed >= 0.8
+    finally:
+        client.close()
+    worker.send_signal(signal.SIGTERM)
+    assert worker.wait(timeout=10) in (0, -signal.SIGTERM)
+
+
 def test_worker_fails_fast_without_kubelet(boot_env, tmp_path):
     """Ref SURVEY §3.1: the worker exits rather than serve with a broken
     stack (no kubelet socket ⇒ deploy error)."""

@@ -246,6 +246,69 @@ def test_worker_watch_stream_over_http(boot_env):
     assert worker.wait(timeout=10) in (0, -signal.SIGTERM)
 
 
+def test_worker_killed_mid_attach_retry_adopts(boot_env):
+    """Worker dies (SIGKILL) after creating slave pods but before the
+    mount completes; a FRESH worker process serving the same node resumes
+    the retry of the same request id by adopting the surviving slave pod —
+    no double-allocation, attach completes. The whole idempotency story at
+    the process level."""
+    import grpc
+
+    b = boot_env
+    b["sim"].schedule_delay_s = 2.0     # widen the kill window
+    worker = b["launch"]("gpumounter_tpu.worker.main")
+    wait_http(f"http://127.0.0.1:{b['grpc_port'] + 1}/readyz")
+
+    from gpumounter_tpu.worker.grpc_server import WorkerClient
+    client = WorkerClient(f"127.0.0.1:{b['grpc_port']}")
+    result = {}
+
+    def attach():
+        try:
+            result["resp"] = client.add_tpu(
+                "workload", "default", 4, is_entire_mount=True,
+                request_id="kill-rid")
+        except grpc.RpcError as e:
+            result["error"] = e.code()
+
+    import threading
+    t = threading.Thread(target=attach)
+    t.start()
+    # wait until the in-flight attach has created its slave pod
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not b["sim"].slave_pods():
+        time.sleep(0.05)
+    assert b["sim"].slave_pods(), "slave pod never appeared"
+    worker.send_signal(signal.SIGKILL)
+    worker.wait(timeout=10)
+    t.join(timeout=30)
+    client.close()
+    assert result.get("error") is not None      # caller saw UNAVAILABLE
+
+    # surviving slave pod is still there (reply was lost, chips reserved)
+    assert len(b["sim"].slave_pods()) == 1
+
+    worker2 = b["launch"]("gpumounter_tpu.worker.main")
+    wait_http(f"http://127.0.0.1:{b['grpc_port'] + 1}/readyz")
+    client2 = WorkerClient(f"127.0.0.1:{b['grpc_port']}")
+    try:
+        resp = client2.add_tpu("workload", "default", 4,
+                               is_entire_mount=True, request_id="kill-rid")
+        assert resp.result == 0, resp
+        assert len(resp.device_ids) == 4
+    finally:
+        client2.close()
+    # adoption, not re-allocation: still exactly one slave pod
+    assert len(b["sim"].slave_pods()) == 1
+    devdir = os.path.join(b["fake_host"].proc_root, str(b["pid"]),
+                          "root", "dev")
+    assert sorted(n for n in os.listdir(devdir)
+                  if n.startswith("accel") and not n.endswith("majmin")) == \
+        ["accel0", "accel1", "accel2", "accel3"]
+    worker2.send_signal(signal.SIGTERM)
+    assert worker2.wait(timeout=10) in (0, -signal.SIGTERM)
+
+
 def test_worker_fails_fast_without_kubelet(boot_env, tmp_path):
     """Ref SURVEY §3.1: the worker exits rather than serve with a broken
     stack (no kubelet socket ⇒ deploy error)."""

@@ -120,6 +120,10 @@ def tpu_metrics() -> dict | None:
     if isinstance(report.get("pallas_parity"), dict):
         out["pallas_err_vs_oracle"] = \
             report["pallas_parity"].get("err_pallas_vs_oracle")
+    if isinstance(report.get("attention_kernels"), dict):
+        out["attention_kernels"] = {
+            "rows": report["attention_kernels"].get("rows"),
+            "ok": report["attention_kernels"].get("ok")}
     if isinstance(report.get("drain_cycle"), dict):
         out["drain_cycle"] = {k: report["drain_cycle"].get(k) for k in (
             "abs_err", "drain_restore_s", "ok")}

@@ -31,13 +31,21 @@ d4096 L4 ff24576  16x512                        0.728
 d4096 L4 ff32768  16x512   (**tuned** entry)    0.746
 ==============================================  =====
 
-Negative result worth keeping: swapping XLA's fused attention for the
-``jax.experimental.pallas.ops.tpu.flash_attention`` kernel was SLOWER at
-every shape tried (0.340→0.233 at d1024; 0.648→0.578 at d4096) — XLA's own
-fusion of the T x T softmax is already good at seq 1024, and the pallas
-kernel's block pipeline doesn't win until much longer sequences. The MFU
-lever at these scales is arithmetic intensity (wider matmuls), not a custom
-attention kernel.
+Attention-kernel findings (both measured on v5e, kept for honesty):
+
+- Inside the TRAINING step at seq 1024 (fwd+bwd), swapping XLA's fused
+  attention for ``jax.experimental.pallas.ops.tpu.flash_attention`` was
+  SLOWER at every shape tried (0.340→0.233 MFU at d1024; 0.648→0.578 at
+  d4096) — at short sequence the MFU lever is arithmetic intensity (wider
+  matmuls), not a custom kernel.
+- On the attention op itself at LONG sequence (forward, b4 h8 hd128,
+  bf16), this repo's own pallas block kernel
+  (:mod:`gpumounter_tpu.jaxcheck.pallas_attention`) beats XLA's fused
+  attention ~3x at seq 4096 (~6-8 ms vs ~20 ms) and runs seq 8192
+  (~12 ms) where XLA full attention cannot even allocate its f32 score
+  tensors. At seq <= 2048 the two are within this host's measurement
+  noise. :func:`measure_attention_kernels` reproduces this; the selftest
+  asserts the seq>=4096 win on hardware.
 """
 
 from __future__ import annotations
@@ -112,6 +120,99 @@ def tuned_config():
     from gpumounter_tpu.jaxcheck.model import ModelConfig
     return ModelConfig(vocab=256, d_model=4096, n_heads=32, n_layers=4,
                        d_ff=32768, dtype=jnp.bfloat16)
+
+
+def measure_attention_kernels(seqs: tuple[int, ...] = (1024, 2048, 4096),
+                              pallas_only_seqs: tuple[int, ...] = (8192,),
+                              b: int = 4, h: int = 8, d: int = 128,
+                              chain: int = 20) -> dict[str, Any]:
+    """Forward attention-op microbenchmark: XLA fused full attention vs the
+    repo's pallas flash block kernel, bf16, causal.
+
+    Timing: ``chain`` serially-dependent applications run inside ONE jit
+    call (a ``lax.scan`` whose q perturbation depends on the carry, so XLA
+    can neither CSE nor overlap them), ended by one d2h sync. Per-op time
+    = call time / chain. Sub-10ms ops cannot be measured call-per-sync
+    here: each sync is a tunnel round-trip with jitter larger than the op
+    itself (two-window subtraction went negative in testing).
+
+    ``pallas_only_seqs``: lengths expected to exceed HBM for XLA full
+    attention. Whether XLA is actually attempted is decided per chip from
+    its reported memory: if the two f32 [b,h,t,t] score temps alone exceed
+    80% of HBM the attempt is skipped as "OOM(predicted ...)" (a doomed
+    compile burns ~10s); on larger-HBM chips it IS attempted, so the
+    "pallas extends the reachable context" claim stays falsifiable
+    hardware-by-hardware rather than confirmed by construction.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from gpumounter_tpu.jaxcheck.pallas_attention import flash_block_bthd
+    from gpumounter_tpu.jaxcheck.ring_attention import full_attention
+
+    def pallas_attn(q, k, v):
+        pv, m, l = flash_block_bthd(q, k, v, 0, 0)
+        return pv / l.transpose(0, 2, 1)[..., None]
+
+    def chained(attn):
+        def fn(q, k, v):
+            def body(carry, _):
+                out = attn(q + (carry * 1e-30).astype(q.dtype), k, v)
+                return jnp.sum(out.astype(jnp.float32)), None
+            s, _ = lax.scan(body, jnp.float32(0.0), None, length=chain)
+            return s
+        return jax.jit(fn)
+
+    def timed(fn, q, k, v) -> float:
+        float(fn(q, k, v))                       # compile + warm
+        t0 = time.perf_counter()
+        float(fn(q, k, v))                       # one sync per chained call
+        return (time.perf_counter() - t0) / chain * 1e3
+
+    def hbm_bytes() -> int | None:
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return int(stats.get("bytes_limit") or 0) or None
+        except Exception:
+            return None
+
+    xla_fn = chained(full_attention)
+    pallas_fn = chained(pallas_attn)
+    hbm = hbm_bytes()
+    rows: list[dict[str, Any]] = []
+    for t_len in (*seqs, *pallas_only_seqs):
+        key = jax.random.PRNGKey(t_len)
+        q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                     (b, t_len, h, d), jnp.bfloat16)
+                   for i in range(3))
+        row: dict[str, Any] = {"seq": t_len}
+        score_temps = 2 * b * h * t_len * t_len * 4    # two f32 [b,h,t,t]
+        if (t_len in pallas_only_seqs and hbm is not None
+                and score_temps > 0.8 * hbm):
+            row["xla_ms"] = (f"OOM(predicted: {score_temps / 2**30:.1f}GiB "
+                             f"score temps vs {hbm / 2**30:.1f}GiB hbm)")
+        else:
+            try:
+                row["xla_ms"] = round(timed(xla_fn, q, k, v), 3)
+            except Exception as e:
+                row["xla_ms"] = ("OOM" if "memory" in str(e).lower()
+                                 else f"err:{str(e)[:80]}")
+        try:
+            row["pallas_ms"] = round(timed(pallas_fn, q, k, v), 3)
+        except Exception as e:
+            row["pallas_ms"] = f"err:{str(e)[:80]}"
+        rows.append(row)
+    # The falsifiable claim is only what reproduces run-to-run on the
+    # shared tunnelled chip: pallas wins at seq >= 4096 (measured ~3x) and
+    # runs the pallas-only lengths at all. Shorter sequences are within
+    # measurement noise and reported informationally.
+    ok = all(
+        isinstance(r["pallas_ms"], float)
+        and (not isinstance(r["xla_ms"], float)
+             or r["seq"] < 4096 or r["pallas_ms"] <= r["xla_ms"])
+        for r in rows)
+    return {"shape": {"b": b, "h": h, "head_dim": d, "dtype": "bfloat16"},
+            "rows": rows, "ok": bool(ok)}
 
 
 def measure_both(batch: int = 8, t_len: int = 1024) -> dict[str, Any]:

@@ -266,6 +266,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="tpumounterctl",
         description="hot-attach/detach TPU chips on running pods")
+    import gpumounter_tpu
+    parser.add_argument("--version", action="version",
+                        version=f"tpumounterctl {gpumounter_tpu.__version__}")
     _add_common(parser, suppress=False)
     sub = parser.add_subparsers(dest="command", required=True)
 

@@ -112,6 +112,21 @@ def flash_block(q, k, v, q_offset, k_offset, interpret: bool = False,
     )(offsets, q, k, v)
 
 
+def normalize_flash_stats(pv, l):
+    """Final softmax normalization of the block kernel's running stats:
+    pv [B,TQ,H,D] / l [B,H,TQ] -> attention output [B,TQ,H,D]. Single
+    home for the expression so the kernel's output contract has one
+    consumer-side implementation."""
+    return pv / l.transpose(0, 2, 1)[..., None]
+
+
+def flash_attention(q, k, v, interpret: bool = False):
+    """Complete causal flash attention via the block kernel (forward only;
+    the trainable path uses XLA's fused attention — see perf.py)."""
+    pv, m, l = flash_block_bthd(q, k, v, 0, 0, interpret=interpret)
+    return normalize_flash_stats(pv, l)
+
+
 def flash_block_bthd(q, k, v, q_offset, k_offset,
                      interpret: bool = False,
                      logical_d: int | None = None):

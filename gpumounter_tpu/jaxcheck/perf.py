@@ -147,12 +147,9 @@ def measure_attention_kernels(seqs: tuple[int, ...] = (1024, 2048, 4096),
     import jax
     import jax.numpy as jnp
     from jax import lax
-    from gpumounter_tpu.jaxcheck.pallas_attention import flash_block_bthd
+    from gpumounter_tpu.jaxcheck.pallas_attention import \
+        flash_attention as pallas_attn
     from gpumounter_tpu.jaxcheck.ring_attention import full_attention
-
-    def pallas_attn(q, k, v):
-        pv, m, l = flash_block_bthd(q, k, v, 0, 0)
-        return pv / l.transpose(0, 2, 1)[..., None]
 
     def chained(attn):
         def fn(q, k, v):
@@ -195,8 +192,12 @@ def measure_attention_kernels(seqs: tuple[int, ...] = (1024, 2048, 4096),
             try:
                 row["xla_ms"] = round(timed(xla_fn, q, k, v), 3)
             except Exception as e:
-                row["xla_ms"] = ("OOM" if "memory" in str(e).lower()
-                                 else f"err:{str(e)[:80]}")
+                msg = str(e).lower()
+                row["xla_ms"] = (
+                    "OOM" if ("memory" in msg or "hbm" in msg
+                              or "resource_exhausted" in msg
+                              or "resource exhausted" in msg)
+                    else f"err:{str(e)[:120]}")
         try:
             row["pallas_ms"] = round(timed(pallas_fn, q, k, v), 3)
         except Exception as e:
@@ -205,12 +206,19 @@ def measure_attention_kernels(seqs: tuple[int, ...] = (1024, 2048, 4096),
     # The falsifiable claim is only what reproduces run-to-run on the
     # shared tunnelled chip: pallas wins at seq >= 4096 (measured ~3x) and
     # runs the pallas-only lengths at all. Shorter sequences are within
-    # measurement noise and reported informationally.
-    ok = all(
-        isinstance(r["pallas_ms"], float)
-        and (not isinstance(r["xla_ms"], float)
-             or r["seq"] < 4096 or r["pallas_ms"] <= r["xla_ms"])
-        for r in rows)
+    # measurement noise and reported informationally. An XLA memory limit
+    # ("OOM"/"OOM(predicted ...)") is an acceptable non-result — that IS
+    # the pallas advantage — but any other XLA failure ("err:...") means
+    # the headline comparison never executed and must NOT count as a win.
+    def row_ok(r) -> bool:
+        if not isinstance(r["pallas_ms"], float):
+            return False
+        xla = r["xla_ms"]
+        if isinstance(xla, float):
+            return r["seq"] < 4096 or r["pallas_ms"] <= xla
+        return str(xla).startswith("OOM")
+
+    ok = all(row_ok(r) for r in rows)
     return {"shape": {"b": b, "h": h, "head_dim": d, "dtype": "bfloat16"},
             "rows": rows, "ok": bool(ok)}
 

@@ -139,7 +139,8 @@ def check_pallas_parity(b: int = 2, t: int = 256, h: int = 4,
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from gpumounter_tpu.jaxcheck.pallas_attention import flash_block_bthd
+    from gpumounter_tpu.jaxcheck.pallas_attention import (
+        flash_block_bthd, normalize_flash_stats)
     from gpumounter_tpu.jaxcheck.ring_attention import full_attention
 
     rng = np.random.default_rng(0)
@@ -161,7 +162,7 @@ def check_pallas_parity(b: int = 2, t: int = 256, h: int = 4,
                                         jnp.asarray(v)))
         pv, m, l = flash_block_bthd(jnp.asarray(q), jnp.asarray(k),
                                     jnp.asarray(v), 0, 0)
-        out = np.asarray(pv / np.asarray(l).transpose(0, 2, 1)[..., None])
+        out = np.asarray(normalize_flash_stats(pv, l))
 
     err_pallas = float(np.abs(out - oracle).max())
     err_ref = float(np.abs(ref - oracle).max())

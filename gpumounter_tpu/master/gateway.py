@@ -188,6 +188,9 @@ class MasterGateway:
         parsed = urllib.parse.urlparse(path)
         if parsed.path == "/healthz":
             return 200, {"status": "ok"}
+        if parsed.path == "/version":
+            import gpumounter_tpu
+            return 200, {"version": gpumounter_tpu.__version__}
         match = _ADD_RE.match(parsed.path) or \
             _ADD_GPU_RE.match(parsed.path)
         if match and method == "GET":

@@ -63,7 +63,9 @@ def test_pallas_block_matches_reference():
     from gpumounter_tpu.jaxcheck.pallas_attention import flash_block_bthd
     q, k, v = make_qkv(jax.random.PRNGKey(3), b=1, t=256, h=2, d=64)
     pv, m, l = flash_block_bthd(q, k, v, 0, 0, interpret=True)
-    out = pv / l.transpose(0, 2, 1)[..., None]
+    from gpumounter_tpu.jaxcheck.pallas_attention import \
+        normalize_flash_stats
+    out = normalize_flash_stats(pv, l)
     np.testing.assert_allclose(np.asarray(full_attention(q, k, v)),
                                np.asarray(out), atol=2e-5, rtol=2e-5)
 

@@ -255,3 +255,10 @@ def test_node_status_reports_gke_topology_labels(stack):
     assert status == 200
     assert all(c["accelerator"] == "tpu-v5-lite-podslice"
                and c["topology"] == "2x2" for c in body["chips"])
+
+
+def test_version_route(stack):
+    import gpumounter_tpu
+    rig, gw = stack
+    status, body = gw.handle("GET", "/version")
+    assert status == 200 and body["version"] == gpumounter_tpu.__version__

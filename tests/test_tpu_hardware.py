@@ -99,9 +99,13 @@ def test_tpu_pallas_kernel_wins_at_long_sequence(selftest_report):
     way pallas must run it; if XLA was attempted and ran, pallas must not
     lose there."""
     ak = selftest_report["attention_kernels"]
-    assert ak["ok"], ak
+    assert ak["ok"], ak     # ok=False on any "err:" non-result (perf.py)
     by_seq = {r["seq"]: r for r in ak["rows"]}
-    assert by_seq[4096]["pallas_ms"] < by_seq[4096]["xla_ms"]
+    xla4k = by_seq[4096]["xla_ms"]
+    if isinstance(xla4k, float):
+        assert by_seq[4096]["pallas_ms"] < xla4k
+    else:                   # small-HBM chip: XLA already out of memory here
+        assert str(xla4k).startswith("OOM")
     assert isinstance(by_seq[8192]["pallas_ms"], float)
     xla8k = by_seq[8192]["xla_ms"]
     if isinstance(xla8k, float):        # big-HBM chip: XLA ran

@@ -29,4 +29,4 @@ Layer map (mirrors SURVEY.md §1; reference files cited per module):
   (ref ``pkg/util/log``, ``pkg/util/gpu/types.go``)
 """
 
-__version__ = "0.4.0"   # round-4 build; keep in sync with pyproject.toml
+__version__ = "0.4.0"   # single source; pyproject reads this dynamically

@@ -353,13 +353,19 @@ class TPUAllocator:
 
     # -- slave pod resolution --------------------------------------------------
 
+    @staticmethod
+    def _owner_selector(owner_name: str, owner_namespace: str) -> str:
+        """The ownership label selector — single source so resolution and
+        removal can never drift apart on the label scheme."""
+        return (f"{consts.OWNER_POD_LABEL_KEY}={owner_name},"
+                f"{consts.OWNER_NAMESPACE_LABEL_KEY}={owner_namespace}")
+
     def request_slave_pods(self, owner_name: str, owner_namespace: str,
                            request_id: str) -> set[str]:
         """Slave pods stamped with this request id (surviving pods of a
         prior attempt of the same logical request)."""
-        selector = (f"{consts.OWNER_POD_LABEL_KEY}={owner_name},"
-                    f"{consts.OWNER_NAMESPACE_LABEL_KEY}={owner_namespace},"
-                    f"{consts.REQUEST_ID_LABEL_KEY}={request_id}")
+        selector = (self._owner_selector(owner_name, owner_namespace)
+                    + f",{consts.REQUEST_ID_LABEL_KEY}={request_id}")
         return {objects.name(p)
                 for p in self.kube.list_pods(self.settings.pool_namespace,
                                              label_selector=selector)}
@@ -371,8 +377,7 @@ class TPUAllocator:
         only (collector.go:155-159), which conflates same-named owners in
         different namespaces on one node. ``txn_id`` narrows to one slice
         transaction's pods."""
-        selector = (f"{consts.OWNER_POD_LABEL_KEY}={owner_name},"
-                    f"{consts.OWNER_NAMESPACE_LABEL_KEY}={owner_namespace}")
+        selector = self._owner_selector(owner_name, owner_namespace)
         if txn_id:
             selector += f",{consts.TXN_LABEL_KEY}={txn_id}"
         return {objects.name(p)
@@ -399,10 +404,10 @@ class TPUAllocator:
         (chips, slave_pod_names_holding_them, all_owner_slave_names) — the
         last lets callers reuse this LIST instead of re-issuing it.
         """
-        selector = (f"{consts.OWNER_POD_LABEL_KEY}={owner_name},"
-                    f"{consts.OWNER_NAMESPACE_LABEL_KEY}={owner_namespace}")
-        slaves = self.kube.list_pods(self.settings.pool_namespace,
-                                     label_selector=selector)
+        slaves = self.kube.list_pods(
+            self.settings.pool_namespace,
+            label_selector=self._owner_selector(owner_name,
+                                                owner_namespace))
         all_slave_names = {objects.name(p) for p in slaves}
         in_scope = {objects.name(p) for p in slaves
                     if not txn_id
@@ -485,9 +490,8 @@ class TPUAllocator:
         try:
             slaves = self.kube.list_pods(
                 self.settings.pool_namespace,
-                label_selector=(
-                    f"{consts.OWNER_POD_LABEL_KEY}={owner_name},"
-                    f"{consts.OWNER_NAMESPACE_LABEL_KEY}={owner_namespace}"))
+                label_selector=self._owner_selector(owner_name,
+                                                    owner_namespace))
         except K8sApiError:
             return consts.MountType.UNKNOWN
         if not slaves:

@@ -142,3 +142,39 @@ def test_node_inventory_command(live_stack):
     assert "tpu-pool/workload-slave-pod-" in out
     rc, out = run_cli(base, "node", "nope")
     assert rc == 1 and "NodeNotFound" in out and "None" not in out
+
+
+def test_slice_remove_retry_converges(fake_host, tmp_path, monkeypatch):
+    """A retried slice remove after a lost reply converges to SUCCESS
+    (detach counts TPU_NOT_FOUND as done) — the CLI's retry of slice
+    remove is safe even without add-style adoption machinery."""
+    from gpumounter_tpu.testing.sim import MultiNodeStack
+    from gpumounter_tpu.utils.config import HostPaths
+    hosts = []
+    for i in range(2):
+        root = tmp_path / f"host{i}"
+        for d in ("dev", "proc", "sys/fs/cgroup"):
+            (root / d).mkdir(parents=True)
+        hosts.append(HostPaths(
+            dev_root=str(root / "dev"), proc_root=str(root / "proc"),
+            sys_root=str(root / "sys"),
+            cgroup_root=str(root / "sys" / "fs" / "cgroup"),
+            kubelet_socket=str(root / "pr" / "kubelet.sock")))
+    stack = MultiNodeStack(hosts)
+    try:
+        rc, _ = run_cli(stack.base, "slice", "add",
+                        "-p", "default/workload-0", "-p",
+                        "default/workload-1")
+        assert rc == 0
+        # first remove commits server-side but the CLI "loses" the reply:
+        # simulate by retrying AFTER a successful remove
+        rc, _ = run_cli(stack.base, "slice", "remove",
+                        "-p", "default/workload-0", "-p",
+                        "default/workload-1")
+        assert rc == 0
+        rc, out = run_cli(stack.base, "slice", "remove",
+                          "-p", "default/workload-0", "-p",
+                          "default/workload-1")
+        assert rc == 0 and "SUCCESS" in out     # converged, not 409
+    finally:
+        stack.close()

@@ -211,6 +211,16 @@ def test_worker_and_master_binaries_end_to_end(boot_env):
     with urllib.request.urlopen(f"{health}/metrics") as resp:
         metrics = resp.read().decode()
     assert "attach_seconds" in metrics
+    assert "tpumounter_node_chips" in metrics
+
+    # the audit-trail Events crossed the process boundary: worker binary ->
+    # kubeconfig client -> HTTP facade -> FakeKubeClient store
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and \
+            len(b["sim"].kube.events) < 2:
+        time.sleep(0.05)
+    reasons = [e["reason"] for e in b["sim"].kube.events]
+    assert reasons == ["TPUAttached", "TPUDetached"], reasons
 
     # clean shutdown on SIGTERM: default handler (no traceback-exit-1)
     worker.send_signal(signal.SIGTERM)

@@ -149,7 +149,7 @@ class TPUMounter:
     # -- attach ----------------------------------------------------------------
 
     def mount_chips(self, pod: objects.Pod, new_chips: list[TPUChip],
-                    all_chips_after: list[TPUChip]) -> None:
+                    all_chips_after: list[TPUChip]) -> int:
         """Expose ``new_chips`` inside the pod's first container.
 
         ``all_chips_after`` is the pod's complete chip set including the new
@@ -158,19 +158,26 @@ class TPUMounter:
 
         Ref util.go:17-71 MountGPU, per chip: cgroup allow -> pick PID ->
         mknod. Companion nodes (VFIO) ride along.
+
+        Returns the number of device nodes newly created (0 when every node
+        already existed — i.e. this call resumed an attach that a prior
+        attempt had fully actuated).
         """
+        created = 0
         for container_id, pid in self._actuatable_containers(pod):
             self.cgroups.sync_device_access(pod, container_id,
                                             all_chips_after)
             for chip in new_chips:
-                self.actuator.create_device_node(
-                    pid, chip.container_path, chip.major, chip.minor)
+                created += bool(self.actuator.create_device_node(
+                    pid, chip.container_path, chip.major, chip.minor))
                 for companion in chip.companions:
-                    self.actuator.create_device_node(
+                    created += bool(self.actuator.create_device_node(
                         pid, companion.container_path, companion.major,
-                        companion.minor)
-        logger.info("mounted %d chips into %s/%s",
-                    len(new_chips), objects.namespace(pod), objects.name(pod))
+                        companion.minor))
+        logger.info("mounted %d chips (%d new nodes) into %s/%s",
+                    len(new_chips), created, objects.namespace(pod),
+                    objects.name(pod))
+        return created
 
     # -- detach ----------------------------------------------------------------
 

@@ -39,7 +39,9 @@ class ContainerNsActuator(abc.ABC):
     @abc.abstractmethod
     def create_device_node(self, pid: int, device_path: str, major: int,
                            minor: int,
-                           mode: int = consts.DEVICE_FILE_MODE) -> None:
+                           mode: int = consts.DEVICE_FILE_MODE) -> bool:
+        """Returns True when a node was newly created, False when an
+        existing node short-circuited (the idempotent-resume signal)."""
         ...
 
     @abc.abstractmethod
@@ -72,14 +74,14 @@ class ProcRootActuator(ContainerNsActuator):
 
     def create_device_node(self, pid: int, device_path: str, major: int,
                            minor: int,
-                           mode: int = consts.DEVICE_FILE_MODE) -> None:
+                           mode: int = consts.DEVICE_FILE_MODE) -> bool:
         target = self._container_path(pid, device_path)
         parent = os.path.dirname(target)
         try:
             os.makedirs(parent, exist_ok=True)
             if os.path.exists(target):
                 logger.debug("device node already present: %s", target)
-                return
+                return False
             if self.fake_nodes:
                 with open(target, "w"):
                     pass
@@ -95,6 +97,7 @@ class ProcRootActuator(ContainerNsActuator):
                 f"mount ns failed: {e}") from e
         logger.info("created %s (c %d:%d) via pid %d", device_path, major,
                     minor, pid)
+        return True
 
     def remove_device_node(self, pid: int, device_path: str) -> None:
         target = self._container_path(pid, device_path)
@@ -129,7 +132,7 @@ class NsenterActuator(ContainerNsActuator):
     def __init__(self, nsenter_bin: str = "nsenter"):
         self.nsenter_bin = nsenter_bin
 
-    def _run_in_mount_ns(self, pid: int, script: str) -> None:
+    def _run_in_mount_ns(self, pid: int, script: str) -> str:
         cmd = [self.nsenter_bin, "--target", str(pid), "--mount", "--",
                "sh", "-c", script]
         try:
@@ -141,16 +144,19 @@ class NsenterActuator(ContainerNsActuator):
             raise ActuationError(
                 f"nsenter script {script!r} in pid {pid} failed "
                 f"rc={proc.returncode}: {proc.stderr.strip()}")
+        return proc.stdout
 
     def create_device_node(self, pid: int, device_path: str, major: int,
                            minor: int,
-                           mode: int = consts.DEVICE_FILE_MODE) -> None:
+                           mode: int = consts.DEVICE_FILE_MODE) -> bool:
         # ref namespace.go:167-177 AddGPUDeviceFile — but idempotent: an
         # existing node short-circuits (EEXIST must not fail the resume
         # path), matching ProcRootActuator's behaviour.
-        self._run_in_mount_ns(
+        out = self._run_in_mount_ns(
             pid, f"test -e {device_path} || "
-                 f"mknod -m {mode:o} {device_path} c {major} {minor}")
+                 f"{{ mknod -m {mode:o} {device_path} c {major} {minor}"
+                 f" && echo created; }}")
+        return "created" in out
 
     def remove_device_node(self, pid: int, device_path: str) -> None:
         # ref namespace.go:179-189 RemoveGPUDeviceFile
@@ -175,7 +181,12 @@ class RecordingActuator(ContainerNsActuator):
                            mode=consts.DEVICE_FILE_MODE):
         if self.fail_on_create:
             raise ActuationError("injected create failure")
+        # Idempotent like the real actuators: re-creating an already
+        # recorded (pid, path) node is a no-op short-circuit.
+        if any(p == pid and d == device_path for p, d, _, _ in self.created):
+            return False
         self.created.append((pid, device_path, major, minor))
+        return True
 
     def remove_device_node(self, pid, device_path):
         self.removed.append((pid, device_path))

@@ -242,9 +242,13 @@ class TPUAllocator:
         ({name: chips}, still_empty_names)."""
         # The deadline is extended whenever a round makes progress, so a
         # kubelet resolving pods serially still gets a full
-        # kubelet_lag_timeout_s window per stall — matching the per-pod
-        # version's worst-case budget (N*T) without its per-pod LISTs.
-        deadline = time.monotonic() + self.settings.kubelet_lag_timeout_s
+        # kubelet_lag_timeout_s window per stall. Total wall time is hard-
+        # capped at N*T (the serial worst case) so an attach can never block
+        # longer than len(names) * kubelet_lag_timeout_s, regardless of
+        # progress pattern.
+        start = time.monotonic()
+        hard_deadline = start + len(names) * self.settings.kubelet_lag_timeout_s
+        deadline = start + self.settings.kubelet_lag_timeout_s
         poll_s = 0.2
         out: dict[str, list[TPUChip]] = {name: [] for name in names}
         pending = set(names)
@@ -259,8 +263,9 @@ class TPUAllocator:
                     pending.discard(name)
                     progressed = True
             if progressed:
-                deadline = (time.monotonic()
-                            + self.settings.kubelet_lag_timeout_s)
+                deadline = min(
+                    time.monotonic() + self.settings.kubelet_lag_timeout_s,
+                    hard_deadline)
             if not pending or time.monotonic() >= deadline:
                 return out, pending
             logger.info("kubelet lists no devices yet for %s; retrying",

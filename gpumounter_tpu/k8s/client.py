@@ -355,6 +355,13 @@ class KubeconfigKubeClient(RestKubeClient):
                         self._ssl = ssl.create_default_context()
                     if cert.file:
                         self._ssl.load_cert_chain(cert.file, key.file or None)
+                    elif key.file:
+                        # Fail-closed (client-go parity): a client key
+                        # without its certificate half would silently
+                        # proceed anonymous/token-less.
+                        raise K8sApiError(
+                            0, f"kubeconfig {path}: user has client-key "
+                               "material but no client-certificate")
             except K8sApiError:
                 raise
             except (OSError, ssl.SSLError) as e:
@@ -578,7 +585,8 @@ class FakeKubeClient(KubeClient):
             if pod is not None:
                 for hook in list(self.on_delete):
                     hook(pod)
-        self.deleted.append((namespace, name))
+        with self._lock:
+            self.deleted.append((namespace, name))
         if self.delete_latency_s > 0:
             t = threading.Timer(self.delete_latency_s, _remove)
             t.daemon = True

@@ -48,7 +48,10 @@ class Settings:
     # On a real node the kubelet's PodResources listing can lag a slave
     # pod's Running transition by a beat (device-plugin assignment is
     # asynchronous); chip collection retries within this bound before
-    # declaring the allocation failed.
+    # declaring the allocation failed. The bound is per stall: a serially
+    # resolving kubelet gets a fresh window after each pod that resolves,
+    # so an N-slave-pod attach can wait up to N * this value in total
+    # (hard-capped there by the allocator).
     kubelet_lag_timeout_s: float = 10.0
     # Accept regular files as chips (BASELINE config 1 / process-level boot
     # tests on CPU-only hosts). Never set in the shipped DaemonSet.

@@ -20,6 +20,7 @@ outcomes; the gRPC adapter maps them onto the proto enums. Deliberate deltas:
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import threading
@@ -118,6 +119,12 @@ class TPUMountService:
         # (namespace, pod, reason) -> last emit time for event suppression
         self._event_times: dict = {}
         self._event_times_lock = threading.Lock()
+        # Event POSTs drain through ONE worker thread over a bounded
+        # drop-oldest queue: thread-per-event against a slow apiserver
+        # (30s timeout x call rate) would pile up unbounded threads.
+        self._event_queue: collections.deque = collections.deque(maxlen=64)
+        self._event_cond = threading.Condition()
+        self._event_thread: threading.Thread | None = None
 
     def _request_lock(self, namespace: str, pod_name: str, request_id: str):
         return self._request_locks.hold((namespace, pod_name, request_id))
@@ -209,7 +216,7 @@ class TPUMountService:
             self.allocator.slave_pod_names(pod_name, namespace),
             refresh=False)
         try:
-            self.mounter.mount_chips(pod, chips, all_after)
+            created_nodes = self.mounter.mount_chips(pod, chips, all_after)
         except TPUMounterError as e:
             # rollback (ref server.go:87-92) + revert partial actuation
             logger.error("mount failed, rolling back %d slave pods: %s",
@@ -228,8 +235,16 @@ class TPUMountService:
         logger.info("AddTPU ok: %d chips -> %s/%s (%s)", len(chips),
                     namespace, pod_name,
                     "entire" if is_entire_mount else "single")
+        # A retry that adopted a fully-mounted prior attempt is the SAME
+        # logical attach — record it under a distinct reason so the audit
+        # trail shows one TPUAttached per attach, not one per retry. "Fully
+        # mounted" means actuation found nothing left to do: a retry that
+        # adopted the slave pods but still created device nodes (worker died
+        # between allocate and mount) is the completing attempt and records
+        # the real TPUAttached.
+        resumed = bool(adopt) and set(slaves) <= adopt and created_nodes == 0
         self._record_event(
-            pod, "TPUAttached",
+            pod, "TPUAttachResumed" if resumed else "TPUAttached",
             f"attached {len(chips)} TPU chip(s) "
             f"({'entire' if is_entire_mount else 'single'}-mount): "
             f"{[c.uuid for c in chips]}")
@@ -390,8 +405,33 @@ class TPUMountService:
                 logger.warning("event %s for %s/%s not recorded: %s",
                                reason, namespace, name, e)
 
-        threading.Thread(target=post, daemon=True,
-                         name="tpumounter-event").start()
+        with self._event_cond:
+            if self._event_thread is None:
+                self._event_thread = threading.Thread(
+                    target=self._drain_events, daemon=True,
+                    name="tpumounter-events")
+                self._event_thread.start()
+            if len(self._event_queue) == self._event_queue.maxlen:
+                # The audit trail is about to lose its oldest entry — say so,
+                # or operators can't tell the trail is incomplete.
+                logger.warning(
+                    "event queue full (%d); dropping oldest audit event",
+                    self._event_queue.maxlen)
+            self._event_queue.append(post)   # deque(maxlen): drops oldest
+            self._event_cond.notify()
+
+    def _drain_events(self) -> None:
+        while True:
+            with self._event_cond:
+                while not self._event_queue:
+                    timed_out = not self._event_cond.wait(timeout=60.0)
+                    if timed_out and not self._event_queue:
+                        # Idle: exit rather than pin the service object
+                        # graph alive forever; _record_event restarts us.
+                        self._event_thread = None
+                        return
+                post = self._event_queue.popleft()
+            post()
 
     def node_status(self) -> list[TPUChip]:
         """Node-wide chip inventory with allocation state (one fresh kubelet
@@ -411,9 +451,12 @@ class TPUMountService:
             except K8sApiError:
                 pass        # unlabeled/unreadable node: fields stay empty
         if topo:
-            for chip in chips:
-                chip.accelerator = topo.accelerator
-                chip.topology = topo.topology
+            # Stamp copies, not the collector's live objects: mutating shared
+            # chips here would race a concurrent update_status inventory
+            # rebuild and could serialise a torn view.
+            chips = [dataclasses.replace(c, accelerator=topo.accelerator,
+                                         topology=topo.topology)
+                     for c in chips]
         return chips
 
     @staticmethod

@@ -58,6 +58,21 @@ def test_replay_after_full_success_returns_same_chips(rig):
     assert len(rig.sim.slave_pods()) == 1
 
 
+def test_replay_of_full_success_records_resumed_event(rig):
+    """One logical attach = one TPUAttached in the audit trail; the replay
+    that adopted a fully-mounted prior attempt records TPUAttachResumed
+    instead of a duplicate TPUAttached."""
+    import time
+    rig.service.add_tpu("workload", "default", 4, True, request_id=RID)
+    rig.service.add_tpu("workload", "default", 4, True, request_id=RID)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and len(rig.sim.kube.events) < 2:
+        time.sleep(0.02)
+    reasons = [e["reason"] for e in rig.sim.kube.events]
+    assert reasons.count("TPUAttached") == 1
+    assert reasons.count("TPUAttachResumed") == 1
+
+
 def test_entire_mount_without_request_id_still_denied_on_repeat(rig):
     """No request id ⇒ no idempotence claim ⇒ the mount policy applies
     unchanged (a genuine second entire-mount is a real conflict)."""

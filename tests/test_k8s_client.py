@@ -410,14 +410,15 @@ def test_kubeconfig_inline_key_tempfile_is_deleted(tmp_path, monkeypatch):
         serialization.Encoding.PEM,
         serialization.PrivateFormat.TraditionalOpenSSL,
         serialization.NoEncryption())
-    # cert chain load requires a matching cert; skip load by providing key
-    # data only (no client-certificate) — the temp file is still created
-    # and must still be cleaned up.
+    # Key data without a client-certificate is rejected fail-closed
+    # (client-go parity: unpaired cert/key errors) — the temp file is
+    # still created during construction and must still be cleaned up.
     path = _write_kubeconfig(
         tmp_path, "https://127.0.0.1:9",
         user={"token": "t",
               "client-key-data": base64.b64encode(key_pem).decode()})
-    KubeconfigKubeClient(path=path)
+    with pytest.raises(K8sApiError, match="client-key"):
+        KubeconfigKubeClient(path=path)
     assert glob.glob(str(tmp_path / "kubeconfig-client-key-*")) == []
 
 

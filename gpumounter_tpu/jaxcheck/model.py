@@ -151,10 +151,25 @@ def make_mesh(devices=None, data: int | None = None, seq: int | None = None,
 def make_attention(mesh: Mesh | None, cfg: ModelConfig,
                    impl: str = "ring") -> Callable:
     """Sequence-parallel attention over the mesh's seq axis — ``impl`` is
-    "ring" (ppermute K/V rotation) or "ulysses" (all-to-all head
-    redistribution); full attention when unsharded (single chip / seq axis
-    of 1)."""
+    "ring" (ppermute K/V rotation, einsum blocks), "ring_pallas" (same ring,
+    fused MXU block kernel), or "ulysses" (all-to-all head redistribution).
+    Unsharded (single chip / seq axis of 1): full attention, or "flash" for
+    the trainable pallas kernel (custom-VJP blockwise backward — the
+    long-context path: no [T, T] score tensor in either direction)."""
+    # pallas kernels compile only for real TPU backends; everywhere else
+    # (CPU test meshes, the driver's virtual-device dryrun) the same kernel
+    # runs via the pallas interpreter.
+    interpret = jax.default_backend() != "tpu"
+    if impl == "identity":
+        # Diagnostic only (perf.measure_roofline's ablation): attention
+        # contributes nothing, so step(full) - step(identity) is the
+        # in-step cost of the attention core.
+        return lambda q, k, v: v
     if mesh is None or mesh.shape["seq"] == 1:
+        if impl in ("flash", "ring_pallas"):
+            from gpumounter_tpu.jaxcheck.pallas_attention import \
+                make_flash_attention
+            return make_flash_attention(interpret=interpret)
         return full_attention
     spec = P("data", "seq", "model", None)
     if impl == "ulysses":
@@ -168,4 +183,8 @@ def make_attention(mesh: Mesh | None, cfg: ModelConfig,
         return make_ulysses_attention(mesh, "seq", spec=spec)
     if impl == "ring":
         return make_sharded_ring_attention(mesh, "seq", spec=spec)
+    if impl == "ring_pallas":
+        return make_sharded_ring_attention(mesh, "seq", spec=spec,
+                                           block_impl="pallas",
+                                           interpret=interpret)
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -122,9 +122,123 @@ def normalize_flash_stats(pv, l):
 
 def flash_attention(q, k, v, interpret: bool = False):
     """Complete causal flash attention via the block kernel (forward only;
-    the trainable path uses XLA's fused attention — see perf.py)."""
+    the trainable path is :func:`make_flash_attention`)."""
     pv, m, l = flash_block_bthd(q, k, v, 0, 0, interpret=interpret)
     return normalize_flash_stats(pv, l)
+
+
+# -- trainable flash attention (custom VJP) -----------------------------------
+#
+# The forward is the fused MXU kernel above; the backward is the standard
+# flash-attention recurrence computed BLOCKWISE over the key dimension in an
+# XLA scan, so the [T, T] score matrix never materialises in either
+# direction. This is what makes long-context *training* fit: at seq 8192 the
+# f32 score tensors XLA's fused attention wants (b·h·T² per layer, kept for
+# the backward) exceed a v5e's entire HBM, while the blockwise backward peaks
+# at b·h·T·block per temp.
+
+DEFAULT_BWD_BLOCK = 512
+
+
+def flash_bwd_block(q, k_blk, v_blk, do, drow, lse, q_offset, k_offset):
+    """One key block of the flash-attention backward, in GLOBAL
+    coordinates — the single home of the delicate recurrence, shared by
+    the single-device blockwise backward below and the ring backward
+    (ring_attention.make_ring_attention), which feed it local/rotating
+    blocks respectively.
+
+    q/do: [B, Tq, H, D] (model dtype); k_blk/v_blk: [B, Tk, H, D];
+    drow (rowsum(do*out), the softmax-jacobian diagonal) and lse
+    (m + log l): [B, H, Tq] f32. Returns (dq_partial, dk_blk, dv_blk) f32.
+
+    Math (s in global coordinates, scale = 1/sqrt(D)):
+        p  = exp(s - lse)            dv_j = pᵀ·do
+        dp = do·v_jᵀ                 ds   = p ⊙ (dp - drow)
+        dq += ds·k_j·scale           dk_j = dsᵀ·q·scale
+    """
+    f32 = jnp.float32
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                   preferred_element_type=f32) * scale
+    q_pos = q_offset + jnp.arange(q.shape[1])
+    k_pos = k_offset + jnp.arange(k_blk.shape[1])
+    mask = q_pos[:, None] >= k_pos[None, :]
+    s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jnp.exp(s - lse[..., None])                     # [B,H,Tq,Tk]
+    dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p.astype(v_blk.dtype), do,
+                        preferred_element_type=f32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", do, v_blk,
+                    preferred_element_type=f32)
+    ds = (p * (dp - drow[..., None])).astype(q.dtype)
+    dq_p = jnp.einsum("bhqk,bkhd->bqhd", ds, k_blk,
+                      preferred_element_type=f32) * scale
+    dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, q,
+                        preferred_element_type=f32) * scale
+    return dq_p, dk_blk, dv_blk
+
+
+def softmax_jacobian_diag(do, out):
+    """rowsum(do * out) in f32, [B, T, H, D] -> [B, H, T] — the ``drow``
+    term of :func:`flash_bwd_block`."""
+    f32 = jnp.float32
+    return jnp.sum(do.astype(f32) * out.astype(f32),
+                   axis=-1).transpose(0, 2, 1)
+
+
+def _flash_backward(q, k, v, out, lse, do, block: int):
+    """Blockwise flash-attention backward (causal, offsets 0): a scan of
+    :func:`flash_bwd_block` over key blocks. q/k/v/out/do: [B, T, H, D]
+    (model dtype); lse: [B, H, T] f32. Returns (dq, dk, dv) in the input
+    dtype with f32 accumulation. ``block`` must divide T."""
+    b, t, h, d = q.shape
+    assert t % block == 0, f"T={t} not a multiple of bwd block {block}"
+    nb = t // block
+    f32 = jnp.float32
+    drow = softmax_jacobian_diag(do, out)
+
+    k_blocks = k.reshape(b, nb, block, h, d).transpose(1, 0, 2, 3, 4)
+    v_blocks = v.reshape(b, nb, block, h, d).transpose(1, 0, 2, 3, 4)
+
+    def body(dq_acc, inp):
+        j, k_blk, v_blk = inp
+        dq_p, dk_blk, dv_blk = flash_bwd_block(
+            q, k_blk, v_blk, do, drow, lse, 0, j * block)
+        return dq_acc + dq_p, (dk_blk, dv_blk)
+
+    dq, (dk_st, dv_st) = jax.lax.scan(
+        body, jnp.zeros((b, t, h, d), f32),
+        (jnp.arange(nb), k_blocks, v_blocks))
+    dk = dk_st.transpose(1, 0, 2, 3, 4).reshape(b, t, h, d)
+    dv = dv_st.transpose(1, 0, 2, 3, 4).reshape(b, t, h, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def make_flash_attention(interpret: bool = False,
+                         bwd_block: int = DEFAULT_BWD_BLOCK):
+    """Trainable causal flash attention: pallas MXU forward + blockwise
+    backward under ``jax.custom_vjp``. Drop-in for
+    :func:`~gpumounter_tpu.jaxcheck.ring_attention.full_attention`
+    ([B, T, H, D] -> [B, T, H, D]); T must be a multiple of TILE_Q and of
+    ``bwd_block``. ``interpret=True`` runs the forward kernel on CPU."""
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        pv, _, l = flash_block_bthd(q, k, v, 0, 0, interpret=interpret)
+        return normalize_flash_stats(pv, l).astype(q.dtype)
+
+    def fwd(q, k, v):
+        pv, m, l = flash_block_bthd(q, k, v, 0, 0, interpret=interpret)
+        out = normalize_flash_stats(pv, l).astype(q.dtype)
+        lse = m + jnp.log(l)                                # [B, H, T] f32
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, do):
+        q, k, v, out, lse = res
+        return _flash_backward(q, k, v, out, lse, do,
+                               min(bwd_block, q.shape[1]))
+
+    attn.defvjp(fwd, bwd)
+    return attn
 
 
 def flash_block_bthd(q, k, v, q_offset, k_offset,

@@ -46,6 +46,24 @@ Attention-kernel findings (both measured on v5e, kept for honesty):
   tensors. At seq <= 2048 the two are within this host's measurement
   noise. :func:`measure_attention_kernels` reproduces this; the selftest
   asserts the seq>=4096 win on hardware.
+
+Round-5: the kernel became TRAINABLE (``make_flash_attention``: pallas
+forward + custom-VJP blockwise backward, no [T, T] tensor either
+direction), converting the microbenchmark into a capability
+(:func:`measure_long_context`, v5e, flagship dims, 8192 tokens/step):
+
+=====================================  ==========  =====
+config                                 step ms      MFU
+=====================================  ==========  =====
+seq 4096 b2, flash                        415      0.566
+seq 4096 b2, XLA full attention           645      0.364
+seq 8192 b1, flash                        575      0.466
+seq 8192 b1, XLA full attention           OOM        —
+=====================================  ==========  =====
+
+At seq 1024 (the primary config) flash moves the step <2% — the step is
+GEMM-floor-bound there (:func:`measure_roofline`); sequence length is
+where the kernel pays.
 """
 
 from __future__ import annotations
@@ -241,9 +259,258 @@ def measure_both(batch: int = 8, t_len: int = 1024) -> dict[str, Any]:
     return {**primary, "tuned": tuned}
 
 
+def measure_long_context() -> dict[str, Any]:
+    """Long-sequence TRAINING on the flagship model dims (d4096 L4 ff16384)
+    via the trainable pallas flash attention — the round-4 microbenchmark
+    win (pallas forward ~2x XLA at seq 4096, seq 8192 pallas-only) turned
+    into a training capability.
+
+    Token count per step is held at 8192 (= the flagship's batch 8 x seq
+    1024), so rows are directly comparable to the primary MFU entry: the
+    only variable is sequence length. The XLA-full-attention comparison at
+    seq 4096 is *attempted for real* when its score residuals are predicted
+    to fit 2x HBM (an OOM error then is a measured result); at seq 8192 the
+    prediction (n_layers * b*h*T^2 f32 saved for the backward) exceeds any
+    current chip's HBM several times over and the doomed compile is skipped
+    with the arithmetic recorded.
+    """
+    import jax
+    cfg = mxu_config()
+    rows: list[dict[str, Any]] = []
+    for t_len, batch in ((4096, 2), (8192, 1)):
+        row: dict[str, Any] = {"seq": t_len, "batch": batch,
+                               "tokens_per_step": batch * t_len}
+        try:
+            r = measure_train_perf(cfg, batch=batch, t_len=t_len,
+                                   attn_impl="flash", window_a=2,
+                                   window_b=6, warmup_steps=1)
+            row["flash"] = {k: r[k] for k in (
+                "train_step_ms", "model_tflops_per_step",
+                "achieved_tflops", "mfu", "final_loss", "ok")}
+        except Exception as e:
+            row["flash"] = {"ok": False, "error": repr(e)[:200]}
+        rows.append(row)
+
+    def hbm_bytes() -> int | None:
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return int(stats.get("bytes_limit") or 0) or None
+        except Exception:
+            return None
+
+    hbm = hbm_bytes()
+    xla_rows: list[dict[str, Any]] = []
+    for t_len, batch in ((4096, 2), (8192, 1)):
+        xla: dict[str, Any] = {"seq": t_len, "batch": batch}
+        # one f32 [b,h,T,T] probability matrix per layer is the floor of
+        # what autodiff through full attention keeps for the backward
+        score_resid = cfg.n_layers * batch * cfg.n_heads * t_len * t_len * 4
+        xla["predicted_score_residuals_gib"] = round(score_resid / 2**30, 1)
+        if hbm is not None and score_resid > 2 * hbm:
+            xla["result"] = (f"OOM(predicted: {score_resid / 2**30:.0f}GiB "
+                             f"score residuals vs {hbm / 2**30:.0f}GiB hbm)")
+        else:
+            try:
+                r = measure_train_perf(cfg, batch=batch, t_len=t_len,
+                                       attn_impl="ring",  # -> full attention
+                                       window_a=2, window_b=6,
+                                       warmup_steps=1)
+                xla["result"] = "ran"
+                xla["train_step_ms"] = r["train_step_ms"]
+                xla["mfu"] = r["mfu"]
+            except Exception as e:
+                msg = str(e).lower()
+                oom = ("memory" in msg or "hbm" in msg
+                       or "resource_exhausted" in msg
+                       or "resource exhausted" in msg)
+                xla["result"] = "OOM" if oom else f"err:{str(e)[:160]}"
+        xla_rows.append(xla)
+
+    ok = all(isinstance(r.get("flash"), dict) and r["flash"].get("ok")
+             for r in rows)
+    return {"config": {"d_model": cfg.d_model, "n_layers": cfg.n_layers,
+                       "n_heads": cfg.n_heads, "d_ff": cfg.d_ff,
+                       "dtype": "bfloat16"},
+            "rows": rows, "xla_full_attention": xla_rows, "ok": bool(ok)}
+
+
+def measure_roofline(batch: int = 8, t_len: int = 1024,
+                     chain: int = 10) -> dict[str, Any]:
+    """Where do the flagship step's milliseconds go? (round-4 VERDICT weak
+    #1: 0.63 MFU was neither justified nor improved.)
+
+    Decomposition, all measured on the chip with the chained-scan timing
+    (see :func:`measure_attention_kernels` for why per-call syncs can't
+    time sub-10ms ops on a tunnelled chip):
+
+    - **per-GEMM 3-matmul efficiency** — for each distinct projection/MLP/
+      LM-head GEMM shape in the model, time the (fwd, dx, dw) triple
+      standalone and derive achieved/peak. This is the practical ceiling
+      for the matmul seconds: a training step cannot beat its own GEMMs
+      run back-to-back with no model around them.
+    - **attention core** — fwd+bwd of full attention at the flagship shape,
+      measured standalone (its score matmuls have K = head_dim = 128 and
+      T-bounded N, structurally below peak).
+    - **optimizer** — the jitted adamw update+apply on a flagship-sized
+      pytree (pure HBM traffic, ~zero MXU work).
+    - **remainder** — measured step minus the above: embeds, norms, gelu,
+      residuals, CE, and whatever fusion overlap the composition hides.
+
+    The output's ``matmul_ceiling_mfu`` is the MFU the step would reach if
+    it consisted ONLY of its GEMMs at their measured standalone
+    efficiencies — the number to compare the measured MFU against.
+
+    Round-5 measurements on v5e (re-runnable via this function): measured
+    0.63-0.67 vs matmul-composite ceiling ~0.64 — the step achieves its
+    own GEMMs' composite efficiency, i.e. the remaining gap to the chip's
+    peak is per-GEMM shape efficiency (out_proj [8192x4096x4096] reaches
+    only ~0.37 standalone; mlp_in ~0.80 is the best), not framework
+    overhead. The in-step attention ablation (~70ms, ~23% of step at 4%
+    of counted FLOPs) confirmed attention is softmax/HBM-bound, but
+    swapping in the pallas flash kernel at seq 1024 moved the step <2%
+    (0.663 -> 0.669): its gain is bounded by the same GEMM floor. Hence
+    the primary MFU stands as within ~5% of this config's practical
+    ceiling; the lever that actually pays is longer sequence (see
+    measure_long_context).
+
+    Caveat on composition: the standalone pieces each carry chain-link
+    measurement overheads (per-link input perturbation + output sums), so
+    ``explained_ms`` can exceed the measured step by ~20-30% — the pieces
+    are upper bounds. ``matmul_ceiling_mfu`` inherits ~5% of the same
+    bias; treat measured ~ ceiling as "at the ceiling", not above it.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from gpumounter_tpu.jaxcheck import train as train_lib
+
+    cfg = mxu_config()
+    device = jax.devices()[0]
+    peak = chip_peak_tflops(device.device_kind)
+    m_tokens = batch * t_len
+    f32 = jnp.float32
+
+    # The real step first: its ~11 GB of state/activations must be freed
+    # before the standalone pieces allocate theirs (HBM fits one flagship
+    # working set, not two).
+    full = measure_train_perf(cfg, batch=batch, t_len=t_len)
+
+    def timed_chain(make_out, x0, *extra, chain_n=chain) -> float:
+        """Seconds per link of a chain of serially-dependent computations
+        (the carry perturbs the input, so XLA cannot CSE or overlap).
+        ``extra`` operands MUST be passed here, not closed over — a closure
+        over a concrete array becomes an embedded HLO constant, which blows
+        up the tunnelled chip's remote-compile request body."""
+        def fn(x, *rest):
+            def body(c, _):
+                out = make_out(x + (c * 1e-30).astype(x.dtype), *rest)
+                return jnp.sum(out.astype(f32)), None
+            s, _ = lax.scan(body, f32(0.0), None, length=chain_n)
+            return s
+        jfn = jax.jit(fn)
+        float(jfn(x0, *extra))
+        t0 = time.perf_counter()
+        float(jfn(x0, *extra))
+        return (time.perf_counter() - t0) / chain_n
+
+    # -- per-GEMM 3-matmul (fwd + dx + dw) microbench -------------------------
+    gemm_shapes = {
+        "qkv_proj": (m_tokens, cfg.d_model, 3 * cfg.d_model),
+        "out_proj": (m_tokens, cfg.d_model, cfg.d_model),
+        "mlp_in": (m_tokens, cfg.d_model, cfg.d_ff),
+        "mlp_out": (m_tokens, cfg.d_ff, cfg.d_model),
+        "lm_head": (m_tokens, cfg.d_model, cfg.vocab),
+    }
+    per_layer = {"qkv_proj", "out_proj", "mlp_in", "mlp_out"}
+    key = jax.random.PRNGKey(0)
+    gemms: dict[str, Any] = {}
+    for name, (mm, kk, nn) in gemm_shapes.items():
+        w = jax.random.normal(jax.random.fold_in(key, hash(name) % 97),
+                              (kk, nn), jnp.bfloat16)
+        dy = jax.random.normal(jax.random.fold_in(key, 7), (mm, nn),
+                               jnp.bfloat16)
+        x0 = jax.random.normal(jax.random.fold_in(key, 11), (mm, kk),
+                               jnp.bfloat16)
+
+        def triple(x, w, dy):
+            y = x @ w                                   # fwd
+            dx = dy @ w.T                               # grad wrt input
+            dw = x.T @ dy                               # grad wrt weight
+            return (jnp.sum(y.astype(f32)) + jnp.sum(dx.astype(f32))
+                    + jnp.sum(dw.astype(f32)))
+
+        s = timed_chain(triple, x0, w, dy)
+        flops = 6 * mm * kk * nn                        # 3 GEMMs x 2MNK
+        eff = flops / s / 1e12 / peak if peak else None
+        count = cfg.n_layers if name in per_layer else 1
+        gemms[name] = {"mnk": [mm, kk, nn], "ms": round(s * 1e3, 3),
+                       "eff": round(eff, 3) if eff else None,
+                       "count": count}
+
+    matmul_pred_ms = sum(g["ms"] * g["count"] for g in gemms.values())
+
+    # -- attention core, in-step ablation -------------------------------------
+    # step(full) - step(identity attention) = what the score/softmax/PV
+    # core costs IN CONTEXT. (A standalone fwd+bwd chain of the core
+    # over-measured ~4x — the chain's per-link sums and unfused f32
+    # softmax temps dwarf the fused in-step cost — so the ablation is the
+    # honest attribution.)
+    no_attn = measure_train_perf(cfg, batch=batch, t_len=t_len,
+                                 attn_impl="identity",
+                                 window_a=2, window_b=6, warmup_steps=1)
+    attn_per_step_ms = max(full["train_step_ms"] - no_attn["train_step_ms"],
+                           0.0)
+
+    # -- optimizer update, standalone -----------------------------------------
+    state = train_lib.init_state(jax.random.PRNGKey(1), cfg, mesh=None)
+    opt = train_lib.make_optimizer()
+    grads0 = jax.tree.map(lambda p: jnp.ones_like(p) * 1e-6, state.params)
+
+    def adam_apply(flat_probe, params, opt_state, grads0):
+        # perturb one leaf via the chain carry to serialise updates
+        import optax
+        grads = jax.tree.map(lambda g: g + flat_probe[0].astype(g.dtype),
+                             grads0)
+        updates, _ = opt.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return sum(jnp.sum(x.astype(f32)) for x in jax.tree.leaves(
+            new_params))
+
+    adam_s = timed_chain(adam_apply, jnp.zeros((1,), f32), state.params,
+                         state.opt_state, grads0,
+                         chain_n=max(chain // 2, 4))
+    adam_ms = adam_s * 1e3
+    del state, grads0
+
+    step_ms = full["train_step_ms"]
+    explained_ms = matmul_pred_ms + attn_per_step_ms + adam_ms
+    total_flops = analytic_train_flops(cfg, batch, t_len)
+    matmul_flops = 3 * sum(2 * g["mnk"][0] * g["mnk"][1] * g["mnk"][2]
+                           * g["count"] for g in gemms.values())
+    ceiling = (matmul_flops / (matmul_pred_ms / 1e3) / 1e12 / peak
+               if peak else None)
+    return {
+        "device_kind": device.device_kind,
+        "config": full["config"],
+        "measured_step_ms": step_ms,
+        "measured_mfu": full["mfu"],
+        "gemms": gemms,
+        "matmul_pred_ms": round(matmul_pred_ms, 1),
+        "matmul_ceiling_mfu": round(ceiling, 3) if ceiling else None,
+        "attention_core_ms": round(attn_per_step_ms, 1),
+        "optimizer_ms": round(adam_ms, 1),
+        "explained_ms": round(explained_ms, 1),
+        "remainder_ms": round(step_ms - explained_ms, 1),
+        "explained_fraction": round(explained_ms / step_ms, 3),
+        "analytic_model_tflops": round(total_flops / 1e12, 2),
+        "ok": bool(full["ok"]),
+    }
+
+
 def measure_train_perf(cfg=None, batch: int = 8, t_len: int = 1024,
                        window_a: int = 4, window_b: int = 12,
-                       warmup_steps: int = 2) -> dict[str, Any]:
+                       warmup_steps: int = 2,
+                       attn_impl: str = "ring") -> dict[str, Any]:
     """Time the single-chip train step on the MXU-sized config and report
     {train_step_ms, model_tflops_per_step, achieved_tflops, mfu, ...}.
 
@@ -266,7 +533,7 @@ def measure_train_perf(cfg=None, batch: int = 8, t_len: int = 1024,
     cfg = cfg or mxu_config()
     device = jax.devices()[0]
     state = train_lib.init_state(jax.random.PRNGKey(0), cfg, mesh=None)
-    step = train_lib.make_train_step(cfg, mesh=None)
+    step = train_lib.make_train_step(cfg, mesh=None, attn_impl=attn_impl)
     tokens = train_lib.make_batch(jax.random.PRNGKey(1), batch, t_len,
                                   cfg.vocab)
 
@@ -293,7 +560,8 @@ def measure_train_perf(cfg=None, batch: int = 8, t_len: int = 1024,
     report: dict[str, Any] = {
         "config": {"d_model": cfg.d_model, "n_layers": cfg.n_layers,
                    "n_heads": cfg.n_heads, "d_ff": cfg.d_ff,
-                   "dtype": "bfloat16", "batch": batch, "seq": t_len},
+                   "dtype": "bfloat16", "batch": batch, "seq": t_len,
+                   "attn_impl": attn_impl},
         "device_kind": device.device_kind,
         "timed_steps": window_a + window_b,
         "compile_and_warmup_s": round(compile_and_warmup_s, 3),

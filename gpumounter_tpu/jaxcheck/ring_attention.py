@@ -72,16 +72,10 @@ def _einsum_block(q, k_blk, v_blk, q_offset, k_offset):
     return pv, m_blk, l_blk
 
 
-def ring_attention(q, k, v, axis_name: str, block_impl: str = "einsum",
-                   interpret: bool = False):
-    """Causal multi-head attention with q/k/v sharded on sequence dim over
-    ``axis_name``. Shapes (per shard): [B, T_local, H, D] -> [B, T_local, H, D].
-
-    Must be called inside ``shard_map`` (or pmap) over ``axis_name``.
-    ``block_impl``: "einsum" (XLA-fused) or "pallas" (the fused MXU kernel in
-    :mod:`gpumounter_tpu.jaxcheck.pallas_attention`; requires T_local to be a
-    multiple of its TILE_Q; ``interpret=True`` runs it on CPU).
-    """
+def _ring_forward(q, k, v, axis_name: str, block_impl: str,
+                  interpret: bool):
+    """The ring forward loop; returns (out, lse) where lse = m + log(l) is
+    the merged logsumexp row statistic the flash backward needs."""
     n = lax.psum(1, axis_name)
     my_index = lax.axis_index(axis_name)
     batch, t_local, heads, dim = q.shape
@@ -116,8 +110,80 @@ def ring_attention(q, k, v, axis_name: str, block_impl: str = "einsum",
         return acc, m, l, k_next, v_next
 
     acc, m, l, _, _ = lax.fori_loop(0, n, body, (acc0, m0, l0, k, v))
-    out = acc / l.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    out = (acc / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    return out, m + jnp.log(l)
+
+
+def ring_attention(q, k, v, axis_name: str, block_impl: str = "einsum",
+                   interpret: bool = False):
+    """Causal multi-head attention with q/k/v sharded on sequence dim over
+    ``axis_name``. Shapes (per shard): [B, T_local, H, D] -> [B, T_local, H, D].
+
+    Must be called inside ``shard_map`` (or pmap) over ``axis_name``.
+    ``block_impl``: "einsum" (XLA-fused) or "pallas" (the fused MXU kernel in
+    :mod:`gpumounter_tpu.jaxcheck.pallas_attention`; requires T_local to be a
+    multiple of its TILE_Q; ``interpret=True`` runs it on CPU).
+    """
+    out, _ = _ring_forward(q, k, v, axis_name, block_impl, interpret)
+    return out
+
+
+def make_ring_attention(axis_name: str, block_impl: str = "einsum",
+                        interpret: bool = False):
+    """Trainable ring attention under ``jax.custom_vjp``: the forward is
+    :func:`ring_attention` (pallas or einsum blocks), the backward is a
+    SECOND ring pass — (k, v, dk, dv) rotate together over ``ppermute``
+    while each rank computes per-block gradients against the global
+    logsumexp rows it saved at forward time. Memory stays O(shard) in both
+    directions (plain autodiff through the forward loop would store every
+    rotation's block statistics), and the pallas forward becomes trainable
+    at all — a pallas_call has no autodiff rule.
+
+    Must be called inside shard_map over ``axis_name``, like
+    :func:`ring_attention`.
+    """
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        return ring_attention(q, k, v, axis_name, block_impl=block_impl,
+                              interpret=interpret)
+
+    def fwd(q, k, v):
+        out, lse = _ring_forward(q, k, v, axis_name, block_impl, interpret)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, do):
+        from gpumounter_tpu.jaxcheck.pallas_attention import (
+            flash_bwd_block, softmax_jacobian_diag)
+        q, k, v, out, lse = res
+        n = lax.psum(1, axis_name)
+        my_index = lax.axis_index(axis_name)
+        t_local = q.shape[1]
+        q_offset = my_index * t_local
+        f32 = jnp.float32
+        drow = softmax_jacobian_diag(do, out)            # [B, H, Tq]
+
+        def body(i, carry):
+            dq, k_blk, v_blk, dk, dv = carry
+            src = (my_index - i) % n
+            dq_p, dk_p, dv_p = flash_bwd_block(
+                q, k_blk, v_blk, do, drow, lse, q_offset, src * t_local)
+            dq = dq + dq_p
+            # dk/dv accumulators travel WITH their block: after the full
+            # cycle each rank holds its own block's completed gradient.
+            k_blk, v_blk, dk, dv = lax.ppermute(
+                (k_blk, v_blk, dk + dk_p, dv + dv_p), axis_name,
+                perm=[(j, (j + 1) % n) for j in range(n)])
+            return dq, k_blk, v_blk, dk, dv
+
+        dq0 = jnp.zeros(q.shape, f32)
+        dk0 = jnp.zeros(k.shape, f32)
+        dv0 = jnp.zeros(v.shape, f32)
+        dq, _, _, dk, dv = lax.fori_loop(0, n, body, (dq0, k, v, dk0, dv0))
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    attn.defvjp(fwd, bwd)
+    return attn
 
 
 def full_attention(q, k, v):
@@ -141,15 +207,21 @@ def make_sharded_ring_attention(mesh: Mesh, seq_axis: str = "seq",
     arrays sharded on T over ``seq_axis`` and runs the ring kernel. ``spec``
     may also shard batch/head dims (data/tensor parallelism compose with the
     ring — those axes are embarrassingly parallel inside the kernel).
-    ``block_impl="pallas"`` uses the fused MXU block kernel."""
+    ``block_impl="pallas"`` uses the fused MXU block kernel.
+
+    Trainable for BOTH block impls: the custom-VJP ring backward
+    (:func:`make_ring_attention`) re-rotates K/V instead of storing each
+    rotation's block statistics, so gradient memory is O(shard) and the
+    pallas forward (no autodiff rule of its own) differentiates."""
     spec = spec if spec is not None else P(None, seq_axis, None, None)
+    ring = make_ring_attention(seq_axis, block_impl=block_impl,
+                               interpret=interpret)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     def sharded(q, k, v):
-        return ring_attention(q, k, v, seq_axis, block_impl=block_impl,
-                              interpret=interpret)
+        return ring(q, k, v)
 
     return sharded
 

@@ -47,7 +47,7 @@ EXIT_FAIL = 1
 EXIT_NO_TPU = 3
 
 
-def run_in_subprocess(timeout: float = 560.0):
+def run_in_subprocess(timeout: float = 1100.0):
     """Run this selftest in a subprocess with the host's real JAX
     environment restored (undoing any test-session CPU pin recorded in
     ``GPUMOUNTER_ORIG_*`` by tests/conftest.py) and the repo on PYTHONPATH
@@ -186,6 +186,24 @@ def check_attention_kernels() -> dict[str, Any]:
     return perf.measure_attention_kernels()
 
 
+def check_long_context() -> dict[str, Any]:
+    """Long-sequence TRAINING through the trainable pallas flash attention
+    (custom-VJP blockwise backward): flagship model dims at seq 4096 and
+    8192, where autodiff through XLA full attention must keep per-layer
+    [b, h, T, T] f32 score residuals that exceed this chip class's HBM —
+    the round-4 microbenchmark win converted into a capability claim."""
+    from gpumounter_tpu.jaxcheck import perf
+    return perf.measure_long_context()
+
+
+def check_roofline() -> dict[str, Any]:
+    """Flagship-step time decomposition: per-GEMM standalone efficiencies,
+    attention core, optimizer, remainder — the written justification (or
+    refutation) of the primary MFU figure."""
+    from gpumounter_tpu.jaxcheck import perf
+    return perf.measure_roofline()
+
+
 def check_drain_cycle() -> dict[str, Any]:
     """BASELINE config 4 on hardware: drain → backend re-init (the
     detach/reattach window) → restore → training continues with the SAME
@@ -273,6 +291,8 @@ def run_selftest(n_steps: int = 8) -> dict[str, Any]:
             ("perf", check_perf),
             ("pallas_parity", check_pallas_parity),
             ("attention_kernels", check_attention_kernels),
+            ("long_context", check_long_context),
+            ("roofline", check_roofline),
             ("drain_cycle", check_drain_cycle),
             ("backend_reinit", check_backend_reinit),
     ):
@@ -282,8 +302,8 @@ def run_selftest(n_steps: int = 8) -> dict[str, Any]:
             report[name] = {"ok": False, "error": repr(e)}
     report["ok"] = all(report[k]["ok"] for k in
                        ("collectives", "training", "perf", "pallas_parity",
-                        "attention_kernels", "drain_cycle",
-                        "backend_reinit"))
+                        "attention_kernels", "long_context", "roofline",
+                        "drain_cycle", "backend_reinit"))
     return report
 
 

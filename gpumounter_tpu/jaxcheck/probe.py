@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import Any
@@ -43,7 +44,96 @@ def device_summary() -> dict[str, Any]:
         "local_device_count": jax.local_device_count(),
         "devices": [str(d) for d in devices],
         "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
     }
+
+
+def visible_chip_indices(dev_root: str = "/dev") -> list[int] | None:
+    """Indices of ``accel*`` device nodes present in this container, or
+    None when there are none (CPU-only hosts, fixture-less tests).
+
+    After a PARTIAL-host mount (1 of 4 chips), only the mounted chips'
+    nodes exist here — the mounter creates nodes per attached chip
+    (actuation/mount.py), so presence == accessibility."""
+    import glob
+    import re
+    found = sorted(
+        int(m.group(1))
+        for p in glob.glob(os.path.join(dev_root, "accel*"))
+        if (m := re.fullmatch(r"accel(\d+)", os.path.basename(p))))
+    return found or None
+
+
+def configure_visible_chips(dev_root: str = "/dev",
+                            env: Any = None) -> str | None:
+    """The partial-host visibility contract (SURVEY.md §7 acceptance:
+    ``TPU_VISIBLE_CHIPS`` / libtpu re-enumeration).
+
+    libtpu enumerates every ``/dev/accel*`` it expects on the host class at
+    backend init; in a pod holding a SINGLE-mount (1 of 4 chips) the three
+    sibling nodes are absent, and initialisation can fail or wedge probing
+    them. Setting ``TPU_VISIBLE_CHIPS`` to exactly the chips whose nodes
+    exist keeps libtpu inside the pod's grant. An operator-set value is
+    respected; with no accel nodes at all nothing is set (whole-host
+    attach needs no pin — all nodes exist). Returns the effective value.
+    """
+    if env is None:
+        env = os.environ
+    if env.get("TPU_VISIBLE_CHIPS"):
+        return env["TPU_VISIBLE_CHIPS"]
+    indices = visible_chip_indices(dev_root)
+    if indices is None:
+        return None
+    value = ",".join(str(i) for i in indices)
+    env["TPU_VISIBLE_CHIPS"] = value
+    logger.info("TPU_VISIBLE_CHIPS=%s (from present device nodes)", value)
+    return value
+
+
+def initialize_distributed(coordinator_address: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None,
+                           cpu_devices_per_process: int | None = None
+                           ) -> None:
+    """Multi-host bring-up: connect this process to the slice-wide JAX
+    world (BASELINE config 5 — a v5p-16 slice spans hosts, and post-attach
+    validation there REQUIRES the multi-process path: each pod sees only
+    its host's 4 chips until ``jax.distributed.initialize`` federates
+    them).
+
+    Must run before the first backend use. On GKE TPU slices all three
+    arguments can be None — libtpu + the TPU environment auto-detect the
+    coordinator (process 0's pod), count, and ids from the slice topology;
+    pass them explicitly when running outside that wiring (the two-pod
+    recipe in docs/guide/QuickStart.md).
+
+    ``cpu_devices_per_process`` is the hardware-free test mode: pins the
+    CPU backend (overriding any sitecustomize platform pin), selects the
+    gloo cross-process collectives implementation, and gives each process
+    that many virtual devices — 2 processes x 4 devices federate to an
+    8-device world on one machine.
+    """
+    if cpu_devices_per_process:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        jax.config.update("jax_num_cpu_devices", cpu_devices_per_process)
+    kwargs: dict[str, Any] = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def put_global(host_array: np.ndarray, sharding) -> jax.Array:
+    """Host data -> a (possibly multi-process) globally sharded array.
+    Every process must hold the same ``host_array`` and provides the
+    shards it is responsible for; single-process this degenerates to a
+    plain transfer."""
+    return jax.make_array_from_callback(
+        np.shape(host_array), sharding, lambda idx: host_array[idx])
 
 
 def reinitialize_backend() -> None:
@@ -57,15 +147,31 @@ def reinitialize_backend() -> None:
 
 
 def wait_for_devices(expected: int, timeout_s: float = 60.0,
-                     poll_s: float = 2.0) -> dict[str, Any]:
+                     poll_s: float = 2.0,
+                     dev_root: str = "/dev",
+                     auto_visible: bool | None = None) -> dict[str, Any]:
     """Poll until ``jax.device_count() >= expected``, re-initialising the
     backend between polls so hot-attached chips appear. Returns the final
-    device summary; raises TimeoutError at the deadline."""
+    device summary; raises TimeoutError at the deadline.
+
+    Between polls the partial-host visibility pin is re-derived from the
+    present device nodes (unless operator-set): chips attached since the
+    last poll must widen ``TPU_VISIBLE_CHIPS`` before the backend re-init
+    that is supposed to see them. ``auto_visible=None`` infers "not
+    operator-set" from the env — callers that already auto-pinned (run_probe
+    calls configure_visible_chips first) must pass the explicit flag, or
+    their own pin would be mistaken for an operator's."""
     deadline = time.monotonic() + timeout_s
+    if auto_visible is None:
+        auto_visible = not os.environ.get("TPU_VISIBLE_CHIPS")
     first = True
     while True:
         if not first:
+            if auto_visible:
+                os.environ.pop("TPU_VISIBLE_CHIPS", None)
             reinitialize_backend()
+        if auto_visible:
+            configure_visible_chips(dev_root)
         first = False
         summary = device_summary()
         if summary["device_count"] >= expected:
@@ -89,14 +195,20 @@ def validate_collectives(n_devices: int | None = None) -> dict[str, Any]:
     devices = jax.devices()
     n = n_devices or len(devices)
     mesh = Mesh(np.array(devices[:n]), ("x",))
-    data = jnp.arange(n, dtype=jnp.int32)
-    sharded = jax.device_put(data, NamedSharding(mesh, P("x")))
+    # make_array_from_callback instead of device_put: in a multi-process
+    # world most of the mesh is non-addressable from this process; each
+    # process contributes only the shards it owns (single-process this is
+    # a plain transfer). Results are read the same way — addressable
+    # shards only.
+    sharded = put_global(np.arange(n, dtype=np.int32),
+                         NamedSharding(mesh, P("x")))
 
     @jax.jit
     def allreduce(v):
         return jnp.sum(v) * jnp.ones_like(v)
 
-    total = int(allreduce(sharded)[0])
+    reduced = allreduce(sharded)
+    total = int(np.asarray(reduced.addressable_shards[0].data).ravel()[0])
     expected_total = n * (n - 1) // 2
 
     @jax.shard_map(mesh=mesh, in_specs=P("x"), out_specs=P("x"),
@@ -105,12 +217,15 @@ def validate_collectives(n_devices: int | None = None) -> dict[str, Any]:
         return jax.lax.ppermute(v, "x",
                                 perm=[(i, (i + 1) % n) for i in range(n)])
 
-    rotated = np.asarray(rotate(sharded))
+    rotated = rotate(sharded)
     expected_rot = np.roll(np.arange(n), 1)
     allreduce_ok = bool(total == expected_total)
-    ppermute_ok = bool((rotated == expected_rot).all())
+    ppermute_ok = all(
+        bool((np.asarray(s.data) == expected_rot[s.index]).all())
+        for s in rotated.addressable_shards)
     return {"n_devices": n, "allreduce_ok": allreduce_ok,
             "ppermute_ok": ppermute_ok,
+            "process_count": jax.process_count(),
             # a 1-device mesh exercises no ICI: "ok" then means "the
             # degenerate case compiles+runs", NOT that collectives moved
             # bytes between chips — callers must not report it as a mesh
@@ -139,6 +254,12 @@ def validate_training(n_steps: int = 4,
     # shards T); 3 chips -> T=48, 8 -> T=64, single device -> 64
     t_len = 16 * mesh.shape["seq"] if mesh else 64
     tokens = train_lib.make_batch(jax.random.PRNGKey(1), 8, t_len, cfg.vocab)
+    if mesh is not None and jax.process_count() > 1:
+        # every process computed identical tokens (same key); re-home them
+        # as one global array sharded over the multi-host mesh
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        tokens = put_global(np.asarray(tokens),
+                            NamedSharding(mesh, P("data", "seq")))
     t0 = time.monotonic()
     first_loss = final_loss = float("nan")
     for i in range(n_steps):
@@ -167,10 +288,20 @@ def validate_training(n_steps: int = 4,
 
 
 def run_probe(expected: int | None = None,
-              timeout_s: float = 60.0) -> dict[str, Any]:
+              timeout_s: float = 60.0,
+              dev_root: str = "/dev") -> dict[str, Any]:
     report: dict[str, Any] = {"ok": False}
+    # Partial-host contract: pin libtpu to the chips this pod actually
+    # holds BEFORE the first backend init (no-op for whole-host attaches
+    # and operator-pinned environments).
+    operator_pinned = bool(os.environ.get("TPU_VISIBLE_CHIPS"))
+    visible = configure_visible_chips(dev_root)
+    if visible is not None:
+        report["tpu_visible_chips"] = visible
     if expected:
-        report["devices"] = wait_for_devices(expected, timeout_s)
+        report["devices"] = wait_for_devices(
+            expected, timeout_s, dev_root=dev_root,
+            auto_visible=not operator_pinned)
     else:
         report["devices"] = device_summary()
     # A compile/execution failure on a broken chip or ICI link is exactly
@@ -191,11 +322,30 @@ def run_probe(expected: int | None = None,
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--expect", type=int, default=None,
-                        help="wait until this many devices are visible")
+                        help="wait until this many devices are visible "
+                             "(multi-host: the SLICE-wide count)")
     parser.add_argument("--timeout", type=float, default=60.0)
+    parser.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                        help="jax.distributed coordinator (process 0's "
+                             "address); enables multi-host mode")
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("--distributed", action="store_true",
+                        help="multi-host mode with auto-detection (GKE TPU "
+                             "slices wire coordinator/count/id themselves)")
+    parser.add_argument("--cpu-devices", type=int, default=None,
+                        help="hardware-free test mode: N virtual CPU "
+                             "devices per process, gloo collectives")
+    parser.add_argument("--dev-root", default="/dev",
+                        help="where accel* device nodes live (fixture "
+                             "trees in tests)")
     args = parser.parse_args(argv)
+    if (args.coordinator is not None or args.distributed
+            or args.process_id is not None):
+        initialize_distributed(args.coordinator, args.num_processes,
+                               args.process_id, args.cpu_devices)
     try:
-        report = run_probe(args.expect, args.timeout)
+        report = run_probe(args.expect, args.timeout, dev_root=args.dev_root)
     except TimeoutError as e:
         print(json.dumps({"ok": False, "error": str(e)}))
         return 2

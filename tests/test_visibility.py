@@ -1,0 +1,99 @@
+"""Partial-host visibility contract (SURVEY.md §7 acceptance:
+TPU_VISIBLE_CHIPS / libtpu re-enumeration).
+
+After a SINGLE-mount of 1 of a 4-chip host's chips, the pod's /dev holds
+only the mounted chip's node (the mounter creates nodes per attached chip).
+libtpu would probe the absent siblings at init; the probe pins
+TPU_VISIBLE_CHIPS to exactly the present nodes first. Whole-host attaches
+need no pin, operator-set values win, and the pin is re-derived between
+wait_for_devices polls so widening attaches widen the pin.
+"""
+
+import os
+
+from gpumounter_tpu.jaxcheck.probe import (configure_visible_chips,
+                                           visible_chip_indices)
+
+
+def test_indices_from_present_nodes(tmp_path):
+    (tmp_path / "accel2").touch()
+    (tmp_path / "accel0").touch()
+    (tmp_path / "vfio").mkdir()          # companions don't count as chips
+    (tmp_path / "accelerator-weird").touch()
+    assert visible_chip_indices(str(tmp_path)) == [0, 2]
+
+
+def test_no_nodes_means_none(tmp_path):
+    assert visible_chip_indices(str(tmp_path)) is None
+
+
+def test_configure_sets_env_from_nodes(tmp_path):
+    (tmp_path / "accel1").touch()
+    env = {}
+    assert configure_visible_chips(str(tmp_path), env) == "1"
+    assert env["TPU_VISIBLE_CHIPS"] == "1"
+
+
+def test_configure_respects_operator_pin(tmp_path):
+    (tmp_path / "accel1").touch()
+    env = {"TPU_VISIBLE_CHIPS": "0,1,2,3"}
+    assert configure_visible_chips(str(tmp_path), env) == "0,1,2,3"
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+
+
+def test_configure_noop_without_nodes(tmp_path):
+    env = {}
+    assert configure_visible_chips(str(tmp_path), env) is None
+    assert "TPU_VISIBLE_CHIPS" not in env
+
+
+def test_whole_host_pin_lists_all_chips(tmp_path):
+    for i in range(4):
+        (tmp_path / f"accel{i}").touch()
+    env = {}
+    assert configure_visible_chips(str(tmp_path), env) == "0,1,2,3"
+
+
+def test_wait_for_devices_widens_pin_between_polls(tmp_path, monkeypatch):
+    """FAQ promise: a widening attach widens the pin. The probe auto-pins
+    before the first backend init; between polls it must re-derive from
+    the (now larger) device-node set — even though its OWN earlier pin is
+    sitting in the environment (the round-5 review bug: the auto pin was
+    mistaken for an operator pin and frozen)."""
+    from gpumounter_tpu.jaxcheck import probe
+
+    monkeypatch.delenv("TPU_VISIBLE_CHIPS", raising=False)
+    (tmp_path / "accel0").touch()
+
+    counts = iter([1, 1, 8])        # below `expected` until the 3rd poll
+    monkeypatch.setattr(probe, "device_summary",
+                        lambda: {"device_count": next(counts)})
+    reinits = []
+
+    def fake_reinit():
+        # the hot-attach lands while the probe is polling
+        (tmp_path / "accel1").touch()
+        reinits.append(os.environ.get("TPU_VISIBLE_CHIPS"))
+
+    monkeypatch.setattr(probe, "reinitialize_backend", fake_reinit)
+    probe.configure_visible_chips(str(tmp_path))     # run_probe's first pin
+    assert os.environ["TPU_VISIBLE_CHIPS"] == "0"
+    probe.wait_for_devices(8, timeout_s=10, poll_s=0.01,
+                           dev_root=str(tmp_path), auto_visible=True)
+    # the pin was DROPPED before each backend re-init and re-derived after
+    assert reinits == [None, None]
+    assert os.environ["TPU_VISIBLE_CHIPS"] == "0,1"
+    monkeypatch.delenv("TPU_VISIBLE_CHIPS", raising=False)
+
+
+def test_probe_reports_visible_chips(tmp_path, monkeypatch):
+    """run_probe surfaces the pin it applied (single-mount scenario: the
+    probe report is the operator's evidence of what libtpu was allowed to
+    see)."""
+    from gpumounter_tpu.jaxcheck.probe import run_probe
+    (tmp_path / "accel3").touch()
+    monkeypatch.delenv("TPU_VISIBLE_CHIPS", raising=False)
+    report = run_probe(dev_root=str(tmp_path))
+    assert report["tpu_visible_chips"] == "3"
+    assert os.environ.get("TPU_VISIBLE_CHIPS") == "3"
+    monkeypatch.delenv("TPU_VISIBLE_CHIPS", raising=False)

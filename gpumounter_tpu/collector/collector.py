@@ -68,6 +68,11 @@ class TPUCollector:
         collector.go:90-138): re-enumerate chips, reset all to FREE, then mark
         chips listed by the kubelet as ALLOCATED with their pod binding."""
         listing = self.podresources.list_pods()
+        # v1 kubelets report what they will actually schedule; an enumerated
+        # chip the kubelet excludes (unhealthy / not plugin-registered) must
+        # not be advertised as free. None = v1alpha1, enumerator is the view.
+        allocatable = self.podresources.allocatable_tpu_ids(
+            self.resource_name)
         with self._lock:
             # freshly enumerated chips start FREE; allocation state is fully
             # re-derived from the kubelet listing every refresh
@@ -97,10 +102,13 @@ class TPUCollector:
                             chip.state = DeviceState.ALLOCATED
                             chip.pod_name = pod.name
                             chip.namespace = pod.namespace
+            allocated = sum(1 for c in self._chips.values()
+                            if c.state is DeviceState.ALLOCATED)
             free = sum(1 for c in self._chips.values()
-                       if c.state is DeviceState.FREE)
+                       if c.state is DeviceState.FREE
+                       and (allocatable is None or c.uuid in allocatable))
             REGISTRY.chips.set(free, state="free")
-            REGISTRY.chips.set(len(self._chips) - free, state="allocated")
+            REGISTRY.chips.set(allocated, state="allocated")
 
     # -- aggregation -----------------------------------------------------------
 

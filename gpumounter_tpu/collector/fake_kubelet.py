@@ -4,6 +4,11 @@ Test double for the kubelet itself (SURVEY.md §4: "e2e harness ... fake
 kubelet socket server"): lets the production
 :class:`~gpumounter_tpu.collector.podresources.KubeletPodResourcesClient` be
 exercised over an actual socket, wire format and all.
+
+Serves BOTH API generations a real kubelet lineage spans: ``v1`` (List +
+GetAllocatableResources, modern kubelets) and ``v1alpha1`` (List only, the
+API the reference consumed). ``serve_v1=False`` models an old kubelet so
+tests can pin the client's fallback path.
 """
 
 from __future__ import annotations
@@ -14,21 +19,76 @@ import os
 import grpc
 
 from gpumounter_tpu.api import podresources_pb2 as pb
+from gpumounter_tpu.api import podresources_v1_pb2 as pb_v1
 from gpumounter_tpu.collector.podresources import FakePodResourcesClient
-
-_LIST_METHOD = "List"
-_SERVICE = "v1alpha1.PodResourcesLister"
 
 
 class FakeKubeletServer:
-    """Serves List on ``unix://<socket_path>`` from a FakePodResourcesClient's
-    assignment table (mutable while running)."""
+    """Serves the PodResourcesLister services on ``unix://<socket_path>``
+    from a FakePodResourcesClient's assignment table (mutable while
+    running)."""
 
     def __init__(self, socket_path: str,
-                 state: FakePodResourcesClient | None = None):
+                 state: FakePodResourcesClient | None = None,
+                 serve_v1: bool = True):
         self.socket_path = socket_path
         self.state = state or FakePodResourcesClient()
+        self.serve_v1 = serve_v1
         self._server: grpc.Server | None = None
+
+    def _v1alpha1_handler(self) -> grpc.GenericRpcHandler:
+        def list_handler(request, context):
+            return self.state.list_pods()
+
+        return grpc.method_handlers_generic_handler(
+            "v1alpha1.PodResourcesLister", {
+                "List": grpc.unary_unary_rpc_method_handler(
+                    list_handler,
+                    request_deserializer=(
+                        pb.ListPodResourcesRequest.FromString),
+                    response_serializer=(
+                        pb.ListPodResourcesResponse.SerializeToString),
+                ),
+            })
+
+    def _v1_handler(self) -> grpc.GenericRpcHandler:
+        def list_handler(request, context):
+            # same assignment table; re-serialised under the v1 package
+            alpha = self.state.list_pods()
+            resp = pb_v1.ListPodResourcesResponse()
+            resp.ParseFromString(alpha.SerializeToString())
+            return resp
+
+        def allocatable_handler(request, context):
+            # None = this fake has no allocatable opinion; a real v1 kubelet
+            # always answers, so tests opting in set state.allocatable.
+            if self.state.allocatable is None:
+                context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                              "fake kubelet: no allocatable table set")
+            resp = pb_v1.AllocatableResourcesResponse()
+            for resource, ids in self.state.allocatable.items():
+                resp.devices.add(resource_name=resource, device_ids=ids)
+            return resp
+
+        return grpc.method_handlers_generic_handler(
+            "v1.PodResourcesLister", {
+                "List": grpc.unary_unary_rpc_method_handler(
+                    list_handler,
+                    request_deserializer=(
+                        pb_v1.ListPodResourcesRequest.FromString),
+                    response_serializer=(
+                        pb_v1.ListPodResourcesResponse.SerializeToString),
+                ),
+                "GetAllocatableResources":
+                    grpc.unary_unary_rpc_method_handler(
+                        allocatable_handler,
+                        request_deserializer=(
+                            pb_v1.AllocatableResourcesRequest.FromString),
+                        response_serializer=(
+                            pb_v1.AllocatableResourcesResponse
+                            .SerializeToString),
+                    ),
+            })
 
     def start(self) -> "FakeKubeletServer":
         os.makedirs(os.path.dirname(self.socket_path), exist_ok=True)
@@ -36,21 +96,10 @@ class FakeKubeletServer:
             os.unlink(self.socket_path)
         self._server = grpc.server(
             concurrent.futures.ThreadPoolExecutor(max_workers=2))
-
-        def list_handler(request: pb.ListPodResourcesRequest,
-                         context: grpc.ServicerContext
-                         ) -> pb.ListPodResourcesResponse:
-            return self.state.list_pods()
-
-        handler = grpc.method_handlers_generic_handler(_SERVICE, {
-            _LIST_METHOD: grpc.unary_unary_rpc_method_handler(
-                list_handler,
-                request_deserializer=pb.ListPodResourcesRequest.FromString,
-                response_serializer=(
-                    pb.ListPodResourcesResponse.SerializeToString),
-            ),
-        })
-        self._server.add_generic_rpc_handlers((handler,))
+        handlers = [self._v1alpha1_handler()]
+        if self.serve_v1:
+            handlers.append(self._v1_handler())
+        self._server.add_generic_rpc_handlers(tuple(handlers))
         self._server.add_insecure_port(f"unix://{self.socket_path}")
         self._server.start()
         return self

@@ -1,28 +1,40 @@
 """Kubelet PodResources client over the node-local unix socket.
 
 Ref ``pkg/util/gpu/collector/collector.go:90-111,165-194``: stat the socket,
-dial it with a unix dialer and 10s timeout, call
-``v1alpha1.PodResourcesLister/List``. Identical contract here, via grpcio's
-``unix://`` channel target. This API is unchanged on GKE and reports
-``google.com/tpu`` device IDs for TPU pods (SURVEY.md §5 "Distributed
-communication backend").
+dial it with a unix dialer and 10s timeout, call the PodResourcesLister
+``List`` RPC. Identical contract here, via grpcio's ``unix://`` channel
+target. This API is unchanged on GKE and reports ``google.com/tpu`` device
+IDs for TPU pods (SURVEY.md §5 "Distributed communication backend").
+
+API version: modern kubelets serve ``v1`` (with GetAllocatableResources);
+the 2020-era reference consumed ``v1alpha1`` via client-go, and alpha APIs
+can be disabled outright. The client tries v1 first and permanently falls
+back to v1alpha1 on UNIMPLEMENTED/UNKNOWN_SERVICE, so it works against
+either kubelet generation.
 """
 
 from __future__ import annotations
 
 import abc
 import os
+import time
 
 import grpc
 
 from gpumounter_tpu.api import podresources_pb2 as pb
+from gpumounter_tpu.api import podresources_v1_pb2 as pb_v1
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.errors import KubeletUnavailableError
 from gpumounter_tpu.utils.log import get_logger
 
 logger = get_logger("collector.podresources")
 
-_LIST_METHOD = "/v1alpha1.PodResourcesLister/List"
+_LIST_METHOD_V1ALPHA1 = "/v1alpha1.PodResourcesLister/List"
+_LIST_METHOD_V1 = "/v1.PodResourcesLister/List"
+_ALLOCATABLE_METHOD_V1 = "/v1.PodResourcesLister/GetAllocatableResources"
+
+# grpc codes a kubelet answers with when a service/method doesn't exist
+_FALLBACK_CODES = (grpc.StatusCode.UNIMPLEMENTED, grpc.StatusCode.UNKNOWN)
 
 
 class PodResourcesClient(abc.ABC):
@@ -33,31 +45,108 @@ class PodResourcesClient(abc.ABC):
     def list_pods(self) -> pb.ListPodResourcesResponse:
         ...
 
+    def allocatable_tpu_ids(self, resource_name: str) -> set[str] | None:
+        """Device ids the kubelet will actually schedule for
+        ``resource_name`` (v1 GetAllocatableResources), or None when the
+        serving API has no such RPC (v1alpha1) — callers then fall back to
+        the enumerator's view."""
+        return None
+
 
 class KubeletPodResourcesClient(PodResourcesClient):
+    # The allocatable set only changes on device-plugin health transitions;
+    # re-fetching it on every collector refresh (which runs per RPC) would
+    # double the unix-socket round-trips for no information.
+    ALLOCATABLE_TTL_S = 10.0
+
     def __init__(self, socket_path: str = consts.KUBELET_SOCKET_PATH,
                  timeout_s: float = consts.PODRESOURCES_CONNECT_TIMEOUT_S):
         self.socket_path = socket_path
         self.timeout_s = timeout_s
+        self.api_version: str | None = None     # probed on first List
+        self._alloc_cache: dict[str, tuple[float, set[str] | None]] = {}
 
-    def list_pods(self) -> pb.ListPodResourcesResponse:
+    def _call(self, channel: grpc.Channel, method: str, request,
+              response_type):
+        call = channel.unary_unary(
+            method,
+            request_serializer=request.SerializeToString,
+            response_deserializer=response_type.FromString,
+        )
+        return call(request, timeout=self.timeout_s)
+
+    def _channel(self) -> grpc.Channel:
         # ref collector.go:92: stat before dialing for a crisp error
         if not os.path.exists(self.socket_path):
             raise KubeletUnavailableError(
                 f"kubelet PodResources socket missing: {self.socket_path}")
-        channel = grpc.insecure_channel(f"unix://{self.socket_path}")
+        return grpc.insecure_channel(f"unix://{self.socket_path}")
+
+    def list_pods(self) -> pb.ListPodResourcesResponse:
+        channel = self._channel()
         try:
-            call = channel.unary_unary(
-                _LIST_METHOD,
-                request_serializer=pb.ListPodResourcesRequest.SerializeToString,
-                response_deserializer=pb.ListPodResourcesResponse.FromString,
-            )
-            return call(pb.ListPodResourcesRequest(), timeout=self.timeout_s)
-        except grpc.RpcError as e:
-            raise KubeletUnavailableError(
-                f"PodResources List failed: {e.code()}: {e.details()}") from e
+            if self.api_version in (None, "v1"):
+                try:
+                    resp = self._call(channel, _LIST_METHOD_V1,
+                                      pb_v1.ListPodResourcesRequest(),
+                                      pb_v1.ListPodResourcesResponse)
+                    if self.api_version is None:
+                        logger.info("kubelet PodResources API: v1")
+                        self.api_version = "v1"
+                    return resp
+                except grpc.RpcError as e:
+                    if (self.api_version is None
+                            and e.code() in _FALLBACK_CODES):
+                        logger.info(
+                            "kubelet has no v1 PodResources (%s); falling "
+                            "back to v1alpha1", e.code())
+                        self.api_version = "v1alpha1"
+                    else:
+                        raise KubeletUnavailableError(
+                            f"PodResources List failed: {e.code()}: "
+                            f"{e.details()}") from e
+            try:
+                return self._call(channel, _LIST_METHOD_V1ALPHA1,
+                                  pb.ListPodResourcesRequest(),
+                                  pb.ListPodResourcesResponse)
+            except grpc.RpcError as e:
+                raise KubeletUnavailableError(
+                    f"PodResources List failed: {e.code()}: "
+                    f"{e.details()}") from e
         finally:
             channel.close()
+
+    def allocatable_tpu_ids(self, resource_name: str) -> set[str] | None:
+        if self.api_version is None:
+            self.list_pods()                    # probe the API version
+        if self.api_version != "v1":
+            return None
+        cached = self._alloc_cache.get(resource_name)
+        now = time.monotonic()
+        if cached is not None and now < cached[0]:
+            return cached[1]
+        channel = self._channel()
+        try:
+            resp = self._call(channel, _ALLOCATABLE_METHOD_V1,
+                              pb_v1.AllocatableResourcesRequest(),
+                              pb_v1.AllocatableResourcesResponse)
+        except grpc.RpcError as e:
+            if e.code() in _FALLBACK_CODES:
+                # fake/partial v1 server; cache too — absent stays absent
+                self._alloc_cache[resource_name] = (
+                    now + self.ALLOCATABLE_TTL_S, None)
+                return None
+            raise KubeletUnavailableError(
+                f"GetAllocatableResources failed: {e.code()}: "
+                f"{e.details()}") from e
+        finally:
+            channel.close()
+        ids = {device_id
+               for dev in resp.devices if dev.resource_name == resource_name
+               for device_id in dev.device_ids}
+        self._alloc_cache[resource_name] = (
+            now + self.ALLOCATABLE_TTL_S, ids)
+        return ids
 
 
 class FakePodResourcesClient(PodResourcesClient):
@@ -67,6 +156,9 @@ class FakePodResourcesClient(PodResourcesClient):
     def __init__(self, assignments: dict | None = None):
         self.assignments = assignments or {}
         self.list_calls = 0        # tests assert O(1) LISTs per RPC
+        # {resource: [ids]} — what a v1 kubelet's GetAllocatableResources
+        # reports. None = "no v1 allocatable view" (v1alpha1-era behaviour).
+        self.allocatable: dict[str, list[str]] | None = None
 
     def assign(self, namespace: str, pod: str, device_ids: list[str],
                container: str = "main",
@@ -87,3 +179,8 @@ class FakePodResourcesClient(PodResourcesClient):
                 for resource, ids in resources.items():
                     cr.devices.add(resource_name=resource, device_ids=ids)
         return resp
+
+    def allocatable_tpu_ids(self, resource_name: str) -> set[str] | None:
+        if self.allocatable is None:
+            return None
+        return set(self.allocatable.get(resource_name, []))

@@ -105,6 +105,59 @@ def test_real_grpc_client_against_fake_kubelet(tmp_path):
         assert list(pr.containers[0].devices[0].device_ids) == ["0", "1"]
 
 
+def test_real_grpc_client_selects_v1(tmp_path):
+    """Against a modern (v1-serving) kubelet the client settles on v1 and
+    GetAllocatableResources works."""
+    socket_path = str(tmp_path / "kubelet.sock")
+    server = FakeKubeletServer(socket_path)
+    server.state.assign("default", "p", ["0"])
+    server.state.allocatable = {consts.TPU_RESOURCE_NAME: ["0", "1", "2"]}
+    with server:
+        client = KubeletPodResourcesClient(socket_path, timeout_s=5)
+        resp = client.list_pods()
+        assert client.api_version == "v1"
+        assert resp.pod_resources[0].name == "p"
+        assert client.allocatable_tpu_ids(consts.TPU_RESOURCE_NAME) == \
+            {"0", "1", "2"}
+
+
+def test_real_grpc_client_falls_back_to_v1alpha1(tmp_path):
+    """An old kubelet (no v1 service) answers UNIMPLEMENTED; the client
+    must fall back permanently and report no allocatable view (ref
+    collector.go:16 pinned v1alpha1 and had neither choice)."""
+    socket_path = str(tmp_path / "kubelet.sock")
+    server = FakeKubeletServer(socket_path, serve_v1=False)
+    server.state.assign("default", "p", ["0", "3"])
+    with server:
+        client = KubeletPodResourcesClient(socket_path, timeout_s=5)
+        resp = client.list_pods()
+        assert client.api_version == "v1alpha1"
+        assert list(resp.pod_resources[0].containers[0]
+                    .devices[0].device_ids) == ["0", "3"]
+        assert client.allocatable_tpu_ids(consts.TPU_RESOURCE_NAME) is None
+        # the fallback is remembered: no per-call re-probe
+        assert client.list_pods().pod_resources[0].name == "p"
+        assert client.api_version == "v1alpha1"
+
+
+def test_free_gauge_uses_v1_allocatable(tmp_path):
+    """A chip the kubelet excludes from allocatable (unhealthy / plugin
+    not registered) must not be advertised as free, even though the
+    enumerator sees its device node."""
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    socket_path = str(tmp_path / "kubelet.sock")
+    server = FakeKubeletServer(socket_path)
+    server.state.allocatable = {consts.TPU_RESOURCE_NAME: ["0", "1", "2"]}
+    with server:
+        coll = TPUCollector(
+            FakeEnumerator(make_chips(4)),     # enumerator sees 4 nodes
+            KubeletPodResourcesClient(socket_path, timeout_s=5))
+        server.state.assign("default", "p", ["2"])
+        coll.update_status()
+        assert REGISTRY.chips.value(state="free") == 2        # 0,1 (not 3)
+        assert REGISTRY.chips.value(state="allocated") == 1   # 2
+
+
 def test_grpc_client_missing_socket_raises(tmp_path):
     client = KubeletPodResourcesClient(str(tmp_path / "nope.sock"))
     with pytest.raises(KubeletUnavailableError):

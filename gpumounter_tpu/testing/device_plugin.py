@@ -1,0 +1,209 @@
+"""Stub ``google.com/tpu`` kubelet device plugin (v1beta1).
+
+The kind e2e's keystone: on a node with no TPUs, this plugin registers
+``google.com/tpu`` with the real kubelet and advertises N fake chips, so
+the REAL scheduler + kubelet run the slave-pod accounting path end to end
+(SURVEY.md §7 build order 6 — the reference was only ever validated against
+live GPU clusters; this is the hardware-free equivalent).
+
+Allocate responses bind-mount the fixture chip files
+(``<dev_root>/accelN`` + ``.majmin`` sidecar) into the container at
+``/dev/accelN`` — regular files, mountable anywhere, accepted by the
+framework's enumerators under ``TPU_ALLOW_FAKE_DEVICES=1`` (BASELINE
+config 1's fake-chip format, device/fake.py).
+
+CLI (inside the kind node / a privileged pod with the kubelet dirs):
+
+    python -m gpumounter_tpu.testing.device_plugin \
+        --devices 4 --dev-root /var/lib/tpumounter-fake-dev \
+        [--plugin-dir /var/lib/kubelet/device-plugins]
+
+Creates the fixture files, serves DevicePlugin on
+``<plugin-dir>/tpumounter-stub.sock``, registers with the kubelet, and
+re-registers if the kubelet restarts (its Registration socket reappears).
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import os
+import threading
+
+import grpc
+
+from gpumounter_tpu.api import deviceplugin_pb2 as pb
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("testing.device_plugin")
+
+KUBELET_PLUGIN_DIR = "/var/lib/kubelet/device-plugins"
+ENDPOINT = "tpumounter-stub.sock"
+API_VERSION = "v1beta1"
+
+
+def make_fixture_chips(dev_root: str, n: int, major: int = 120) -> list[str]:
+    """Fixture chip files in the fake-device format every enumerator
+    accepts with allow_fake (regular file + ``.majmin`` sidecar)."""
+    os.makedirs(dev_root, exist_ok=True)
+    ids = []
+    for i in range(n):
+        path = os.path.join(dev_root, f"accel{i}")
+        with open(path, "w"):
+            pass
+        with open(path + ".majmin", "w") as f:
+            f.write(f"{major}:{i}")
+        ids.append(str(i))
+    return ids
+
+
+class StubTPUPlugin:
+    """Serves the DevicePlugin service and handles kubelet registration."""
+
+    def __init__(self, n_devices: int, dev_root: str,
+                 plugin_dir: str = KUBELET_PLUGIN_DIR,
+                 resource_name: str = consts.TPU_RESOURCE_NAME,
+                 endpoint: str = ENDPOINT):
+        self.n_devices = n_devices
+        self.dev_root = dev_root
+        self.plugin_dir = plugin_dir
+        self.resource_name = resource_name
+        self.endpoint = endpoint
+        self.socket_path = os.path.join(plugin_dir, endpoint)
+        self._server: grpc.Server | None = None
+        self._executor: concurrent.futures.ThreadPoolExecutor | None = None
+        self._stop = threading.Event()
+
+    # -- DevicePlugin service handlers ----------------------------------------
+
+    def _options(self, request, context) -> pb.DevicePluginOptions:
+        return pb.DevicePluginOptions()
+
+    def _list_and_watch(self, request, context):
+        devices = [pb.Device(ID=str(i), health="Healthy")
+                   for i in range(self.n_devices)]
+        yield pb.ListAndWatchResponse(devices=devices)
+        # hold the stream open (static device set) until the kubelet
+        # cancels or we stop; event-wait so shutdown is prompt
+        stop = self._stop
+        while not stop.wait(0.5):
+            if not context.is_active():
+                return
+
+    def _allocate(self, request: pb.AllocateRequest,
+                  context) -> pb.AllocateResponse:
+        resp = pb.AllocateResponse()
+        for creq in request.container_requests:
+            cresp = resp.container_responses.add()
+            for device_id in creq.devicesIDs:
+                host = os.path.join(self.dev_root, f"accel{device_id}")
+                for suffix in ("", ".majmin"):
+                    cresp.mounts.add(
+                        container_path=f"/dev/accel{device_id}{suffix}",
+                        host_path=host + suffix, read_only=False)
+        logger.info("Allocate: %s", [list(c.devicesIDs)
+                                     for c in request.container_requests])
+        return resp
+
+    def _pre_start(self, request, context) -> pb.PreStartContainerResponse:
+        return pb.PreStartContainerResponse()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "StubTPUPlugin":
+        make_fixture_chips(self.dev_root, self.n_devices)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._executor = concurrent.futures.ThreadPoolExecutor(max_workers=4)
+        self._server = grpc.server(self._executor)
+        handler = grpc.method_handlers_generic_handler(
+            "v1beta1.DevicePlugin", {
+                "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
+                    self._options,
+                    request_deserializer=pb.Empty.FromString,
+                    response_serializer=(
+                        pb.DevicePluginOptions.SerializeToString)),
+                "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+                    self._list_and_watch,
+                    request_deserializer=pb.Empty.FromString,
+                    response_serializer=(
+                        pb.ListAndWatchResponse.SerializeToString)),
+                "Allocate": grpc.unary_unary_rpc_method_handler(
+                    self._allocate,
+                    request_deserializer=pb.AllocateRequest.FromString,
+                    response_serializer=pb.AllocateResponse.SerializeToString),
+                "PreStartContainer": grpc.unary_unary_rpc_method_handler(
+                    self._pre_start,
+                    request_deserializer=(
+                        pb.PreStartContainerRequest.FromString),
+                    response_serializer=(
+                        pb.PreStartContainerResponse.SerializeToString)),
+            })
+        self._server.add_generic_rpc_handlers((handler,))
+        self._server.add_insecure_port(f"unix://{self.socket_path}")
+        self._server.start()
+        logger.info("device plugin serving on %s (%d devices)",
+                    self.socket_path, self.n_devices)
+        return self
+
+    def register(self, kubelet_socket: str | None = None) -> None:
+        """Register with the kubelet's Registration service."""
+        kubelet_socket = kubelet_socket or os.path.join(
+            self.plugin_dir, "kubelet.sock")
+        channel = grpc.insecure_channel(f"unix://{kubelet_socket}")
+        try:
+            call = channel.unary_unary(
+                "/v1beta1.Registration/Register",
+                request_serializer=pb.RegisterRequest.SerializeToString,
+                response_deserializer=pb.Empty.FromString)
+            call(pb.RegisterRequest(version=API_VERSION,
+                                    endpoint=self.endpoint,
+                                    resource_name=self.resource_name),
+                 timeout=10)
+            logger.info("registered %s with kubelet", self.resource_name)
+        finally:
+            channel.close()
+
+    def serve_forever(self) -> None:
+        """Register and re-register when the kubelet restarts (detected by
+        our plugin socket disappearing — kubelet wipes the dir on boot)."""
+        self.register()
+        while not self._stop.wait(3.0):
+            if not os.path.exists(self.socket_path):
+                logger.info("kubelet restarted; re-serving + re-registering")
+                self.stop_server()
+                self.start()
+                self.register()
+
+    def stop_server(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.stop(grace=0)
+            self._server = None
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+            self._executor = None
+        self._stop = threading.Event()
+
+    def __enter__(self) -> "StubTPUPlugin":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop_server()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--devices", type=int, default=4)
+    parser.add_argument("--dev-root", default="/var/lib/tpumounter-fake-dev")
+    parser.add_argument("--plugin-dir", default=KUBELET_PLUGIN_DIR)
+    args = parser.parse_args(argv)
+    plugin = StubTPUPlugin(args.devices, args.dev_root, args.plugin_dir)
+    plugin.start()
+    plugin.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,95 @@
+"""Stub device plugin tests: the v1beta1 wire contract over real unix
+sockets — the locally-verifiable half of the kind e2e (the other half,
+kubelet's side of the contract, runs in CI's kind cluster)."""
+
+import concurrent.futures
+import threading
+
+import grpc
+import pytest
+
+from gpumounter_tpu.api import deviceplugin_pb2 as pb
+from gpumounter_tpu.testing.device_plugin import StubTPUPlugin
+
+
+@pytest.fixture
+def plugin(tmp_path):
+    plugin_dir = tmp_path / "device-plugins"
+    plugin_dir.mkdir()
+    p = StubTPUPlugin(n_devices=4, dev_root=str(tmp_path / "dev"),
+                      plugin_dir=str(plugin_dir))
+    with p:
+        yield p
+
+
+def _channel(p):
+    return grpc.insecure_channel(f"unix://{p.socket_path}")
+
+
+def test_fixture_chips_created(plugin, tmp_path):
+    for i in range(4):
+        assert (tmp_path / "dev" / f"accel{i}").exists()
+        assert (tmp_path / "dev" / f"accel{i}.majmin").read_text() == \
+            f"120:{i}"
+
+
+def test_list_and_watch_streams_healthy_devices(plugin):
+    with _channel(plugin) as channel:
+        stream = channel.unary_stream(
+            "/v1beta1.DevicePlugin/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )(pb.Empty(), timeout=10)
+        first = next(iter(stream))
+        assert sorted(d.ID for d in first.devices) == ["0", "1", "2", "3"]
+        assert all(d.health == "Healthy" for d in first.devices)
+        stream.cancel()
+
+
+def test_allocate_bind_mounts_fixture_files(plugin, tmp_path):
+    with _channel(plugin) as channel:
+        call = channel.unary_unary(
+            "/v1beta1.DevicePlugin/Allocate",
+            request_serializer=pb.AllocateRequest.SerializeToString,
+            response_deserializer=pb.AllocateResponse.FromString)
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=["1", "3"])
+        resp = call(req, timeout=10)
+        assert len(resp.container_responses) == 1
+        mounts = {m.container_path: m.host_path
+                  for m in resp.container_responses[0].mounts}
+        assert mounts["/dev/accel1"] == str(tmp_path / "dev" / "accel1")
+        assert mounts["/dev/accel3.majmin"] == \
+            str(tmp_path / "dev" / "accel3.majmin")
+
+
+def test_registers_with_kubelet_socket(plugin, tmp_path):
+    """The plugin dials the kubelet's Registration service with the
+    upstream-fixed version/endpoint/resource tuple."""
+    received = []
+    done = threading.Event()
+
+    def register(request: pb.RegisterRequest, context):
+        received.append(request)
+        done.set()
+        return pb.Empty()
+
+    kubelet_sock = str(tmp_path / "device-plugins" / "kubelet.sock")
+    server = grpc.server(concurrent.futures.ThreadPoolExecutor(max_workers=1))
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler("v1beta1.Registration", {
+            "Register": grpc.unary_unary_rpc_method_handler(
+                register,
+                request_deserializer=pb.RegisterRequest.FromString,
+                response_serializer=pb.Empty.SerializeToString)}),))
+    server.add_insecure_port(f"unix://{kubelet_sock}")
+    server.start()
+    try:
+        plugin.register()
+        assert done.wait(5)
+        req = received[0]
+        assert req.version == "v1beta1"
+        assert req.endpoint == "tpumounter-stub.sock"
+        assert req.resource_name == "google.com/tpu"
+    finally:
+        server.stop(grace=0)

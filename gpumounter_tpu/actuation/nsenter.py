@@ -190,6 +190,11 @@ class RecordingActuator(ContainerNsActuator):
 
     def remove_device_node(self, pid, device_path):
         self.removed.append((pid, device_path))
+        # Mirror the real actuators: the node is gone, so a later create of
+        # the same (pid, path) genuinely creates (returns True) — without
+        # this, a detach->attach cycle would be misread as a no-op resume.
+        self.created = [e for e in self.created
+                        if not (e[0] == pid and e[1] == device_path)]
 
     def kill_processes(self, pids, sig=signal.SIGKILL):
         self.killed.extend((pid, sig) for pid in pids)

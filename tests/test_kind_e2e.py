@@ -1,0 +1,191 @@
+"""Real-apiserver e2e on a kind cluster (SURVEY.md §7 build order 6).
+
+Everything else in the suite talks to FakeKubeClient; this file drives the
+QuickStart flow against a REAL kube-apiserver + scheduler + kubelet, which
+is what catches REST-shape drift the fake cannot (DeleteOptions semantics,
+watch bookmarks/410s, RBAC denials, ownerReference/GC behaviour):
+
+  kind cluster → load the two images → apply deploy/ (the production
+  manifests, RBAC included) + the stub google.com/tpu device plugin →
+  attach 4 chips to a running pod over the master's REST surface → assert
+  device nodes appear inside the container, slave pods hold the scheduler
+  accounting, events are recorded → detach → assert reversal → delete the
+  target pod mid-hold → assert the orphan reconciler GCs the slave pods.
+
+Gated on TPUMOUNTER_KIND_E2E=1 plus kind/kubectl/docker on PATH, so it
+skips everywhere except the CI job that sets the environment up
+(.github/workflows/ci.yml `kind-e2e`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLUSTER = "tpumounter-e2e"
+NODE = f"{CLUSTER}-control-plane"
+MASTER_PORT = 18080
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("TPUMOUNTER_KIND_E2E") != "1"
+    or not all(shutil.which(b) for b in ("kind", "kubectl", "docker")),
+    reason="kind e2e needs TPUMOUNTER_KIND_E2E=1 + kind/kubectl/docker")
+
+
+def sh(*cmd: str, timeout: float = 300, check: bool = True,
+       capture: bool = True) -> str:
+    proc = subprocess.run(cmd, cwd=REPO, timeout=timeout, text=True,
+                          capture_output=capture)
+    if check and proc.returncode != 0:
+        raise AssertionError(
+            f"{' '.join(cmd)} rc={proc.returncode}\n"
+            f"stdout: {proc.stdout}\nstderr: {proc.stderr}")
+    return proc.stdout or ""
+
+
+def kubectl(*args: str, **kw) -> str:
+    return sh("kubectl", "--context", f"kind-{CLUSTER}", *args, **kw)
+
+
+def wait_until(what: str, fn, timeout: float = 180, poll: float = 2.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = fn()
+        if value:
+            return value
+        time.sleep(poll)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    sh("kind", "delete", "cluster", "--name", CLUSTER, check=False)
+    sh("kind", "create", "cluster", "--name", CLUSTER, "--wait", "120s",
+       timeout=600)
+    try:
+        for component in ("master", "worker"):
+            sh("docker", "build", "-f",
+               f"docker/tpu-mounter-{component}/Dockerfile",
+               "-t", f"tpu-mounter/{component}:latest", ".", timeout=900)
+            sh("kind", "load", "docker-image", "--name", CLUSTER,
+               f"tpu-mounter/{component}:latest", timeout=300)
+        # the worker DaemonSet targets GKE TPU nodes; dress the kind node up
+        kubectl("label", "node", NODE,
+                "cloud.google.com/gke-tpu-accelerator=tpu-v5-lite-podslice",
+                "cloud.google.com/gke-tpu-topology=2x2")
+        for manifest in ("namespace.yaml", "service-account.yaml",
+                         "rbac.yaml", "tpu-mounter-master.yaml",
+                         "tpu-mounter-svc.yaml", "tpu-mounter-workers.yaml"):
+            kubectl("apply", "-f", f"deploy/{manifest}")
+        kubectl("patch", "daemonset", "-n", "kube-system",
+                "tpu-mounter-worker", "--patch-file",
+                "deploy/e2e-kind/worker-patch.yaml")
+        kubectl("apply", "-f", "deploy/e2e-kind/device-plugin.yaml")
+        kubectl("rollout", "status", "-n", "kube-system",
+                "daemonset/stub-tpu-device-plugin", "--timeout=180s")
+        # the stub plugin registered -> the node advertises 4 fake chips
+        wait_until("google.com/tpu allocatable", lambda: kubectl(
+            "get", "node", NODE, "-o",
+            "jsonpath={.status.allocatable.google\\.com/tpu}"
+        ).strip() == "4")
+        kubectl("rollout", "status", "-n", "kube-system",
+                "daemonset/tpu-mounter-worker", "--timeout=180s")
+        kubectl("rollout", "status", "-n", "kube-system",
+                "deployment/tpu-mounter-master", "--timeout=180s")
+        kubectl("apply", "-f", "deploy/e2e-kind/workload.yaml")
+        kubectl("wait", "--for=condition=Ready", "pod/workload",
+                "--timeout=120s")
+        forward = subprocess.Popen(
+            ["kubectl", "--context", f"kind-{CLUSTER}", "-n", "kube-system",
+             "port-forward", "svc/tpu-mounter-svc",
+             f"{MASTER_PORT}:80"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            wait_until("master reachable", _master_alive, timeout=60)
+            yield
+        finally:
+            forward.terminate()
+    finally:
+        sh("kind", "delete", "cluster", "--name", CLUSTER, check=False,
+           timeout=300)
+
+
+def _master_alive() -> bool:
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{MASTER_PORT}/metrics", timeout=2)
+        return True
+    except Exception:
+        return False
+
+
+def _call(path: str, method: str = "GET", data: dict | None = None) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{MASTER_PORT}{path}",
+        data=json.dumps(data).encode() if data is not None else None,
+        method=method)
+    with urllib.request.urlopen(req, timeout=180) as resp:
+        return json.loads(resp.read())
+
+
+def _workload_dev() -> set[str]:
+    out = kubectl("exec", "pod/workload", "--", "sh", "-c",
+                  "ls /dev | grep -E '^accel[0-9]+$' || true")
+    return {line for line in out.split() if line}
+
+
+def test_attach_detach_against_real_cluster(cluster):
+    # -- attach: 4 chips, entire mount -----------------------------------
+    body = _call("/addtpu/namespace/default/pod/workload"
+                 "/tpu/4/isEntireMount/true")
+    assert body["result"] == "SUCCESS", body
+    assert len(body["device_ids"]) == 4, body
+
+    # the chips are real inside the running container
+    assert _workload_dev() == {"accel0", "accel1", "accel2", "accel3"}
+
+    # scheduler accounting: one slave pod holds the 4 chips in tpu-pool
+    slaves = json.loads(kubectl("get", "pods", "-n", "tpu-pool", "-o",
+                                "json"))["items"]
+    assert len(slaves) == 1, [s["metadata"]["name"] for s in slaves]
+    limits = slaves[0]["spec"]["containers"][0]["resources"]["limits"]
+    assert limits.get("google.com/tpu") == "4", limits
+
+    # the audit trail reached the real events API (RBAC sufficed)
+    events = wait_until("TPUAttached event", lambda: [
+        e for e in json.loads(kubectl(
+            "get", "events", "-n", "default", "-o", "json"))["items"]
+        if e.get("reason") == "TPUAttached"])
+    assert events[0]["involvedObject"]["name"] == "workload"
+
+    # -- status surfaces --------------------------------------------------
+    status = _call("/tpustatus/namespace/default/pod/workload")
+    assert len(status["chips"]) == 4, status
+
+    # -- detach ------------------------------------------------------------
+    body = _call("/removetpu/namespace/default/pod/workload/force/false",
+                 method="POST", data={"uuids": body["device_ids"]})
+    assert body["result"] == "SUCCESS", body
+    assert _workload_dev() == set()
+    wait_until("slave pods deleted", lambda: not json.loads(kubectl(
+        "get", "pods", "-n", "tpu-pool", "-o", "json"))["items"])
+
+
+def test_orphan_gc_after_target_pod_deletion(cluster):
+    """Delete the target pod while it holds a chip: the worker's orphan
+    reconciler must release the slave pod (cross-namespace ownerReferences
+    don't GC — the reference's design bug, FAQ.md)."""
+    body = _call("/addtpu/namespace/default/pod/workload"
+                 "/tpu/1/isEntireMount/false")
+    assert body["result"] == "SUCCESS", body
+    kubectl("delete", "pod", "workload", "--wait=true", timeout=180)
+    wait_until("orphaned slave pods GCed", lambda: not json.loads(kubectl(
+        "get", "pods", "-n", "tpu-pool", "-o", "json"))["items"],
+        timeout=120)

@@ -25,7 +25,7 @@ Usage (``python -m gpumounter_tpu.cli`` or the ``tpumounterctl`` entry):
 
 The master address comes from ``--master`` or ``$TPU_MOUNTER_MASTER``
 (default ``http://127.0.0.1:8080`` — matching a
-``kubectl -n kube-system port-forward svc/tpu-mounter-svc 8080:80``).
+``kubectl -n kube-system port-forward svc/tpu-mounter 8080:80``).
 """
 
 from __future__ import annotations

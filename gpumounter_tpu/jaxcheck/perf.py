@@ -48,22 +48,29 @@ Attention-kernel findings (both measured on v5e, kept for honesty):
   asserts the seq>=4096 win on hardware.
 
 Round-5: the kernel became TRAINABLE (``make_flash_attention``: pallas
-forward + custom-VJP blockwise backward, no [T, T] tensor either
-direction), converting the microbenchmark into a capability
-(:func:`measure_long_context`, v5e, flagship dims, 8192 tokens/step):
+forward + fused pallas backward under custom VJP, no [T, T] tensor in
+either direction) and then TUNED (512-row q tiles over 1024-row k blocks
+forward; 1024x1024 backward tiles; causal block skip — backward pair
+70.5 -> 22.5 ms at b4 h8 t8192). Measured on v5e, flagship dims
+(:func:`measure_long_context` / :func:`measure_both`):
 
 =====================================  ==========  =====
 config                                 step ms      MFU
 =====================================  ==========  =====
-seq 4096 b2, flash                        415      0.566
+seq 1024 b8, flash (PRIMARY)              285      0.736
+seq 1024 b8, XLA full attention           316      0.663
+seq 4096 b2, flash                        342      0.686
 seq 4096 b2, XLA full attention           645      0.364
-seq 8192 b1, flash                        575      0.466
+seq 8192 b1, flash                        385      0.697
 seq 8192 b1, XLA full attention           OOM        —
+seq 16384 b1, flash                       930      0.721
 =====================================  ==========  =====
 
-At seq 1024 (the primary config) flash moves the step <2% — the step is
-GEMM-floor-bound there (:func:`measure_roofline`); sequence length is
-where the kernel pays.
+The tuned kernels beat XLA fused attention at EVERY length, including the
+short-sequence regime where the round-4 kernel lost; long-context MFU now
+*exceeds* the short-sequence figure (attention FLOPs are counted, and the
+kernel runs them near GEMM efficiency), and seq 16384 trains on a single
+chip.
 """
 
 from __future__ import annotations
@@ -246,17 +253,33 @@ def measure_both(batch: int = 8, t_len: int = 1024) -> dict[str, Any]:
     Top-level mfu/ok mirror the PRIMARY so existing consumers keep working;
     the tuned run is best-effort extra evidence — its ~10.6 GB of bf16
     state may not fit smaller-HBM chips, and an OOM there must not discard
-    the primary measurement that already succeeded."""
-    primary = measure_train_perf(mxu_config(), batch=batch, t_len=t_len)
+    the primary measurement that already succeeded.
+
+    The primary trains through the repo's OWN flash kernels (round-5: the
+    tuned tile/skip kernels beat XLA fused attention even at seq 1024 —
+    0.74 vs 0.63-0.66 MFU on v5e); ``xla_attention`` records the same
+    config on stock XLA attention so the kernel's contribution stays
+    measured, not asserted."""
+    primary = measure_train_perf(mxu_config(), batch=batch, t_len=t_len,
+                                 attn_impl="flash")
     try:
-        tuned_full = measure_train_perf(tuned_config(), batch=16, t_len=512)
+        stock = measure_train_perf(mxu_config(), batch=batch, t_len=t_len,
+                                   attn_impl="ring",   # -> XLA full attn
+                                   window_a=2, window_b=6, warmup_steps=1)
+        xla: dict[str, Any] = {k: stock[k] for k in (
+            "train_step_ms", "mfu", "ok")}
+    except Exception as e:
+        xla = {"ok": False, "error": repr(e)[:300]}
+    try:
+        tuned_full = measure_train_perf(tuned_config(), batch=16, t_len=512,
+                                        attn_impl="flash")
         tuned: dict[str, Any] = {
             k: tuned_full[k] for k in
             ("config", "train_step_ms", "model_tflops_per_step",
              "achieved_tflops", "mfu", "ok")}
     except Exception as e:
         tuned = {"ok": False, "error": repr(e)[:300]}
-    return {**primary, "tuned": tuned}
+    return {**primary, "xla_attention": xla, "tuned": tuned}
 
 
 def measure_long_context() -> dict[str, Any]:
@@ -277,7 +300,7 @@ def measure_long_context() -> dict[str, Any]:
     import jax
     cfg = mxu_config()
     rows: list[dict[str, Any]] = []
-    for t_len, batch in ((4096, 2), (8192, 1)):
+    for t_len, batch in ((4096, 2), (8192, 1), (16384, 1)):
         row: dict[str, Any] = {"seq": t_len, "batch": batch,
                                "tokens_per_step": batch * t_len}
         try:
@@ -300,7 +323,7 @@ def measure_long_context() -> dict[str, Any]:
 
     hbm = hbm_bytes()
     xla_rows: list[dict[str, Any]] = []
-    for t_len, batch in ((4096, 2), (8192, 1)):
+    for t_len, batch in ((4096, 2), (8192, 1), (16384, 1)):
         xla: dict[str, Any] = {"seq": t_len, "batch": batch}
         # one f32 [b,h,T,T] probability matrix per layer is the floor of
         # what autodiff through full attention keeps for the backward
@@ -360,18 +383,16 @@ def measure_roofline(batch: int = 8, t_len: int = 1024,
     it consisted ONLY of its GEMMs at their measured standalone
     efficiencies — the number to compare the measured MFU against.
 
-    Round-5 measurements on v5e (re-runnable via this function): measured
-    0.63-0.67 vs matmul-composite ceiling ~0.64 — the step achieves its
-    own GEMMs' composite efficiency, i.e. the remaining gap to the chip's
-    peak is per-GEMM shape efficiency (out_proj [8192x4096x4096] reaches
-    only ~0.37 standalone; mlp_in ~0.80 is the best), not framework
-    overhead. The in-step attention ablation (~70ms, ~23% of step at 4%
-    of counted FLOPs) confirmed attention is softmax/HBM-bound, but
-    swapping in the pallas flash kernel at seq 1024 moved the step <2%
-    (0.663 -> 0.669): its gain is bounded by the same GEMM floor. Hence
-    the primary MFU stands as within ~5% of this config's practical
-    ceiling; the lever that actually pays is longer sequence (see
-    measure_long_context).
+    Round-5 measurements on v5e (re-runnable via this function): the
+    XLA-attention step measured 0.63-0.67 vs a matmul-composite ceiling
+    ~0.64 — at its own GEMMs' efficiency, with per-GEMM shapes setting the
+    bound (out_proj [8192x4096x4096] ~0.37 standalone; mlp_in ~0.80). The
+    in-step attention ablation (~70 ms, ~23% of step at 4% of counted
+    FLOPs) identified attention as softmax/HBM-bound — and tuning the
+    repo's flash kernels (larger tiles + causal skip) converted exactly
+    that margin into the primary 0.74 (measure_both: flash primary vs the
+    recorded stock-XLA row). What remains above 0.74 is per-GEMM shape
+    efficiency, not framework overhead.
 
     Caveat on composition: the standalone pieces each carry chain-link
     measurement overheads (per-link input perturbation + output sums), so

@@ -102,20 +102,62 @@ def _attention_grads(attn, q, k, v, w):
     return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
 
 
-def test_flash_attention_grads_match_reference():
+@pytest.mark.parametrize("bwd_impl", ["pallas", "xla"])
+def test_flash_attention_grads_match_reference(bwd_impl):
     """The trainable pallas flash attention (custom VJP: kernel forward,
-    blockwise backward) must produce the same q/k/v gradients as autodiff
-    through the unsharded einsum reference — the correctness basis of the
-    long-context training path."""
+    fused-pallas or blockwise-XLA backward) must produce the same q/k/v
+    gradients as autodiff through the unsharded einsum reference — the
+    correctness basis of the long-context training path."""
     from gpumounter_tpu.jaxcheck.pallas_attention import make_flash_attention
     q, k, v = make_qkv(jax.random.PRNGKey(7), b=1, t=256, h=2, d=64)
     w = jax.random.normal(jax.random.PRNGKey(8), q.shape, jnp.float32)
-    flash = make_flash_attention(interpret=True, bwd_block=128)
+    flash = make_flash_attention(interpret=True, bwd_block=128,
+                                 bwd_impl=bwd_impl)
     got = _attention_grads(flash, q, k, v, w)
     want = _attention_grads(full_attention, q, k, v, w)
     for g, r in zip(got, want):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r),
                                    atol=3e-5, rtol=3e-5)
+
+
+def test_flash_attention_odd_multiple_of_tile_q():
+    """T=1536 and T=768 are multiples of TILE_Q but not of the tuned
+    512/1024 tile defaults — the tiles must adapt downward instead of
+    asserting (round-5 review regression)."""
+    from gpumounter_tpu.jaxcheck.pallas_attention import make_flash_attention
+    flash = make_flash_attention(interpret=True)
+    for t in (1536, 768):
+        q, k, v = make_qkv(jax.random.PRNGKey(t), b=1, t=t, h=2, d=64)
+        w = jax.random.normal(jax.random.PRNGKey(t + 1), q.shape,
+                              jnp.float32)
+        got = _attention_grads(flash, q, k, v, w)
+        want = _attention_grads(full_attention, q, k, v, w)
+        for g, r in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                       atol=5e-5, rtol=5e-5)
+
+
+def test_kblocked_forward_matches_whole_k():
+    """The scratch-accumulating (bh, q-tile, k-block) forward — online
+    softmax rescaling + causal block skip — must reproduce the whole-K
+    kernel's (pv, m, l) contract exactly, including at nonzero ring
+    offsets."""
+    from gpumounter_tpu.jaxcheck.pallas_attention import (
+        flash_block_bthd, normalize_flash_stats)
+    q, k, v = make_qkv(jax.random.PRNGKey(13), b=1, t=512, h=2, d=64)
+    pv, m, l = flash_block_bthd(q, k, v, 0, 0, interpret=True,
+                                tile_q=128, k_block=128)
+    ref = full_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(normalize_flash_stats(pv, l)), np.asarray(ref),
+        atol=3e-5, rtol=3e-5)
+    # ring usage: nonzero global offsets must agree with the 2D kernel
+    pv2, m2, l2 = flash_block_bthd(q, k, v, 1024, 1024, interpret=True,
+                                   tile_q=128, k_block=128)
+    pv3, m3, l3 = flash_block_bthd(q, k, v, 1024, 1024, interpret=True)
+    np.testing.assert_allclose(np.asarray(pv2), np.asarray(pv3), atol=3e-5)
+    np.testing.assert_allclose(np.asarray(m2), np.asarray(m3), atol=3e-5)
+    np.testing.assert_allclose(np.asarray(l2), np.asarray(l3), atol=3e-5)
 
 
 def test_ring_custom_vjp_grads_match_reference():

@@ -104,7 +104,7 @@ def cluster():
                 "--timeout=120s")
         forward = subprocess.Popen(
             ["kubectl", "--context", f"kind-{CLUSTER}", "-n", "kube-system",
-             "port-forward", "svc/tpu-mounter-svc",
+             "port-forward", "svc/tpu-mounter",
              f"{MASTER_PORT}:80"],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
         try:

@@ -57,9 +57,16 @@ def test_tpu_mfu_is_reported_and_plausible(selftest_report):
     if perf["peak_bf16_tflops"] is not None:
         assert 0.2 < perf["mfu"] <= 1.0, perf
         if "v5 lite" in perf["device_kind"].lower():
-            assert 0.5 < perf["mfu"] <= 1.0, perf
+            # round-5 regression floors: flash-kernel primary measured
+            # 0.73-0.74 on v5e; the tuned 8x-MLP entry no longer clearly
+            # exceeds it (both ride the same kernels), so both get the
+            # same floor instead of an ordering claim.
+            assert 0.65 < perf["mfu"] <= 1.0, perf
             assert perf["tuned"]["ok"], perf
-            assert perf["mfu"] < perf["tuned"]["mfu"] <= 1.0, perf
+            assert 0.65 < perf["tuned"]["mfu"] <= 1.0, perf
+            # the kernel's edge over stock XLA attention stays measured
+            if perf.get("xla_attention", {}).get("ok"):
+                assert perf["mfu"] > perf["xla_attention"]["mfu"], perf
 
 
 def test_tpu_pallas_parity_pinned_precision(selftest_report):
@@ -90,13 +97,13 @@ def test_tpu_long_context_training(selftest_report):
     lc = selftest_report["long_context"]
     assert lc["ok"], lc
     by_seq = {r["seq"]: r for r in lc["rows"]}
-    for seq in (4096, 8192):
+    for seq in (4096, 8192, 16384):
         fl = by_seq[seq]["flash"]
         assert fl["ok"], fl
         assert fl["train_step_ms"] > 0
         assert 0 < fl["mfu"] <= 1.0
     xla = {r["seq"]: r for r in lc["xla_full_attention"]}
-    for seq in (4096, 8192):
+    for seq in (4096, 8192, 16384):
         res = xla[seq]["result"]
         # ran (big-HBM chip) or OOMed (measured or predicted) — but the
         # flash path must run either way, which the loop above asserted

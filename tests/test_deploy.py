@@ -20,10 +20,25 @@ def load(name):
         return list(yaml.safe_load_all(f))
 
 
+def _production_manifests():
+    # deploy/ top level = the production manifests deploy.sh applies;
+    # subdirectories (e2e-kind/) are harness-specific overlays
+    root = os.path.join(REPO, "deploy")
+    return [n for n in os.listdir(root)
+            if os.path.isfile(os.path.join(root, n))]
+
+
 def test_all_manifests_parse():
-    for name in os.listdir(os.path.join(REPO, "deploy")):
+    for name in _production_manifests():
         docs = load(name)
         assert docs and all(d for d in docs), name
+    # the e2e overlay manifests must parse too
+    for sub in ("e2e-kind",):
+        subdir = os.path.join(REPO, "deploy", sub)
+        for name in os.listdir(subdir):
+            with open(os.path.join(subdir, name)) as f:
+                docs = list(yaml.safe_load_all(f))
+            assert docs and all(d for d in docs), f"{sub}/{name}" 
 
 
 def test_pool_namespace_consistent_with_code():
@@ -107,7 +122,7 @@ def test_deploy_sh_is_executable_and_covers_manifests():
     path = os.path.join(REPO, "deploy.sh")
     assert os.stat(path).st_mode & stat.S_IXUSR
     content = open(path).read()
-    for name in os.listdir(os.path.join(REPO, "deploy")):
+    for name in _production_manifests():
         assert f"deploy/{name}" in content, f"{name} missing from deploy.sh"
     rc = subprocess.run(["bash", "-n", path])
     assert rc.returncode == 0

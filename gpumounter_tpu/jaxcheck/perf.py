@@ -148,7 +148,8 @@ def tuned_config():
 
 
 def measure_attention_kernels(seqs: tuple[int, ...] = (1024, 2048, 4096),
-                              pallas_only_seqs: tuple[int, ...] = (8192,),
+                              pallas_only_seqs: tuple[int, ...] = (8192,
+                                                                   16384),
                               b: int = 4, h: int = 8, d: int = 128,
                               chain: int = 20) -> dict[str, Any]:
     """Forward attention-op microbenchmark: XLA fused full attention vs the

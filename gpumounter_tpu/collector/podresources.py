@@ -33,8 +33,13 @@ _LIST_METHOD_V1ALPHA1 = "/v1alpha1.PodResourcesLister/List"
 _LIST_METHOD_V1 = "/v1.PodResourcesLister/List"
 _ALLOCATABLE_METHOD_V1 = "/v1.PodResourcesLister/GetAllocatableResources"
 
-# grpc codes a kubelet answers with when a service/method doesn't exist
-_FALLBACK_CODES = (grpc.StatusCode.UNIMPLEMENTED, grpc.StatusCode.UNKNOWN)
+# UNIMPLEMENTED is what a kubelet without the service answers — a
+# PERMANENT fact about the serving API. UNKNOWN can also mean a transient
+# failure of a registered handler (grpc-go), so it only triggers a
+# fallback for THIS call without pinning the version — the next List
+# re-probes v1.
+_PERMANENT_FALLBACK_CODES = (grpc.StatusCode.UNIMPLEMENTED,)
+_TRANSIENT_FALLBACK_CODES = (grpc.StatusCode.UNKNOWN,)
 
 
 class PodResourcesClient(abc.ABC):
@@ -96,11 +101,18 @@ class KubeletPodResourcesClient(PodResourcesClient):
                     return resp
                 except grpc.RpcError as e:
                     if (self.api_version is None
-                            and e.code() in _FALLBACK_CODES):
+                            and e.code() in _PERMANENT_FALLBACK_CODES):
                         logger.info(
                             "kubelet has no v1 PodResources (%s); falling "
                             "back to v1alpha1", e.code())
                         self.api_version = "v1alpha1"
+                    elif (self.api_version is None
+                            and e.code() in _TRANSIENT_FALLBACK_CODES):
+                        # try v1alpha1 for this call, but leave the version
+                        # unpinned so the next List re-probes v1
+                        logger.info(
+                            "v1 PodResources List returned %s; trying "
+                            "v1alpha1 without pinning", e.code())
                     else:
                         raise KubeletUnavailableError(
                             f"PodResources List failed: {e.code()}: "
@@ -131,7 +143,8 @@ class KubeletPodResourcesClient(PodResourcesClient):
                               pb_v1.AllocatableResourcesRequest(),
                               pb_v1.AllocatableResourcesResponse)
         except grpc.RpcError as e:
-            if e.code() in _FALLBACK_CODES:
+            if e.code() in (_PERMANENT_FALLBACK_CODES
+                            + _TRANSIENT_FALLBACK_CODES):
                 # fake/partial v1 server; cache too — absent stays absent
                 self._alloc_cache[resource_name] = (
                     now + self.ALLOCATABLE_TTL_S, None)

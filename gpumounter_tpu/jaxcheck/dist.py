@@ -1,0 +1,29 @@
+"""Multi-host array placement helpers shared by the probe and the train
+state initialiser (a neutral home: probe imports train, so neither can own
+the helper without a cycle).
+
+The one delicate rule both callers rely on: in a multi-process JAX world,
+``jax.device_put`` of host data to a sharding spanning non-addressable
+devices is invalid — every process must hold IDENTICAL host data (same
+seed/derivation) and contribute only the shards it owns, which is exactly
+what ``jax.make_array_from_callback`` does. Single-process this degenerates
+to a plain transfer.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def put_global(host_array, sharding) -> jax.Array:
+    """Host data -> a (possibly multi-process) globally sharded array."""
+    host_array = np.asarray(host_array)
+    return jax.make_array_from_callback(
+        host_array.shape, sharding, lambda idx: host_array[idx])
+
+
+def put_global_tree(tree, shardings):
+    """``put_global`` over a pytree of host arrays with a matching pytree
+    of shardings (the multi-host parameter-placement path)."""
+    return jax.tree.map(put_global, tree, shardings)

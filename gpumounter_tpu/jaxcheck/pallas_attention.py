@@ -527,8 +527,10 @@ def make_flash_attention(interpret: bool = False,
         q, k, v, out, lse = res
         if bwd_impl == "pallas":
             return _flash_backward_pallas(q, k, v, out, lse, do, interpret)
+        # _fit_tile, not min(): the block must also DIVIDE T (T=768 is a
+        # valid multiple of TILE_Q that 512 doesn't divide)
         return _flash_backward(q, k, v, out, lse, do,
-                               min(bwd_block, q.shape[1]))
+                               _fit_tile(bwd_block, q.shape[1]))
 
     attn.defvjp(fwd, bwd)
     return attn

@@ -127,13 +127,9 @@ def initialize_distributed(coordinator_address: str | None = None,
     jax.distributed.initialize(**kwargs)
 
 
-def put_global(host_array: np.ndarray, sharding) -> jax.Array:
-    """Host data -> a (possibly multi-process) globally sharded array.
-    Every process must hold the same ``host_array`` and provides the
-    shards it is responsible for; single-process this degenerates to a
-    plain transfer."""
-    return jax.make_array_from_callback(
-        np.shape(host_array), sharding, lambda idx: host_array[idx])
+# Re-exported from the shared home (jaxcheck/dist.py): every process holds
+# identical host data and contributes only its own shards.
+from gpumounter_tpu.jaxcheck.dist import put_global  # noqa: E402
 
 
 def reinitialize_backend() -> None:
@@ -340,10 +336,19 @@ def main(argv: list[str] | None = None) -> int:
                         help="where accel* device nodes live (fixture "
                              "trees in tests)")
     args = parser.parse_args(argv)
-    if (args.coordinator is not None or args.distributed
-            or args.process_id is not None):
+    distributed = (args.coordinator is not None or args.distributed
+                   or args.process_id is not None)
+    if args.num_processes is not None and not distributed:
+        parser.error("--num-processes requires --coordinator, "
+                     "--process-id, or --distributed")
+    if distributed:
         initialize_distributed(args.coordinator, args.num_processes,
                                args.process_id, args.cpu_devices)
+    elif args.cpu_devices:
+        # hardware-free single-process mode: honor the flag instead of
+        # silently dropping it (N virtual CPU devices, no distributed init)
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
     try:
         report = run_probe(args.expect, args.timeout, dev_root=args.dev_root)
     except TimeoutError as e:

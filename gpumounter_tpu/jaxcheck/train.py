@@ -49,15 +49,10 @@ def init_state(key: jax.Array, cfg: ModelConfig, mesh: Mesh | None = None,
     if mesh is not None:
         shardings = model_lib.param_shardings(mesh, cfg)
         if jax.process_count() > 1:
-            # Multi-host mesh: device_put of host data to a sharding with
-            # non-addressable devices is invalid; every process holds the
-            # same init (same key) and contributes its own shards.
-            import numpy as np
-            params = jax.tree.map(
-                lambda x, s: jax.make_array_from_callback(
-                    np.shape(x), s,
-                    lambda idx, x=x: np.asarray(x)[idx]),
-                params, shardings)
+            # Multi-host mesh: every process holds the same init (same
+            # key) and contributes only its own shards (see dist.py).
+            from gpumounter_tpu.jaxcheck.dist import put_global_tree
+            params = put_global_tree(params, shardings)
         else:
             params = jax.device_put(params, shardings)
     opt_state = optimizer.init(params)

@@ -112,6 +112,9 @@ class StubTPUPlugin:
     # -- lifecycle -------------------------------------------------------------
 
     def start(self) -> "StubTPUPlugin":
+        # fresh stop event per server generation — resetting it in
+        # stop_server would let a concurrent serve_forever miss the signal
+        self._stop = threading.Event()
         make_fixture_chips(self.dev_root, self.n_devices)
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
@@ -184,7 +187,6 @@ class StubTPUPlugin:
         if self._executor is not None:
             self._executor.shutdown(wait=False)
             self._executor = None
-        self._stop = threading.Event()
 
     def __enter__(self) -> "StubTPUPlugin":
         return self.start()

@@ -120,12 +120,14 @@ def test_flash_attention_grads_match_reference(bwd_impl):
                                    atol=3e-5, rtol=3e-5)
 
 
-def test_flash_attention_odd_multiple_of_tile_q():
+@pytest.mark.parametrize("bwd_impl", ["pallas", "xla"])
+def test_flash_attention_odd_multiple_of_tile_q(bwd_impl):
     """T=1536 and T=768 are multiples of TILE_Q but not of the tuned
-    512/1024 tile defaults — the tiles must adapt downward instead of
-    asserting (round-5 review regression)."""
+    512/1024 tile defaults (nor of the XLA path's bwd_block=512) — the
+    tiles must adapt downward instead of asserting (round-5 review
+    regressions, both backward impls)."""
     from gpumounter_tpu.jaxcheck.pallas_attention import make_flash_attention
-    flash = make_flash_attention(interpret=True)
+    flash = make_flash_attention(interpret=True, bwd_impl=bwd_impl)
     for t in (1536, 768):
         q, k, v = make_qkv(jax.random.PRNGKey(t), b=1, t=t, h=2, d=64)
         w = jax.random.normal(jax.random.PRNGKey(t + 1), q.shape,

@@ -172,7 +172,7 @@ def make_attention(mesh: Mesh | None, cfg: ModelConfig,
             return make_flash_attention(interpret=interpret)
         return full_attention
     spec = P("data", "seq", "model", None)
-    if impl == "ulysses":
+    if impl in ("ulysses", "ulysses_flash"):
         from gpumounter_tpu.jaxcheck.ulysses import make_ulysses_attention
         # per-device head count after TP sharding must split over seq too
         per_device = mesh.shape["model"] * mesh.shape["seq"]
@@ -180,7 +180,9 @@ def make_attention(mesh: Mesh | None, cfg: ModelConfig,
             raise ValueError(
                 f"ulysses needs n_heads ({cfg.n_heads}) divisible by "
                 f"model*seq mesh axes ({per_device})")
-        return make_ulysses_attention(mesh, "seq", spec=spec)
+        local = "flash" if impl == "ulysses_flash" else "full"
+        return make_ulysses_attention(mesh, "seq", spec=spec,
+                                      local_impl=local, interpret=interpret)
     if impl == "ring":
         return make_sharded_ring_attention(mesh, "seq", spec=spec)
     if impl == "ring_pallas":

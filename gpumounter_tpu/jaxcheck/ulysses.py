@@ -30,13 +30,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 from gpumounter_tpu.jaxcheck.ring_attention import full_attention
 
 
-def _ulysses_attention(q, k, v, axis_name: str):
+def _ulysses_attention(q, k, v, axis_name: str, local_attention=None):
     """Per-shard body. q/k/v: [B, T_local, H, D] (sequence-sharded).
-    H must be divisible by the axis size."""
+    H must be divisible by the axis size. ``local_attention`` runs over
+    the gathered sequence for this device's heads (default: einsum full
+    attention)."""
     n = lax.psum(1, axis_name)
     _, _, heads, _ = q.shape
     assert heads % n == 0, (
         f"Ulysses needs heads ({heads}) divisible by axis size ({n})")
+    local_attention = local_attention or full_attention
 
     def seq_to_heads(x):
         # [B, T/n, H, D] -> [B, T, H/n, D]: split heads across devices,
@@ -49,21 +52,38 @@ def _ulysses_attention(q, k, v, axis_name: str):
                               tiled=True)
 
     q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = full_attention(q, k, v)      # full causal attention, local heads
+    out = local_attention(q, k, v)     # full causal attention, local heads
     return heads_to_seq(out)
 
 
 def make_ulysses_attention(mesh: Mesh, seq_axis: str = "seq",
-                           spec: P | None = None):
+                           spec: P | None = None,
+                           local_impl: str = "full",
+                           interpret: bool = False):
     """shard_map-wrapped Ulysses attention with the same call signature as
     :func:`make_sharded_ring_attention`: globally-shaped [B, T, H, D] inputs
-    sequence-sharded over ``seq_axis``."""
+    sequence-sharded over ``seq_axis``.
+
+    ``local_impl="flash"`` runs the gathered-sequence attention through the
+    trainable pallas flash kernels (custom VJP composes with the
+    all-to-alls under shard_map's AD) — after the redistribution each
+    device holds the FULL sequence for its heads, so at long T the einsum
+    local attention hits the same [T, T] score-tensor wall XLA does;
+    flash removes it for the Ulysses path exactly as for the single-chip
+    path."""
     spec = spec if spec is not None else P(None, seq_axis, None, None)
+    local = None
+    if local_impl == "flash":
+        from gpumounter_tpu.jaxcheck.pallas_attention import \
+            make_flash_attention
+        local = make_flash_attention(interpret=interpret)
+    elif local_impl != "full":
+        raise ValueError(f"unknown local_impl {local_impl!r}")
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     def sharded(q, k, v):
-        return _ulysses_attention(q, k, v, seq_axis)
+        return _ulysses_attention(q, k, v, seq_axis, local)
 
     return sharded

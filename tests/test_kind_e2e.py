@@ -87,6 +87,11 @@ def cluster():
         kubectl("patch", "daemonset", "-n", "kube-system",
                 "tpu-mounter-worker", "--patch-file",
                 "deploy/e2e-kind/worker-patch.yaml")
+        # :latest + default pull policy would try to PULL the side-loaded
+        # images; pin Never for both binaries
+        kubectl("patch", "deployment", "-n", "kube-system",
+                "tpu-mounter-master", "--patch-file",
+                "deploy/e2e-kind/master-patch.yaml")
         kubectl("apply", "-f", "deploy/e2e-kind/device-plugin.yaml")
         kubectl("rollout", "status", "-n", "kube-system",
                 "daemonset/stub-tpu-device-plugin", "--timeout=180s")

@@ -157,6 +157,18 @@ def main() -> None:
     # overhead mode (no injected delay): 100 cycles so the p99 is a real
     # percentile of the framework's own cost, not the max
     overhead, overhead_detach = measure_attach_cycle(0.0, cycles=100)
+    # Phase decomposition of the overhead cycles straight from the worker's
+    # own tracing histograms (the LiveStack worker runs in-process, so the
+    # registry is shared): where the framework's milliseconds go.
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    phase_p50_ms = {
+        f"attach_{d['phase']}": round(
+            REGISTRY.attach_phase.percentile(50, **d) * 1e3, 2)
+        for d in REGISTRY.attach_phase.phases()}
+    phase_p50_ms.update({
+        f"detach_{d['phase']}": round(
+            REGISTRY.detach_phase.percentile(50, **d) * 1e3, 2)
+        for d in REGISTRY.detach_phase.phases()})
     single, single_detach = measure_attach_cycle(0.0, cycles=25, n_chips=1,
                                                  entire=False)
     # >=100 e2e cycles so the p99 is a real percentile, not the max
@@ -178,6 +190,7 @@ def main() -> None:
             statistics.median(single_detach), 4),
         "detach_p50_s": round(statistics.median(overhead_detach), 4),
         "injected_schedule_delay_s": SCHED_DELAY_S,
+        "overhead_phase_p50_ms": phase_p50_ms,
         "cycles": {"overhead": len(overhead), "single": len(single),
                    "e2e": len(e2e)},
     }

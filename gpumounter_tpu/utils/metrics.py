@@ -106,6 +106,73 @@ class Histogram:
             yield f"{self.name}_count {cumulative}"
 
 
+class LabeledHistogram:
+    """A family of :class:`Histogram` series keyed by label values — the
+    subset of prometheus-client's labelled histogram this repo needs
+    (per-phase attach/detach latency). Series are created on first
+    observe; rendering emits one HELP/TYPE header then every series'
+    buckets/sum/count with its labels merged alongside ``le``."""
+
+    def __init__(self, name: str, help_text: str,
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_text
+        self.buckets = buckets
+        self._lock = threading.Lock()
+        self._series: dict[tuple[tuple[str, str], ...], Histogram] = {}
+
+    def _get(self, labels: dict[str, str]) -> Histogram:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            hist = self._series.get(key)
+            if hist is None:
+                hist = self._series[key] = Histogram(
+                    self.name, self.help, self.buckets)
+            return hist
+
+    def _peek(self, labels: dict[str, str]) -> Histogram | None:
+        """Read-side lookup: probing a series that never observed must NOT
+        create it, or /metrics would grow a phantom all-zero series per
+        mistyped phase queried."""
+        with self._lock:
+            return self._series.get(tuple(sorted(labels.items())))
+
+    def observe(self, value: float, **labels: str) -> None:
+        self._get(labels).observe(value)
+
+    def percentile(self, q: float, **labels: str) -> float:
+        hist = self._peek(labels)
+        return hist.percentile(q) if hist is not None else 0.0
+
+    def count(self, **labels: str) -> int:
+        hist = self._peek(labels)
+        return hist.count if hist is not None else 0
+
+    def phases(self) -> list[dict[str, str]]:
+        with self._lock:
+            return [dict(key) for key in self._series]
+
+    def render(self) -> Iterator[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        with self._lock:
+            items = sorted(self._series.items())
+        for key, hist in items:
+            labels = dict(key)
+            for line in hist.render():
+                if line.startswith("#"):
+                    continue
+                # merge series labels into the bucket/sum/count lines
+                if "{" in line:                      # _bucket{le="..."}
+                    head, rest = line.split("{", 1)
+                    extra = ",".join(f'{k}="{v}"'
+                                     for k, v in sorted(labels.items()))
+                    yield f"{head}{{{extra},{rest}"
+                else:                                # _sum / _count
+                    head, value = line.rsplit(" ", 1)
+                    yield f"{head}{_fmt_labels(labels)} {value}"
+
+
 class _Timer:
     def __init__(self, hist: Histogram):
         self._hist = hist
@@ -171,12 +238,19 @@ class Registry:
             "tpumounter_node_chips",
             "Chips on this node by allocation state "
             "(refreshed on every collector snapshot)")
+        self.attach_phase = LabeledHistogram(
+            "tpumounter_attach_phase_seconds",
+            "AddTPU latency by phase "
+            "(policy/allocate/resolve/actuate; rollback on mount failure)")
+        self.detach_phase = LabeledHistogram(
+            "tpumounter_detach_phase_seconds",
+            "RemoveTPU latency by phase (resolve/actuate/cleanup)")
 
     def render_text(self) -> str:
         lines: list[str] = []
         for metric in (self.attach_latency, self.detach_latency,
                        self.attach_results, self.detach_results,
-                       self.chips):
+                       self.chips, self.attach_phase, self.detach_phase):
             lines.extend(metric.render())
         return "\n".join(lines) + "\n"
 

@@ -1,0 +1,74 @@
+"""Per-request phase tracing for the attach/detach hot path.
+
+The reference has no tracing or profiling of any kind (SURVEY.md §5: "only
+zap logging" — the sole way to see where an attach's seconds went was
+reading interleaved debug lines). This framework's north-star metric IS a
+latency (hot-attach <3s p50, BASELINE.md), so its decomposition is a
+first-class observable:
+
+- every AddTPU/RemoveTPU collects named **spans** (``policy`` /
+  ``allocate`` / ``resolve`` / ``actuate`` / ``cleanup``);
+- on completion the trace is emitted as ONE structured log line
+  (``trace op=attach rid=... result=SUCCESS total_ms=... allocate_ms=...``)
+  so a single grep reconstructs any request's timing;
+- each span also feeds a per-phase Prometheus histogram
+  (``tpumounter_attach_phase_seconds{phase="allocate"}``), so fleet-wide
+  dashboards can answer "did the p95 regression come from the scheduler
+  or from actuation?" without touching logs.
+
+Spans survive failures: a trace finished after an exception still records
+the phases that ran, which is exactly when the breakdown matters most.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("trace")
+
+
+class Trace:
+    """Collects (phase, seconds) spans for one logical operation.
+
+    Not thread-safe by design: one Trace belongs to one request handler.
+    Phases repeated within a request (e.g. a retried resolve) accumulate
+    into one entry so the log line stays one-key-per-phase.
+    """
+
+    def __init__(self, op: str, rid: str = "-"):
+        self.op = op
+        self.rid = rid or "-"
+        self._t0 = time.monotonic()
+        self._spans: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def span(self, phase: str):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self._spans[phase] = (self._spans.get(phase, 0.0)
+                                  + time.monotonic() - t0)
+
+    @property
+    def spans(self) -> dict[str, float]:
+        return dict(self._spans)
+
+    def finish(self, result: str, histograms=None) -> None:
+        """Emit the trace: one log line + per-phase histogram observations.
+
+        ``histograms``: a mapping-like with ``observe(seconds, phase=...)``
+        (:class:`gpumounter_tpu.utils.metrics.LabeledHistogram`); None skips
+        the metrics feed (unit tests of the trace itself).
+        """
+        total = time.monotonic() - self._t0
+        if histograms is not None:
+            for phase, seconds in self._spans.items():
+                histograms.observe(seconds, phase=phase)
+        parts = " ".join(f"{phase}_ms={seconds * 1e3:.1f}"
+                         for phase, seconds in self._spans.items())
+        logger.info("trace op=%s rid=%s result=%s total_ms=%.1f %s",
+                    self.op, self.rid, result, total * 1e3, parts)

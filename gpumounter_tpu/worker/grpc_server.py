@@ -70,7 +70,8 @@ def _remove_handler(service: TPUMountService):
         try:
             outcome = service.remove_tpu(request.pod_name, request.namespace,
                                          list(request.uuids), request.force,
-                                         txn_id=request.txn_id)
+                                         txn_id=request.txn_id,
+                                         request_id=rid if rid != "-" else "")
         except TPUMounterError as e:
             logger.exception("[rid=%s] RemoveTPU internal failure", rid)
             context.abort(grpc.StatusCode.INTERNAL, str(e))

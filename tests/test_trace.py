@@ -1,0 +1,113 @@
+"""Per-request phase tracing (utils/trace.py + the labeled phase
+histograms): the attach/detach latency decomposition the reference never
+had (SURVEY.md §5: no tracing/profiling of any kind)."""
+
+import pytest
+
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.metrics import REGISTRY, LabeledHistogram
+from gpumounter_tpu.utils.trace import Trace
+
+from tests.helpers import WorkerRig
+
+
+def test_trace_collects_and_accumulates_spans():
+    trace = Trace("attach", "rid-1")
+    with trace.span("allocate"):
+        pass
+    with trace.span("allocate"):        # repeated phase accumulates
+        pass
+    with trace.span("actuate"):
+        pass
+    spans = trace.spans
+    assert set(spans) == {"allocate", "actuate"}
+    assert all(s >= 0 for s in spans.values())
+
+
+def test_trace_records_span_despite_exception():
+    trace = Trace("attach")
+    with pytest.raises(RuntimeError):
+        with trace.span("actuate"):
+            raise RuntimeError("boom")
+    assert "actuate" in trace.spans
+
+
+def test_trace_finish_feeds_labeled_histogram():
+    hist = LabeledHistogram("t_seconds", "test")
+    trace = Trace("attach", "rid-2")
+    with trace.span("policy"):
+        pass
+    trace.finish("SUCCESS", hist)
+    assert hist.count(phase="policy") == 1
+    assert hist.count(phase="allocate") == 0
+
+
+def test_labeled_histogram_renders_prometheus_exposition():
+    hist = LabeledHistogram("x_seconds", "help text", buckets=(0.1, 1.0))
+    hist.observe(0.05, phase="allocate")
+    hist.observe(5.0, phase="actuate")
+    text = "\n".join(hist.render())
+    assert "# TYPE x_seconds histogram" in text
+    assert 'x_seconds_bucket{phase="allocate",le="0.1"} 1' in text
+    assert 'x_seconds_bucket{phase="actuate",le="1"} 0' in text
+    assert 'x_seconds_bucket{phase="actuate",le="+Inf"} 1' in text
+    assert 'x_seconds_count{phase="allocate"} 1' in text
+    # exactly one header pair for the whole family
+    assert text.count("# HELP") == 1 and text.count("# TYPE") == 1
+
+
+def test_labeled_histogram_percentile_per_series():
+    hist = LabeledHistogram("y_seconds", "test")
+    for v in (0.1, 0.2, 0.3):
+        hist.observe(v, phase="a")
+    hist.observe(9.0, phase="b")
+    assert hist.percentile(50, phase="a") == pytest.approx(0.2)
+    assert hist.percentile(50, phase="b") == pytest.approx(9.0)
+
+
+@pytest.fixture
+def rig(fake_host):
+    return WorkerRig(fake_host)
+
+
+def _counts(hist):
+    return {d["phase"]: hist.count(**d) for d in hist.phases()}
+
+
+def test_attach_records_phase_histograms(rig):
+    before = _counts(REGISTRY.attach_phase)
+    out = rig.service.add_tpu("workload", "default", 2, False)
+    assert out.result is consts.AddResult.SUCCESS
+    after = _counts(REGISTRY.attach_phase)
+    for phase in ("policy", "allocate", "resolve", "actuate"):
+        assert after.get(phase, 0) == before.get(phase, 0) + 1, phase
+    # no failure -> no rollback span
+    assert after.get("rollback", 0) == before.get("rollback", 0)
+
+
+def test_detach_records_phase_histograms(rig):
+    out = rig.service.add_tpu("workload", "default", 2, False)
+    before = _counts(REGISTRY.detach_phase)
+    res = rig.service.remove_tpu("workload", "default",
+                                 [c.uuid for c in out.chips], force=False)
+    assert res.result is consts.RemoveResult.SUCCESS
+    after = _counts(REGISTRY.detach_phase)
+    for phase in ("resolve", "actuate", "cleanup"):
+        assert after.get(phase, 0) == before.get(phase, 0) + 1, phase
+
+
+def test_failed_attach_still_records_ran_phases(rig):
+    before = _counts(REGISTRY.attach_phase)
+    out = rig.service.add_tpu("ghost", "default", 1, False)
+    assert out.result is consts.AddResult.POD_NOT_FOUND
+    after = _counts(REGISTRY.attach_phase)
+    assert after.get("policy", 0) == before.get("policy", 0) + 1
+    # never reached allocation
+    assert after.get("allocate", 0) == before.get("allocate", 0)
+
+
+def test_phase_histograms_render_on_metrics_endpoint(rig):
+    rig.service.add_tpu("workload", "default", 1, False)
+    text = REGISTRY.render_text()
+    assert "tpumounter_attach_phase_seconds_bucket" in text
+    assert 'phase="allocate"' in text

@@ -162,8 +162,12 @@ class LabeledHistogram:
             for line in hist.render():
                 if line.startswith("#"):
                     continue
-                # merge series labels into the bucket/sum/count lines
-                if "{" in line:                      # _bucket{le="..."}
+                if not labels:
+                    # a label-less series: the plain lines are already
+                    # valid ({,le=...} with a leading comma is not)
+                    yield line
+                elif "{" in line:                    # _bucket{le="..."}
+                    # merge series labels into the bucket lines
                     head, rest = line.split("{", 1)
                     extra = ",".join(f'{k}="{v}"'
                                      for k, v in sorted(labels.items()))

@@ -156,6 +156,11 @@ class TPUMountService:
                                                 is_entire_mount, txn_id,
                                                 request_id, trace=trace)
             result_name = outcome.result.name
+        except MountPolicyError:
+            # a routine, expected denial (gRPC FAILED_PRECONDITION) — not
+            # the "worker blew up" signal EXCEPTION must keep meaning
+            result_name = "POLICY_DENIED"
+            raise
         finally:
             # emitted on failure too — the phase breakdown of an attach
             # that threw is when the decomposition matters most; the result

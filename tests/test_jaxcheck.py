@@ -373,3 +373,58 @@ def test_measure_train_perf_smoke_cpu():
     assert report["step_ms_incl_sync"] > 0
     assert report["model_tflops_per_step"] > 0
     assert report["mfu"] is None          # CPU: no published bf16 peak
+
+
+def test_transient_backend_error_classifier():
+    """Tunnel/transport flakes retry; capacity results never do (an OOM is
+    a *finding* about the measured config, not a flake)."""
+    from gpumounter_tpu.jaxcheck.perf import is_transient_backend_error
+    transient = [
+        RuntimeError("INTERNAL: http://127.0.0.1:8103/remote_compile: "
+                     "read body: response body closed before all bytes "
+                     "were read"),
+        RuntimeError("UNAVAILABLE: connection reset by peer"),
+        RuntimeError("Deadline Exceeded while awaiting response"),
+    ]
+    findings = [
+        RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating HBM"),
+        RuntimeError("Resource exhausted: HBM space for score temps"),
+        # transport wording + OOM wording: capacity wins
+        RuntimeError("remote_compile failed: out of memory"),
+        AssertionError("bad loss nan"),
+    ]
+    assert all(is_transient_backend_error(e) for e in transient)
+    assert not any(is_transient_backend_error(e) for e in findings)
+
+
+def test_measure_with_retry_retries_only_transient():
+    from gpumounter_tpu.jaxcheck.perf import measure_with_retry
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("UNAVAILABLE: connection reset")
+        return "ok"
+
+    assert measure_with_retry(flaky, attempts=3, backoff_s=0.0) == "ok"
+    assert calls["n"] == 3
+
+    def oom():
+        calls["n"] += 1
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    calls["n"] = 0
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        measure_with_retry(oom, attempts=3, backoff_s=0.0)
+    assert calls["n"] == 1                # no retry on a capacity finding
+
+    def always_flaky():
+        calls["n"] += 1
+        raise RuntimeError("deadline exceeded")
+
+    calls["n"] = 0
+    with _pytest.raises(RuntimeError, match="deadline"):
+        measure_with_retry(always_flaky, attempts=2, backoff_s=0.0)
+    assert calls["n"] == 2                # bounded

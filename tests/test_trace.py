@@ -111,3 +111,23 @@ def test_phase_histograms_render_on_metrics_endpoint(rig):
     text = REGISTRY.render_text()
     assert "tpumounter_attach_phase_seconds_bucket" in text
     assert 'phase="allocate"' in text
+
+
+def test_policy_denial_counts_as_policy_denied_not_exception(rig):
+    from gpumounter_tpu.utils.errors import MountPolicyError
+    rig.service.add_tpu("workload", "default", 4, True)
+    before = REGISTRY.attach_results.value(result="POLICY_DENIED")
+    before_exc = REGISTRY.attach_results.value(result="EXCEPTION")
+    with pytest.raises(MountPolicyError):
+        rig.service.add_tpu("workload", "default", 1, False)
+    assert REGISTRY.attach_results.value(
+        result="POLICY_DENIED") == before + 1
+    assert REGISTRY.attach_results.value(result="EXCEPTION") == before_exc
+
+
+def test_labeled_histogram_labelless_series_renders_plain():
+    hist = LabeledHistogram("z_seconds", "test", buckets=(1.0,))
+    hist.observe(0.5)                    # no labels
+    text = "\n".join(hist.render())
+    assert 'z_seconds_bucket{le="1"} 1' in text
+    assert "{," not in text              # no malformed leading comma

@@ -103,8 +103,13 @@ _TRANSIENT_SIGNATURES = ("remote_compile", "response body closed",
                          "read body", "unavailable", "connection reset",
                          "deadline exceeded", "socket closed",
                          "broken pipe")
+# Bare "memory" is deliberately over-broad: an allocator message like
+# "exceeds memory limit" is a capacity finding even without the OOM
+# spellings, and the guard's contract is that capacity results are NEVER
+# retried — a transient error mentioning memory fails fast instead of
+# retrying, which is the safe direction.
 _OOM_SIGNATURES = ("resource_exhausted", "resource exhausted",
-                   "out of memory", "hbm")
+                   "out of memory", "memory", "hbm")
 
 
 def is_transient_backend_error(e: Exception) -> bool:

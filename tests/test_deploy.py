@@ -126,3 +126,92 @@ def test_deploy_sh_is_executable_and_covers_manifests():
         assert f"deploy/{name}" in content, f"{name} missing from deploy.sh"
     rc = subprocess.run(["bash", "-n", path])
     assert rc.returncode == 0
+
+
+# -- observability pack (deploy/observability/) -------------------------------
+
+def _registry_metric_names():
+    """Every metric family name the binaries export, from a fresh render
+    (family headers render even with no series recorded)."""
+    import re
+    from gpumounter_tpu.utils.metrics import Registry
+    text = Registry().render_text()
+    return set(re.findall(r"^# TYPE (\S+)", text, re.M))
+
+
+def _referenced_metrics(expr_text):
+    """Metric names referenced in PromQL, with histogram suffixes folded
+    back to the family name."""
+    import re
+    names = set()
+    for tok in re.findall(r"\btpumounter_[a-z0-9_]+", expr_text):
+        for suffix in ("_bucket", "_count", "_sum"):
+            if tok.endswith(suffix):
+                tok = tok[: -len(suffix)]
+                break
+        names.add(tok)
+    return names
+
+
+def test_grafana_dashboard_metrics_exist_in_code():
+    import json
+    with open(os.path.join(REPO, "deploy", "observability",
+                           "grafana-dashboard.json")) as f:
+        dash = json.load(f)
+    exported = _registry_metric_names()
+    exprs = [t["expr"] for p in dash["panels"]
+             for t in p.get("targets", [])]
+    assert exprs, "dashboard has no queries"
+    for expr in exprs:
+        refs = _referenced_metrics(expr)
+        assert refs, f"no tpumounter metric in {expr!r}"
+        missing = refs - exported
+        assert not missing, f"dashboard references unexported {missing}"
+
+
+def test_grafana_dashboard_panel_hygiene():
+    """One axis per panel (no dual-axis overrides) and phase/result
+    identity carried by legend labels, not color alone."""
+    import json
+    with open(os.path.join(REPO, "deploy", "observability",
+                           "grafana-dashboard.json")) as f:
+        dash = json.load(f)
+    for panel in dash["panels"]:
+        for target in panel.get("targets", []):
+            if "by (le, phase)" in target["expr"]:
+                assert "{{phase}}" in target.get("legendFormat", "")
+            if "by (result)" in target["expr"]:
+                assert "{{result}}" in target.get("legendFormat", "")
+        # no per-series axis placement overrides = single axis
+        overrides = panel.get("fieldConfig", {}).get("overrides", [])
+        assert not any("axisPlacement" in str(o) for o in overrides), \
+            panel["title"]
+
+
+def test_prometheus_rules_parse_and_reference_real_metrics():
+    with open(os.path.join(REPO, "deploy", "observability",
+                           "prometheus-rules.yaml")) as f:
+        doc = yaml.safe_load(f)
+    rules = [r for g in doc["groups"] for r in g["rules"]]
+    assert len(rules) >= 5
+    exported = _registry_metric_names()
+    for rule in rules:
+        assert "alert" in rule and "expr" in rule
+        assert rule["annotations"]["summary"]
+        refs = _referenced_metrics(rule["expr"])
+        assert refs, f"no tpumounter metric in {rule['alert']}"
+        missing = refs - exported
+        assert not missing, \
+            f"{rule['alert']} references unexported {missing}"
+
+
+def test_rules_exception_label_matches_service_semantics():
+    """The EXCEPTION/POLICY_DENIED split the alerts rely on is the one the
+    worker actually emits (service.py add_tpu finally block)."""
+    with open(os.path.join(REPO, "deploy", "observability",
+                           "prometheus-rules.yaml")) as f:
+        text = f.read()
+    assert 'result="EXCEPTION"' in text
+    src = open(os.path.join(REPO, "gpumounter_tpu", "worker",
+                            "service.py")).read()
+    assert '"EXCEPTION"' in src and '"POLICY_DENIED"' in src

@@ -103,18 +103,20 @@ _TRANSIENT_SIGNATURES = ("remote_compile", "response body closed",
                          "read body", "unavailable", "connection reset",
                          "deadline exceeded", "socket closed",
                          "broken pipe")
-# Bare "memory" is deliberately over-broad: an allocator message like
-# "exceeds memory limit" is a capacity finding even without the OOM
-# spellings, and the guard's contract is that capacity results are NEVER
-# retried — a transient error mentioning memory fails fast instead of
-# retrying, which is the safe direction.
+# Used to LABEL rows as "OOM" (an acceptable non-result — the capacity
+# wall IS the pallas advantage), so it must stay narrow: a crash that
+# merely mentions memory ("failed to map memory region") is an error, not
+# a capacity finding, and must render as err:... to stay falsifiable.
 _OOM_SIGNATURES = ("resource_exhausted", "resource exhausted",
-                   "out of memory", "memory", "hbm")
+                   "out of memory", "memory limit", "hbm")
 
 
 def is_transient_backend_error(e: Exception) -> bool:
     msg = str(e).lower()
-    if any(s in msg for s in _OOM_SIGNATURES):
+    # The retry guard is broader than the row labeler: ANY mention of
+    # memory fails fast rather than retrying — never retry something that
+    # might be a capacity result, even when it wouldn't label as OOM.
+    if "memory" in msg or any(s in msg for s in _OOM_SIGNATURES):
         return False
     return any(s in msg for s in _TRANSIENT_SIGNATURES)
 

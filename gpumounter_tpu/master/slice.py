@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import dataclasses
+import time
 import uuid as uuid_mod
 
 from gpumounter_tpu.allocator import topology
@@ -29,6 +30,7 @@ from gpumounter_tpu.utils.errors import (K8sApiError, PodNotFoundError,
                                          TopologyError)
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
+from gpumounter_tpu.utils.trace import Trace
 
 logger = get_logger("master.slice")
 
@@ -40,6 +42,7 @@ class PodResult:
     result: str
     device_ids: list[str] = dataclasses.field(default_factory=list)
     message: str = ""
+    elapsed_ms: float = 0.0
 
     def to_json(self) -> dict:
         out = {"namespace": self.namespace, "pod": self.pod,
@@ -48,6 +51,11 @@ class PodResult:
             out["device_ids"] = self.device_ids
         if self.message:
             out["message"] = self.message
+        if self.elapsed_ms:
+            # per-host worker round-trip: the slice's slowest host sets the
+            # transaction's wall time, so the straggler is identifiable
+            # from the response alone
+            out["elapsed_ms"] = round(self.elapsed_ms, 1)
         return out
 
 
@@ -87,33 +95,56 @@ class SliceCoordinator:
         two pods sharing a host, or a per-host chip count that isn't the
         hosts' whole-host size).
         """
-        self.validate_slice_topology(pods, tpus_per_host)
-        txn_id = "txn-" + uuid_mod.uuid4().hex[:12]
-        results = self._fan_out(
-            pods,
-            lambda ns, name: self._attach_one(ns, name, tpus_per_host,
-                                              request_id, txn_id))
-        ok = all(r.result == "SUCCESS" for r in results)
-        rollback_clean = True
-        if not ok:
-            logger.warning("slice %s attach failed; rolling back %d hosts",
-                           txn_id, len(pods))
-            rollback = self._fan_out(
-                pods,
-                lambda ns, name: self._detach_one(
-                    ns, name, force=True, txn_id=txn_id,
-                    request_id=request_id))
-            for r in rollback:
-                if r.result not in ("SUCCESS", "TPU_NOT_FOUND",
-                                    "POD_NOT_FOUND"):
-                    rollback_clean = False
-                    logger.error("slice rollback left %s/%s attached: %s",
-                                 r.namespace, r.pod, r.message)
+        trace = Trace("slice_attach", request_id or "-")
+        result_name = "EXCEPTION"
+        try:
+            with trace.span("validate"):
+                self.validate_slice_topology(pods, tpus_per_host)
+            txn_id = "txn-" + uuid_mod.uuid4().hex[:12]
+            with trace.span("fanout"):
+                results = self._fan_out(
+                    pods,
+                    lambda ns, name: self._attach_one(
+                        ns, name, tpus_per_host, request_id, txn_id))
+            ok = all(r.result == "SUCCESS" for r in results)
+            rollback_clean = True
+            if not ok:
+                logger.warning(
+                    "slice %s attach failed; rolling back %d hosts",
+                    txn_id, len(pods))
+                with trace.span("rollback"):
+                    rollback = self._fan_out(
+                        pods,
+                        lambda ns, name: self._detach_one(
+                            ns, name, force=True, txn_id=txn_id,
+                            request_id=request_id))
+                for r in rollback:
+                    if r.result not in ("SUCCESS", "TPU_NOT_FOUND",
+                                        "POD_NOT_FOUND"):
+                        rollback_clean = False
+                        logger.error(
+                            "slice rollback left %s/%s attached: %s",
+                            r.namespace, r.pod, r.message)
+            slowest = max(results, key=lambda r: r.elapsed_ms, default=None)
+            if slowest is not None and slowest.elapsed_ms:
+                logger.info("slice %s straggler: %s/%s at %.1fms", txn_id,
+                            slowest.namespace, slowest.pod,
+                            slowest.elapsed_ms)
+            result_name = "SUCCESS" if ok else "FAILED"
+        finally:
+            # In a finally, like the worker's (service.py add_tpu): a
+            # TopologyError from validate still emits the trace. The spans
+            # feed the shared attach_phase family — the master's /metrics
+            # then exposes phase="rollback" for slice-level rollbacks, so
+            # the TPUMounterRollbacks alert sees multi-host rollbacks, not
+            # just single-host actuation failures.
+            trace.finish(result_name, REGISTRY.attach_phase)
         return ok, results, rollback_clean
 
     def _attach_one(self, namespace: str, pod: str, tpu_num: int,
                     request_id: str | None = None,
                     txn_id: str = "") -> PodResult:
+        t0 = time.monotonic()
         try:
             resp = self.gateway._call_worker(
                 namespace, pod,
@@ -124,6 +155,7 @@ class SliceCoordinator:
                             device_ids=list(resp.device_ids))
         except Exception as e:
             out = PodResult(namespace, pod, "ERROR", message=str(e))
+        out.elapsed_ms = (time.monotonic() - t0) * 1e3
         REGISTRY.attach_results.inc(result=f"slice_{out.result}")
         return out
 
@@ -213,6 +245,7 @@ class SliceCoordinator:
                     uuids: list[str] | None = None,
                     request_id: str | None = None,
                     txn_id: str = "") -> PodResult:
+        t0 = time.monotonic()
         try:
             resp = self.gateway._call_worker(
                 namespace, pod,
@@ -223,6 +256,7 @@ class SliceCoordinator:
             out = PodResult(namespace, pod, result.name)
         except Exception as e:
             out = PodResult(namespace, pod, "ERROR", message=str(e))
+        out.elapsed_ms = (time.monotonic() - t0) * 1e3
         REGISTRY.detach_results.inc(result=f"slice_{out.result}")
         return out
 

@@ -245,7 +245,8 @@ class Registry:
         self.attach_phase = LabeledHistogram(
             "tpumounter_attach_phase_seconds",
             "AddTPU latency by phase "
-            "(policy/allocate/resolve/actuate; rollback on mount failure)")
+            "(worker: policy/allocate/resolve/actuate, rollback on mount "
+            "failure; master slice txns: validate/fanout/rollback)")
         self.detach_phase = LabeledHistogram(
             "tpumounter_detach_phase_seconds",
             "RemoveTPU latency by phase (resolve/actuate/cleanup)")

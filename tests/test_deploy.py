@@ -215,3 +215,16 @@ def test_rules_exception_label_matches_service_semantics():
     src = open(os.path.join(REPO, "gpumounter_tpu", "worker",
                             "service.py")).read()
     assert '"EXCEPTION"' in src and '"POLICY_DENIED"' in src
+
+
+def test_grafana_dashboard_panels_use_datasource_variable():
+    """The datasource dropdown must actually steer every panel."""
+    import json
+    with open(os.path.join(REPO, "deploy", "observability",
+                           "grafana-dashboard.json")) as f:
+        dash = json.load(f)
+    names = [v["name"] for v in dash["templating"]["list"]]
+    assert "datasource" in names
+    for panel in dash["panels"]:
+        assert panel.get("datasource", {}).get("uid") == "${datasource}", \
+            panel["title"]

@@ -119,3 +119,35 @@ def test_slice_rollback_preserves_preexisting_mounts(stack):
     # rollback removed node-0's new chips but NOT node-1's earlier mount
     assert stack.rigs[0].sim.slave_pods() == []
     assert len(stack.rigs[1].sim.slave_pods()) == 1
+
+
+def test_slice_results_carry_per_host_elapsed(stack):
+    """Straggler identification: every per-pod result reports its worker
+    round-trip, so the host that set the transaction's wall time is
+    visible from the response alone."""
+    status, body = _post(f"{stack.base}/addtpuslice", SLICE)
+    assert status == 200
+    for entry in body["pods"]:
+        assert entry["elapsed_ms"] > 0
+    status, body = _post(f"{stack.base}/removetpuslice",
+                         {"pods": SLICE["pods"]})
+    assert status == 200
+    for entry in body["pods"]:
+        assert entry["elapsed_ms"] > 0
+
+
+def test_slice_rollback_feeds_rollback_phase_metric(stack):
+    """Multi-host rollbacks must be visible to the TPUMounterRollbacks
+    alert: the slice trace feeds phase="rollback" into the attach_phase
+    family on the master's registry."""
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    before = REGISTRY.attach_phase.count(phase="rollback")
+    urllib.request.urlopen(
+        f"{stack.base}/addtpu/namespace/default/pod/workload-1/tpu/4"
+        "/isEntireMount/true")                      # exhaust node-1
+    status, _ = _post(f"{stack.base}/addtpuslice", SLICE)
+    assert status == 503
+    assert REGISTRY.attach_phase.count(phase="rollback") == before + 1
+    # slice span phases recorded too
+    assert REGISTRY.attach_phase.count(phase="fanout") >= 1
+    assert REGISTRY.attach_phase.count(phase="validate") >= 1

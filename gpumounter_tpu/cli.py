@@ -22,6 +22,7 @@ Usage (``python -m gpumounter_tpu.cli`` or the ``tpumounterctl`` entry):
     tpumounterctl slice add    -p ns/pod-a -p ns/pod-b --tpus-per-host 4
     tpumounterctl slice remove -p ns/pod-a -p ns/pod-b --force
     tpumounterctl health
+    tpumounterctl doctor [--node my-tpu-node]
 
 The master address comes from ``--master`` or ``$TPU_MOUNTER_MASTER``
 (default ``http://127.0.0.1:8080`` — matching a
@@ -244,6 +245,229 @@ def cmd_health(args) -> int:
                    f"master {args.master}: {payload.get('status')}")
 
 
+# -- doctor -------------------------------------------------------------------
+
+def _fetch_text(master: str, path: str, timeout: float) -> str:
+    url = master.rstrip("/") + path
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.read().decode()
+    except (urllib.error.URLError, TimeoutError, OSError) as e:
+        raise TransportError(f"GET {url}: {e}") from e
+
+
+def _parse_exposition(text: str) -> dict:
+    """Minimal parser for the registry's own text exposition: returns
+    {metric_name: {frozen label tuple: value}} for non-comment lines."""
+    out: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        head, _, value = line.rpartition(" ")
+        name, labels = head, {}
+        if "{" in head:
+            name, _, rest = head.partition("{")
+            for part in rest.rstrip("}").split(","):
+                if "=" in part:
+                    k, _, v = part.partition("=")
+                    labels[k] = v.strip('"')
+        try:
+            out.setdefault(name, {})[tuple(sorted(labels.items()))] = \
+                float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def _histogram_quantile(metrics: dict, family: str, q: float,
+                        **match: str) -> float | None:
+    """Bucket-interpolated quantile (the promql histogram_quantile
+    estimate) over the matching series of ``<family>_bucket``."""
+    buckets: dict[float, float] = {}
+    for labels, value in metrics.get(f"{family}_bucket", {}).items():
+        d = dict(labels)
+        if any(d.get(k) != v for k, v in match.items()):
+            continue
+        le = d.get("le", "")
+        bound = float("inf") if le == "+Inf" else float(le)
+        buckets[bound] = buckets.get(bound, 0.0) + value
+    if not buckets:
+        return None
+    total = buckets.get(float("inf"), 0.0)
+    if total <= 0:
+        return None
+    target = q * total
+    prev_bound, prev_count = 0.0, 0.0
+    for bound in sorted(buckets):
+        count = buckets[bound]
+        if count >= target:
+            if bound == float("inf"):
+                return prev_bound
+            if count == prev_count:
+                return bound
+            frac = (target - prev_count) / (count - prev_count)
+            return prev_bound + frac * (bound - prev_bound)
+        prev_bound, prev_count = bound, count
+    return prev_bound
+
+
+def _counter_total(metrics: dict, family: str, **match: str) -> float:
+    return sum(value for labels, value in metrics.get(family, {}).items()
+               if all(dict(labels).get(k) == v for k, v in match.items()))
+
+
+EXIT_DOCTOR_CRIT = 12   # NOT 2 — argparse owns 2 for usage errors, and a
+                        # cron wrapper's typo must never page as a CRIT
+
+
+def cmd_doctor(args) -> int:
+    """One-shot cluster diagnosis from the target's own surfaces: liveness,
+    error counters, latency vs the 3s baseline, rollbacks, and (with
+    --node) the node's chip inventory. Exit 0 = healthy, 1 = warnings,
+    12 = critical. Error counters are cumulative, so without --window they
+    can only WARN; liveness/node failures, and windowed error activity,
+    are what CRIT.
+
+    Metric scope honesty: master and workers are separate processes with
+    separate registries. Against the master (the default), the error/
+    latency checks see the master's own counters (master_*/slice_* result
+    labels, slice-level rollback spans); the worker-local families
+    (attach_seconds, bare EXCEPTION, actuation rollbacks) live on each
+    node's :1201 — point --master at a worker's metrics port to audit one
+    node, and doctor says which view it found rather than reporting a
+    blind 'all clear'. The reference had no equivalent — its runbook was
+    'read the worker logs'."""
+    checks: list[tuple[str, str]] = []     # (level, message)
+
+    def check(level: str, message: str) -> None:
+        checks.append((level, message))
+
+    def finish() -> int:
+        worst = max(({"ok": 0, "warn": 1, "crit": 2}[lvl]
+                     for lvl, _ in checks), default=0)
+        rc = {0: 0, 1: 1, 2: EXIT_DOCTOR_CRIT}[worst]
+        if getattr(args, "json", False):
+            print(json.dumps({"checks": [
+                {"level": lvl, "message": msg} for lvl, msg in checks],
+                "worst": ["ok", "warn", "crit"][worst],
+                "exit_code": rc}, indent=2))
+        else:
+            icon = {"ok": "OK  ", "warn": "WARN", "crit": "CRIT"}
+            for level, message in checks:
+                print(f"{icon[level]} {message}")
+        return rc
+
+    try:
+        # lenient parse: the master's /healthz is JSON, a worker's :1201
+        # sidecar answers plain "ok" — doctor audits either
+        raw = _fetch_text(args.master, "/healthz", args.timeout).strip()
+        try:
+            status_str = json.loads(raw).get("status")
+        except (json.JSONDecodeError, AttributeError):
+            status_str = raw[:40]
+        check("ok", f"master reachable, status={status_str}")
+    except TransportError as e:
+        check("crit", f"master unreachable: {e}")
+        return finish()
+
+    try:
+        metrics = _parse_exposition(
+            _fetch_text(args.master, "/metrics", args.timeout))
+    except TransportError as e:
+        check("warn", f"/metrics unreadable: {e}")
+        metrics = {}
+
+    # Counters are cumulative since process start: a snapshot cannot
+    # distinguish one historical incident from an ongoing one, so lifetime
+    # totals may only WARN (a latched CRIT would page forever for a
+    # long-resolved event). --window N scrapes again after N seconds and
+    # diffs — activity inside the window is current and may CRIT, same
+    # semantics as the shipped increase[10m] alert rules.
+    window = getattr(args, "window", 0.0) or 0.0
+    if metrics and window > 0:
+        time.sleep(window)
+        try:
+            later = _parse_exposition(
+                _fetch_text(args.master, "/metrics", args.timeout))
+            metrics_delta = {
+                fam: {labels: value - metrics.get(fam, {}).get(labels, 0.0)
+                      for labels, value in series.items()}
+                for fam, series in later.items()}
+        except TransportError as e:
+            check("warn", f"second /metrics scrape failed: {e}")
+            window, metrics_delta = 0.0, None
+    else:
+        metrics_delta = None
+
+    if metrics:
+        src = metrics_delta if metrics_delta is not None else metrics
+        scope = (f"in the last {window:g}s" if metrics_delta is not None
+                 else "lifetime (use --window N for a current-activity "
+                      "check)")
+        # worker-local label (present when pointed at a worker's :1201 or
+        # an in-process stack) + the failures the master itself records
+        exceptions = (_counter_total(src, "tpumounter_attach_total",
+                                     result="EXCEPTION")
+                      + _counter_total(src, "tpumounter_detach_total",
+                                       result="EXCEPTION"))
+        slice_errors = (_counter_total(src, "tpumounter_attach_total",
+                                       result="slice_ERROR")
+                        + _counter_total(src, "tpumounter_detach_total",
+                                         result="slice_ERROR"))
+        bad = exceptions or slice_errors
+        check(("crit" if metrics_delta is not None else "warn") if bad
+              else "ok",
+              f"exceptions: {int(exceptions)} worker-local, "
+              f"{int(slice_errors)} slice transaction — {scope}")
+        rollbacks = _counter_total(
+            src, "tpumounter_attach_phase_seconds_count", phase="rollback")
+        check("warn" if rollbacks else "ok",
+              f"attach rollbacks: {int(rollbacks)} — {scope}")
+        attaches = _counter_total(metrics, "tpumounter_attach_seconds_count")
+        master_attaches = sum(
+            value for labels, value in
+            metrics.get("tpumounter_attach_total", {}).items()
+            if dict(labels).get("result", "").startswith("master_"))
+        if attaches:
+            p95 = _histogram_quantile(metrics, "tpumounter_attach_seconds",
+                                      0.95)
+            if p95 is None:
+                check("warn", f"{int(attaches)} attach(es) recorded but "
+                              "the latency histogram is unreadable")
+            else:
+                slow = p95 > 3.0
+                check("warn" if slow else "ok",
+                      f"attach p95 ~{p95:.2f}s over {int(attaches)} "
+                      f"attach(es) (baseline < 3s)"
+                      f"{' — inspect the phase panel' if slow else ''}")
+        elif master_attaches:
+            check("ok",
+                  f"{int(master_attaches)} attach(es) routed by this "
+                  "master; latency histograms live on each worker's :1201 "
+                  "(point --master there to audit a node)")
+        else:
+            check("ok", "no attaches recorded yet")
+
+    if getattr(args, "node", None):
+        try:
+            _, payload = _request(
+                args.master, "GET",
+                f"/nodestatus/node/{urllib.parse.quote(args.node)}",
+                timeout=args.timeout)
+        except TransportError as e:
+            check("crit", f"node {args.node}: inventory unreadable: {e}")
+            return finish()
+        if "free" in payload:
+            free, total = payload.get("free"), payload.get("total")
+            check("warn" if not free else "ok",
+                  f"node {args.node}: {free}/{total} chips free")
+        else:
+            check("crit", f"node {args.node}: {payload.get('result')}: "
+                          f"{payload.get('message', '')}")
+
+    return finish()
+
+
 def _add_common(p: argparse.ArgumentParser, suppress: bool) -> None:
     """--master/--json/--timeout work both before AND after the subcommand
     (operators type `tpumounterctl health --master ...`). Subparsers get
@@ -319,6 +543,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("health", help="master liveness")
     p.set_defaults(fn=cmd_health)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser(
+        "doctor",
+        help="one-shot diagnosis: liveness, errors, latency, rollbacks")
+    p.add_argument("--node", default=None,
+                   help="also check this node's chip inventory")
+    p.add_argument("--window", type=float, default=0.0, metavar="SECONDS",
+                   help="scrape twice this many seconds apart and judge "
+                        "only activity inside the window (counters are "
+                        "lifetime totals otherwise, which can only WARN)")
+    p.set_defaults(fn=cmd_doctor)
     _add_common(p, suppress=True)
     return parser
 

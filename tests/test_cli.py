@@ -178,3 +178,87 @@ def test_slice_remove_retry_converges(fake_host, tmp_path, monkeypatch):
         assert rc == 0 and "SUCCESS" in out     # converged, not 409
     finally:
         stack.close()
+
+
+def test_doctor_healthy_stack(live_stack):
+    _, base = live_stack
+    run_cli(base, "add", "workload", "--tpus", "2")
+    rc, out = run_cli(base, "doctor", "--node", "node-a")
+    assert rc == 0, out
+    assert "master reachable" in out
+    assert "exceptions: 0 worker-local, 0 slice transaction" in out
+    assert "attach rollbacks: 0" in out
+    assert "attach p95" in out
+    assert "chips free" in out
+    # --json emits the machine-readable check list like other subcommands
+    rc, out = run_cli(base, "--json", "doctor")
+    assert rc == 0
+    payload = json.loads(out)
+    assert payload["worst"] == "ok"
+    assert any("master reachable" in c["message"]
+               for c in payload["checks"])
+
+
+def test_doctor_flags_node_exhaustion_and_bad_node(live_stack):
+    _, base = live_stack
+    run_cli(base, "add", "workload", "--tpus", "4", "--entire")
+    rc, out = run_cli(base, "doctor", "--node", "node-a")
+    assert rc == 1                       # 0 free chips -> WARN
+    assert "0/4 chips free" in out
+    rc, out = run_cli(base, "doctor", "--node", "ghost-node")
+    assert rc == cli.EXIT_DOCTOR_CRIT    # unknown node -> CRIT (12, not
+    assert "NodeNotFound" in out         # argparse's 2)
+
+
+def test_doctor_unreachable_master_is_crit():
+    rc, out = run_cli("http://127.0.0.1:1", "--timeout", "1", "doctor")
+    assert rc == cli.EXIT_DOCTOR_CRIT
+    assert "master unreachable" in out
+
+
+def test_histogram_quantile_estimator():
+    metrics = cli._parse_exposition("\n".join([
+        'h_bucket{le="0.1"} 50',
+        'h_bucket{le="1"} 90',
+        'h_bucket{le="+Inf"} 100',
+        "h_sum 40",
+        "h_count 100",
+    ]))
+    p50 = cli._histogram_quantile(metrics, "h", 0.50)
+    assert p50 == pytest.approx(0.1)     # 50th obs sits at the 0.1 bound
+    p95 = cli._histogram_quantile(metrics, "h", 0.95)
+    assert 0.1 < p95 <= 1.0              # interpolated inside (0.1, 1]
+    # quantile beyond the last finite bucket clamps to it
+    p999 = cli._histogram_quantile(metrics, "h", 0.999)
+    assert p999 == pytest.approx(1.0)
+    assert cli._histogram_quantile(metrics, "absent", 0.5) is None
+
+
+def test_parse_exposition_labels_and_values():
+    m = cli._parse_exposition("\n".join([
+        "# HELP x help",
+        "# TYPE x counter",
+        'x{result="SUCCESS"} 3',
+        'x{result="EXCEPTION"} 1',
+        "y 2.5",
+    ]))
+    assert cli._counter_total(m, "x") == 4
+    assert cli._counter_total(m, "x", result="EXCEPTION") == 1
+    assert m["y"][()] == 2.5
+
+
+def test_doctor_lifetime_counters_warn_not_crit(live_stack):
+    """A historical exception must not page forever: lifetime totals WARN;
+    only windowed (current) activity may CRIT."""
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    _, base = live_stack
+    REGISTRY.attach_results.inc(result="EXCEPTION")
+    rc, out = run_cli(base, "doctor")
+    assert rc == 1, out                  # WARN, not EXIT_DOCTOR_CRIT
+    assert "1 worker-local" in out
+    assert "lifetime" in out
+    # windowed: no NEW exceptions inside the window -> healthy
+    rc, out = run_cli(base, "doctor", "--window", "0.2")
+    assert rc == 0, out
+    assert "exceptions: 0 worker-local" in out
+    assert "in the last 0.2s" in out

@@ -423,6 +423,12 @@ def cmd_doctor(args) -> int:
             src, "tpumounter_attach_phase_seconds_count", phase="rollback")
         check("warn" if rollbacks else "ok",
               f"attach rollbacks: {int(rollbacks)} — {scope}")
+        orphans = _counter_total(src, "tpumounter_orphans_reclaimed_total")
+        # worker-local family (the reconciler runs per node); fresh reclaims
+        # inside a window mean workloads are dying mid-hold right now
+        check("warn" if (metrics_delta is not None and orphans) else "ok",
+              f"orphaned slave pods reclaimed: {int(orphans)} worker-local "
+              f"— {scope}")
         attaches = _counter_total(metrics, "tpumounter_attach_seconds_count")
         master_attaches = sum(
             value for labels, value in

@@ -242,6 +242,11 @@ class Registry:
             "tpumounter_node_chips",
             "Chips on this node by allocation state "
             "(refreshed on every collector snapshot)")
+        self.orphans_reclaimed = Counter(
+            "tpumounter_orphans_reclaimed_total",
+            "Orphaned slave pods deleted by the reconciler (their owner "
+            "pod vanished while holding chips — normal GC, but a rising "
+            "rate means workloads die mid-hold)")
         self.attach_phase = LabeledHistogram(
             "tpumounter_attach_phase_seconds",
             "AddTPU latency by phase "
@@ -255,7 +260,8 @@ class Registry:
         lines: list[str] = []
         for metric in (self.attach_latency, self.detach_latency,
                        self.attach_results, self.detach_results,
-                       self.chips, self.attach_phase, self.detach_phase):
+                       self.chips, self.orphans_reclaimed,
+                       self.attach_phase, self.detach_phase):
             lines.extend(metric.render())
         return "\n".join(lines) + "\n"
 

@@ -29,6 +29,7 @@ from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.config import Settings
 from gpumounter_tpu.utils.errors import K8sApiError, PodNotFoundError
 from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
 
 logger = get_logger("worker.reconciler")
 
@@ -103,6 +104,7 @@ class OrphanReconciler:
             try:
                 self.kube.delete_pod(self.settings.pool_namespace, name)
                 deleted.append(name)
+                REGISTRY.orphans_reclaimed.inc()
             except K8sApiError as e:
                 logger.warning("delete orphan %s failed: %s", name, e)
         return deleted

@@ -12,16 +12,20 @@ from tests.helpers import WorkerRig
 
 
 def test_orphan_deleted_when_owner_gone(fake_host):
+    from gpumounter_tpu.utils.metrics import REGISTRY
     rig = WorkerRig(fake_host)
     rig.service.add_tpu("workload", "default", 2, False)
     assert len(rig.sim.slave_pods()) == 2
 
+    before = REGISTRY.orphans_reclaimed.value()
     rig.sim.kube.delete_pod("default", "workload")
     reconciler = OrphanReconciler(rig.sim.kube, rig.sim.settings)
     deleted = reconciler.scan_once()
     assert len(deleted) == 2
     assert rig.sim.slave_pods() == []
     assert rig.sim.podresources.assignments == {}    # chips released
+    # GC is observable: the reclaim counter moved with the deletions
+    assert REGISTRY.orphans_reclaimed.value() == before + 2
 
 
 def test_orphan_deleted_when_owner_terminal(fake_host):

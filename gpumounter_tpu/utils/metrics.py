@@ -247,6 +247,12 @@ class Registry:
             "Orphaned slave pods deleted by the reconciler (their owner "
             "pod vanished while holding chips — normal GC, but a rising "
             "rate means workloads die mid-hold)")
+        # Seed the labelless series at 0 so a sample exists from process
+        # start: without a prior 0, Prometheus increase() extrapolates from
+        # the first observed value and misses each process's FIRST reclaim
+        # (the labeled result counters can't be pre-seeded — their label
+        # values are open-ended — but this one can).
+        self.orphans_reclaimed.inc(0.0)
         self.attach_phase = LabeledHistogram(
             "tpumounter_attach_phase_seconds",
             "AddTPU latency by phase "

@@ -131,3 +131,19 @@ def test_labeled_histogram_labelless_series_renders_plain():
     text = "\n".join(hist.render())
     assert 'z_seconds_bucket{le="1"} 1' in text
     assert "{," not in text              # no malformed leading comma
+
+
+def test_failed_mount_records_rollback_span(rig):
+    """The span that matters most: an actuation failure's trace carries
+    rollback timing, and the rollback phase histogram (which the
+    TPUMounterRollbacks alert watches) moves."""
+    from gpumounter_tpu.utils.errors import ActuationError
+    before = _counts(REGISTRY.attach_phase)
+    rig.actuator.fail_on_create = True
+    with pytest.raises(ActuationError):
+        rig.service.add_tpu("workload", "default", 2, False)
+    after = _counts(REGISTRY.attach_phase)
+    assert after.get("rollback", 0) == before.get("rollback", 0) + 1
+    # the phases that ran before the failure are recorded too
+    for phase in ("policy", "allocate", "actuate"):
+        assert after.get(phase, 0) == before.get(phase, 0) + 1, phase

@@ -189,6 +189,8 @@ def test_doctor_healthy_stack(live_stack):
     run_cli(base, "add", "workload", "--tpus", "2")
     dirty = (REGISTRY.attach_results.value(result="EXCEPTION")
              + REGISTRY.detach_results.value(result="EXCEPTION")
+             + REGISTRY.attach_results.value(result="slice_ERROR")
+             + REGISTRY.detach_results.value(result="slice_ERROR")
              + REGISTRY.attach_phase.count(phase="rollback")) > 0
     rc, out = run_cli(base, "doctor", "--node", "node-a")
     assert rc == (1 if dirty else 0), out

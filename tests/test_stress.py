@@ -4,7 +4,9 @@ contention — no chip double-grant, exact scheduler accounting, no leaked
 slave pods after failures — complementing the same-pod serialization tests
 in test_idempotency.py."""
 
+import os
 import threading
+import time
 
 import pytest
 
@@ -107,3 +109,47 @@ def test_contention_exact_accounting(grpc_rig):
     holders = {p["metadata"]["labels"][consts.OWNER_POD_LABEL_KEY]
                for p in rig.sim.slave_pods()}
     assert holders == set(winners)
+
+
+@pytest.mark.skipif(not os.path.isdir("/proc/self/fd"),
+                    reason="needs procfs for fd counting")
+def test_no_fd_thread_or_lock_leak_over_many_cycles(grpc_rig):
+    """The worker is a months-lived daemon: every attach/detach cycle must
+    return the process to baseline. Catches leaked sockets/pipes (open
+    fds), orphaned threads, and growth in the per-request/per-pod lock
+    tables and the event queue."""
+    rig, client = grpc_rig
+    _add_pods(rig, ["soak"])
+
+    def cycle(i):
+        resp = client.add_tpu("soak", "default", 2, False,
+                              request_id=f"soak-{i}")
+        assert resp.result == int(consts.AddResult.SUCCESS)
+        out = client.remove_tpu("soak", "default",
+                                list(resp.device_ids), False)
+        assert out.result == int(consts.RemoveResult.SUCCESS)
+
+    for i in range(5):                       # warm-up: lazy inits allocate
+        cycle(i)
+    fds_before = len(os.listdir("/proc/self/fd"))
+    threads_before = threading.active_count()
+
+    for i in range(5, 35):
+        cycle(i)
+
+    fds_after = len(os.listdir("/proc/self/fd"))
+    threads_after = threading.active_count()
+    # small tolerance: the event worker thread and a gRPC poller may spin
+    # up lazily, but growth must not scale with cycle count
+    assert fds_after - fds_before <= 3, (fds_before, fds_after)
+    assert threads_after - threads_before <= 2, (threads_before,
+                                                 threads_after)
+    # refcounted lock tables drain to empty when no request is in flight
+    assert rig.service._request_locks._entries == {}
+    assert rig.service._pod_locks._entries == {}
+    # bounded event queue drains (nothing stuck waiting on the apiserver);
+    # the drain is async off the RPC path, so poll briefly
+    deadline = time.monotonic() + 5.0
+    while rig.service._event_queue and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert len(rig.service._event_queue) == 0

@@ -1,5 +1,6 @@
 """Slave-pod allocation layer (scheduler integration)."""
 
-from gpumounter_tpu.allocator.allocator import TPUAllocator
+from gpumounter_tpu.allocator.allocator import (AllocationStats,
+                                                TPUAllocator)
 
-__all__ = ["TPUAllocator"]
+__all__ = ["AllocationStats", "TPUAllocator"]

@@ -28,6 +28,7 @@ Deliberate deltas from the reference (SURVEY.md §7/§8):
 
 from __future__ import annotations
 
+import dataclasses
 import math
 import secrets
 import time
@@ -61,6 +62,17 @@ def is_unschedulable(pod: objects.Pod) -> bool:
     cond = _scheduled_condition(pod)
     return bool(cond and cond.get("status") == "False"
                 and cond.get("reason") == "Unschedulable")
+
+
+@dataclasses.dataclass
+class AllocationStats:
+    """Out-param of :meth:`TPUAllocator.get_available_tpus`: where each
+    slave pod came from, so the service can surface warm-pool hit/miss
+    without the allocator changing its return contract."""
+
+    warm_adopted: int = 0       # claimed pre-scheduled from the warm pool
+    cold_created: int = 0       # created + waited through the scheduler
+    resumed: int = 0            # re-adopted from a prior same-request try
 
 
 class TPUAllocator:
@@ -97,6 +109,52 @@ class TPUAllocator:
         labels.update(extra_labels or {})
         if txn_id:
             labels[consts.TXN_LABEL_KEY] = txn_id
+        return self._slave_pod_spec(pod_name, objects.node_name(owner),
+                                    tpu_num, labels,
+                                    self.owner_references(owner))
+
+    def owner_references(self, owner: objects.Pod) -> list[dict]:
+        """ownerReferences stamping a slave pod as GC'd with its owner
+        (ref allocator.go:204-213) — single source for the cold create
+        path AND warm-pod adoption, so the policy cannot diverge.
+        Cross-namespace ownerRefs are not honoured by the k8s GC, so this
+        only takes effect when the pool namespace equals the owner's; the
+        explicit delete path is the primary cleanup either way."""
+        if objects.namespace(owner) != self.settings.pool_namespace:
+            return []
+        return [{
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "name": objects.name(owner),
+            "uid": objects.uid(owner),
+            "blockOwnerDeletion": False,
+            "controller": False,
+        }]
+
+    def new_warm_slave_pod(self, node_name: str, tpu_num: int,
+                           entire: bool) -> objects.Pod:
+        """An UNOWNED slave pod for the warm pool: same scheduler path and
+        chip request as an owned slave pod (accounting stays honest), but
+        no owner labels and no ownerReference — adoption patches those in
+        later (worker/pool.py)."""
+        mount_type = (consts.MountType.ENTIRE if entire
+                      else consts.MountType.SINGLE)
+        pod_name = (consts.WARM_POD_NAME_PREFIX + consts.SLAVE_POD_INFIX
+                    + secrets.token_hex(3))
+        labels = {
+            consts.SLAVE_POD_LABEL_KEY: consts.SLAVE_POD_LABEL_VALUE,
+            consts.WARM_POD_LABEL_KEY: consts.WARM_POD_LABEL_VALUE,
+            consts.MOUNT_TYPE_LABEL_KEY: mount_type.value,
+        }
+        if node_name:
+            # node as a LABEL too (nodeSelector can't be label-selected):
+            # lets the pool LIST only its own node's warm pods server-side
+            labels[consts.WARM_POD_NODE_LABEL_KEY] = node_name
+        return self._slave_pod_spec(pod_name, node_name, tpu_num, labels, [])
+
+    def _slave_pod_spec(self, pod_name: str, node_name: str, tpu_num: int,
+                        labels: dict[str, str],
+                        owner_refs: list[dict]) -> objects.Pod:
         return {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -104,24 +162,12 @@ class TPUAllocator:
                 "name": pod_name,
                 "namespace": self.settings.pool_namespace,
                 "labels": labels,
-                # GC with the owner (ref allocator.go:204-213). Cross-namespace
-                # ownerRefs are not honoured by the k8s GC, so this only takes
-                # effect when the pool namespace equals the owner's; the
-                # explicit delete path is the primary cleanup either way.
-                "ownerReferences": [{
-                    "apiVersion": "v1",
-                    "kind": "Pod",
-                    "name": owner_name,
-                    "uid": objects.uid(owner),
-                    "blockOwnerDeletion": False,
-                    "controller": False,
-                }] if objects.namespace(owner) ==
-                self.settings.pool_namespace else [],
+                "ownerReferences": owner_refs,
             },
             "spec": {
-                # Pin to the owner's node (ref allocator.go:229-231).
+                # Pin to the target node (ref allocator.go:229-231).
                 "nodeSelector": {
-                    "kubernetes.io/hostname": objects.node_name(owner),
+                    "kubernetes.io/hostname": node_name,
                 },
                 "restartPolicy": "Never",
                 "tolerations": [{
@@ -149,7 +195,10 @@ class TPUAllocator:
             tpus_per_pod: int,
             txn_id: str = "",
             request_id: str = "",
-            adopt: set[str] | None = None) -> tuple[list[TPUChip], list[str]]:
+            adopt: set[str] | None = None,
+            pool=None,
+            stats: AllocationStats | None = None
+    ) -> tuple[list[TPUChip], list[str]]:
         """Allocate ``total_tpus`` chips on the owner's node via slave pods of
         ``tpus_per_pod`` chips each. Returns (chips, slave_pod_names).
 
@@ -168,6 +217,16 @@ class TPUAllocator:
         prior attempt may have fully mounted them into the workload (reply
         lost), and deleting that reservation would free chips that are
         still in use — the reconciler owns genuinely-orphaned pods.
+
+        ``pool`` (a :class:`~gpumounter_tpu.worker.pool.PoolManager`) lets
+        the shortfall be satisfied by *adopting* pre-scheduled warm pods
+        before falling back to create+wait: a full pool hit skips the
+        scheduler wait entirely (no ``_wait_running``) because claimed
+        pods were verified Running at claim time by the label patch's
+        resourceVersion precondition. Warm-claimed pods ARE this call's to
+        clean up on failure — unlike request-id-adopted ones, nothing
+        mounted them yet. ``stats`` is filled with the warm/cold/resumed
+        split when provided.
         """
         entire = tpus_per_pod > 1
         # Topology-aware validation (SURVEY.md §7 hard part 3): an entire
@@ -188,29 +247,45 @@ class TPUAllocator:
         if adopted:
             logger.info("request %s: adopting %d existing slave pods %s",
                         request_id, len(adopted), adopted)
+        warm: list[str] = []
         fresh: list[str] = []
         created = list(adopted)
         try:
-            for _ in range(max(0, num_pods - len(adopted))):
+            shortfall = max(0, num_pods - len(adopted))
+            if pool is not None and shortfall:
+                warm = pool.claim(owner, tpus_per_pod, entire, shortfall,
+                                  txn_id=txn_id, request_id=request_id,
+                                  extra_labels=extra_labels)
+                created.extend(warm)
+                shortfall -= len(warm)
+            for _ in range(shortfall):
                 spec = self.new_slave_pod(owner, tpus_per_pod, entire,
                                           txn_id=txn_id,
                                           extra_labels=extra_labels)
                 self.kube.create_pod(self.settings.pool_namespace, spec)
                 fresh.append(objects.name(spec))
                 created.append(objects.name(spec))
-            self._wait_running(created)
+            # Warm pods were Running when claimed (the rv-guarded patch
+            # proved the observed state was current); only resumed and
+            # cold-created pods still need the scheduler state machine.
+            if adopted or fresh:
+                self._wait_running(adopted + fresh)
         except (InsufficientTPUError, AllocationTimeoutError, K8sApiError):
             logger.warning("allocation failed; cleaning up slave pods %s "
                            "(adopted pods %s left for the reconciler/retry)",
-                           fresh, adopted)
-            self.delete_slave_pods(fresh, wait=False)
+                           fresh + warm, adopted)
+            self.delete_slave_pods(fresh + warm, wait=False)
             raise
+        if stats is not None:
+            stats.warm_adopted = len(warm)
+            stats.cold_created = len(fresh)
+            stats.resumed = len(adopted)
 
         # Which chips did each slave pod actually get? Ground truth is the
         # kubelet PodResources API (ref allocator.go:84-97 → collector).
         per_pod_chips, lagging = self._pods_chips_with_lag_retry(created)
         if lagging:
-            self.delete_slave_pods(fresh, wait=False)
+            self.delete_slave_pods(fresh + warm, wait=False)
             raise InsufficientTPUError(
                 f"slave pod(s) {sorted(lagging)} are Running but kubelet "
                 f"reports no {self.settings.resource_name} devices for them "
@@ -417,9 +492,14 @@ class TPUAllocator:
         in_scope = {objects.name(p) for p in slaves
                     if not txn_id
                     or objects.labels(p).get(consts.TXN_LABEL_KEY) == txn_id}
+        # Exact-name resolution via the owner labels, never the
+        # <owner>-slave-pod- name-prefix convention: adopted warm-pool
+        # pods keep their warm-* name, so prefix matching would silently
+        # make their chips non-removable.
         removable = {
             c.uuid: c
-            for c in self.collector.get_pod_tpu_resources(owner_name, "")
+            for c in self.collector.get_pod_tpu_resources_exact(
+                owner_name, "", in_scope)
             if c.namespace == self.settings.pool_namespace
             and c.pod_name in in_scope}
         wanted = list(uuids) or list(removable)

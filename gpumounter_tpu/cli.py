@@ -257,23 +257,34 @@ def _fetch_text(master: str, path: str, timeout: float) -> str:
 
 
 def _parse_exposition(text: str) -> dict:
-    """Minimal parser for the registry's own text exposition: returns
-    {metric_name: {frozen label tuple: value}} for non-comment lines."""
+    """Minimal parser for Prometheus text exposition: returns
+    {metric_name: {frozen label tuple: value}} for non-comment lines.
+    Handles the standard optional trailing timestamp
+    (``name{labels} value timestamp_ms``) — the value is the FIRST token
+    after the name/labels, not the last (rpartition took the timestamp as
+    the value when doctor was pointed at a non-registry endpoint)."""
     out: dict = {}
     for line in text.splitlines():
+        line = line.strip()
         if not line or line.startswith("#"):
             continue
-        head, _, value = line.rpartition(" ")
-        name, labels = head, {}
-        if "{" in head:
-            name, _, rest = head.partition("{")
-            for part in rest.rstrip("}").split(","):
+        labels = {}
+        if "{" in line:
+            name, _, rest = line.partition("{")
+            labelstr, _, tail = rest.rpartition("}")
+            for part in labelstr.split(","):
                 if "=" in part:
                     k, _, v = part.partition("=")
                     labels[k] = v.strip('"')
+            fields = tail.split()
+        else:
+            fields = line.split()
+            name, fields = fields[0], fields[1:]
+        if not fields:
+            continue
         try:
             out.setdefault(name, {})[tuple(sorted(labels.items()))] = \
-                float(value)
+                float(fields[0])
         except ValueError:
             continue
     return out
@@ -393,6 +404,21 @@ def cmd_doctor(args) -> int:
                 fam: {labels: value - metrics.get(fam, {}).get(labels, 0.0)
                       for labels, value in series.items()}
                 for fam, series in later.items()}
+            # A lower second sample in a COUNTER family means the counter
+            # reset — the process restarted between scrapes. The deltas
+            # are then meaningless (negative counts would print, and a
+            # negative-but-truthy exceptions delta would page CRIT for a
+            # mere restart): fall back to lifetime/WARN semantics and say
+            # why. Gauges (chip counts, warm-pool size) go down in normal
+            # operation and must not trip this.
+            if any(v < 0 for fam, series in metrics_delta.items()
+                   if fam.endswith(("_total", "_count", "_bucket", "_sum"))
+                   for v in series.values()):
+                check("warn",
+                      f"counter reset inside the {window:g}s window "
+                      "(target restarted?) — judging lifetime totals "
+                      "instead")
+                window, metrics_delta = 0.0, None
         except TransportError as e:
             check("warn", f"second /metrics scrape failed: {e}")
             window, metrics_delta = 0.0, None
@@ -429,13 +455,17 @@ def cmd_doctor(args) -> int:
         check("warn" if (metrics_delta is not None and orphans) else "ok",
               f"orphaned slave pods reclaimed: {int(orphans)} worker-local "
               f"— {scope}")
-        attaches = _counter_total(metrics, "tpumounter_attach_seconds_count")
+        # Windowed mode diffs the _bucket/_count series like the counter
+        # checks above (a histogram delta is itself a valid histogram), so
+        # the p95 judges CURRENT latency; lifetime mode says so in the
+        # message instead of presenting an all-time figure as current.
+        attaches = _counter_total(src, "tpumounter_attach_seconds_count")
         master_attaches = sum(
             value for labels, value in
             metrics.get("tpumounter_attach_total", {}).items()
             if dict(labels).get("result", "").startswith("master_"))
         if attaches:
-            p95 = _histogram_quantile(metrics, "tpumounter_attach_seconds",
+            p95 = _histogram_quantile(src, "tpumounter_attach_seconds",
                                       0.95)
             if p95 is None:
                 check("warn", f"{int(attaches)} attach(es) recorded but "
@@ -444,7 +474,7 @@ def cmd_doctor(args) -> int:
                 slow = p95 > 3.0
                 check("warn" if slow else "ok",
                       f"attach p95 ~{p95:.2f}s over {int(attaches)} "
-                      f"attach(es) (baseline < 3s)"
+                      f"attach(es) (baseline < 3s) — {scope}"
                       f"{' — inspect the phase panel' if slow else ''}")
         elif master_attaches:
             check("ok",
@@ -452,7 +482,7 @@ def cmd_doctor(args) -> int:
                   "master; latency histograms live on each worker's :1201 "
                   "(point --master there to audit a node)")
         else:
-            check("ok", "no attaches recorded yet")
+            check("ok", f"no attaches recorded — {scope}")
 
     if getattr(args, "node", None):
         try:

@@ -127,33 +127,18 @@ class TPUCollector:
                     if c.state is DeviceState.ALLOCATED
                     and c.pod_name == pod_name and c.namespace == namespace]
 
-    def get_pod_tpu_resources(self, pod_name: str, namespace: str,
-                              refresh: bool = True) -> list[TPUChip]:
-        """Chips of the pod PLUS its slave pods (ref GetPodGPUResources,
-        collector.go:149-163: slave pods matched by the
-        ``<pod>-slave-pod-`` name prefix in the pool namespace)."""
-        if refresh:
-            self.update_status()
-        prefix = pod_name + consts.SLAVE_POD_INFIX
-        with self._lock:
-            out = []
-            for c in self._chips.values():
-                if c.state is not DeviceState.ALLOCATED:
-                    continue
-                if c.pod_name == pod_name and c.namespace == namespace:
-                    out.append(c)
-                elif (c.namespace == self.pool_namespace
-                      and c.pod_name.startswith(prefix)):
-                    out.append(c)
-            return out
+    # The reference's name-PREFIX slave matching (GetPodGPUResources,
+    # collector.go:149-163: ``<pod>-slave-pod-``) is deliberately NOT
+    # offered here: it conflates same-named owners across namespaces, and
+    # adopted warm-pool pods keep their warm-* names, so prefix matching
+    # silently loses their chips. Resolution goes through owner labels
+    # (allocator.slave_pod_names) into the exact-name method below.
 
     def get_pod_tpu_resources_exact(
             self, pod_name: str, namespace: str,
             slave_names: set[str], refresh: bool = True) -> list[TPUChip]:
-        """Like :meth:`get_pod_tpu_resources`, but slave pods are given by
-        exact name (resolved from owner labels by the allocator) instead of
-        the name-prefix convention — immune to same-named owners in other
-        namespaces sharing the node."""
+        """Chips of the pod PLUS its slave pods, the latter given by exact
+        name (resolved from owner labels by the allocator)."""
         if refresh:
             self.update_status()
         with self._lock:

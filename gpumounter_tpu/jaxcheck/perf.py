@@ -130,6 +130,10 @@ def measure_with_retry(fn, attempts: int = 3, backoff_s: float = 5.0):
     whole hardware-evidence section not-ok. Non-transient errors (OOM,
     assertion, anything about the measured computation itself) raise
     immediately."""
+    if attempts < 1:
+        # an empty retry loop would silently return None and crash the
+        # caller with a confusing TypeError far from the cause
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
     for attempt in range(attempts):
         try:
             return fn()

@@ -75,8 +75,28 @@ class KubeClient(abc.ABC):
 
     @abc.abstractmethod
     def delete_pod(self, namespace: str, name: str,
-                   grace_period_seconds: int = 0) -> None:
-        """404s are swallowed — deleting an already-gone pod is success."""
+                   grace_period_seconds: int = 0,
+                   resource_version: str | None = None) -> None:
+        """404s are swallowed — deleting an already-gone pod is success.
+
+        ``resource_version`` is a DeleteOptions precondition: the delete
+        only lands if the live object still has that version, else 409
+        (:class:`K8sApiError`). The warm-pool trim uses this so a delete
+        decided on a stale LIST cannot kill a pod an attach adopted in
+        between."""
+
+    @abc.abstractmethod
+    def patch_pod(self, namespace: str, name: str, patch: dict[str, Any],
+                  resource_version: str | None = None) -> objects.Pod:
+        """JSON merge-patch (RFC 7386: null deletes a key) the pod and
+        return the updated object. ``resource_version`` is an optimistic-
+        concurrency precondition: the patch carries
+        ``metadata.resourceVersion`` and the apiserver answers 409 Conflict
+        when the live object has moved on — the warm-pool adoption race is
+        decided by exactly this (two claimers patch the same observed
+        version; one wins, the other gets 409 and tries the next pod).
+        Raises :class:`PodNotFoundError` on 404, :class:`K8sApiError`
+        (status 409) on a lost precondition."""
 
     @abc.abstractmethod
     def watch_pods(self, namespace: str, label_selector: str | None = None,
@@ -122,7 +142,8 @@ class RestKubeClient(KubeClient):
     def _request(self, method: str, path: str,
                  query: dict[str, str] | None = None,
                  body: dict[str, Any] | None = None,
-                 stream: bool = False, timeout: float = 30.0):
+                 stream: bool = False, timeout: float = 30.0,
+                 content_type: str = "application/json"):
         url = self.base + path
         if query:
             url += "?" + urllib.parse.urlencode(query)
@@ -130,7 +151,7 @@ class RestKubeClient(KubeClient):
         req = urllib.request.Request(url, data=data, method=method)
         req.add_header("Accept", "application/json")
         if data is not None:
-            req.add_header("Content-Type", "application/json")
+            req.add_header("Content-Type", content_type)
         tok = self._token()
         if tok:
             req.add_header("Authorization", f"Bearer {tok}")
@@ -178,14 +199,33 @@ class RestKubeClient(KubeClient):
                              body=pod)
 
     def delete_pod(self, namespace: str, name: str,
-                   grace_period_seconds: int = 0) -> None:
+                   grace_period_seconds: int = 0,
+                   resource_version: str | None = None) -> None:
+        body: dict[str, Any] = {"gracePeriodSeconds": grace_period_seconds}
+        if resource_version is not None:
+            body["preconditions"] = {"resourceVersion": resource_version}
         try:
             self._request(
                 "DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}",
-                body={"gracePeriodSeconds": grace_period_seconds})
+                body=body)
         except K8sApiError as e:
             if e.status != 404:
                 raise
+
+    def patch_pod(self, namespace: str, name: str, patch: dict[str, Any],
+                  resource_version: str | None = None) -> objects.Pod:
+        if resource_version is not None:
+            meta = dict(patch.get("metadata") or {})
+            meta["resourceVersion"] = resource_version
+            patch = {**patch, "metadata": meta}
+        try:
+            return self._request(
+                "PATCH", f"/api/v1/namespaces/{namespace}/pods/{name}",
+                body=patch, content_type="application/merge-patch+json")
+        except K8sApiError as e:
+            if e.status == 404:
+                raise PodNotFoundError(namespace, name) from None
+            raise
 
     def get_node(self, name: str) -> dict[str, Any]:
         return self._request("GET", f"/api/v1/nodes/{name}")
@@ -455,6 +495,18 @@ def default_kube_client() -> KubeClient:
 # -- test fake -----------------------------------------------------------------
 
 
+def _json_merge_patch(target: dict, patch: dict) -> None:
+    """RFC 7386 merge patch, in place: dicts merge recursively, ``None``
+    deletes the key, everything else replaces."""
+    for key, value in patch.items():
+        if value is None:
+            target.pop(key, None)
+        elif isinstance(value, dict) and isinstance(target.get(key), dict):
+            _json_merge_patch(target[key], value)
+        else:
+            target[key] = value
+
+
 def _match_label_selector(pod: objects.Pod, selector: str | None) -> bool:
     if not selector:
         return True
@@ -527,11 +579,12 @@ class FakeKubeClient(KubeClient):
             self._record("MODIFIED", pod)
 
     def _record(self, event_type: str, pod: objects.Pod) -> None:
-        copy = json.loads(json.dumps(pod))
         # Event index is the resourceVersion: monotonically increasing,
-        # stamped on the event object like a real apiserver.
-        copy.setdefault("metadata", {})["resourceVersion"] = \
+        # stamped on the STORED object too (like a real apiserver) so
+        # get/list return versions that patch preconditions can cite.
+        pod.setdefault("metadata", {})["resourceVersion"] = \
             str(len(self._events) + 1)
+        copy = json.loads(json.dumps(pod))
         self._events.append((event_type, copy))
         self._lock.notify_all()
 
@@ -576,7 +629,8 @@ class FakeKubeClient(KubeClient):
         return json.loads(json.dumps(pod))
 
     def delete_pod(self, namespace: str, name: str,
-                   grace_period_seconds: int = 0) -> None:
+                   grace_period_seconds: int = 0,
+                   resource_version: str | None = None) -> None:
         def _remove():
             with self._lock:
                 pod = self._pods.pop((namespace, name), None)
@@ -586,6 +640,16 @@ class FakeKubeClient(KubeClient):
                 for hook in list(self.on_delete):
                     hook(pod)
         with self._lock:
+            if resource_version is not None:
+                pod = self._pods.get((namespace, name))
+                if pod is not None:
+                    live_rv = pod.get("metadata", {}).get(
+                        "resourceVersion", "")
+                    if live_rv != resource_version:
+                        raise K8sApiError(
+                            409, f"Precondition failed: pod {name!r} is at "
+                                 f"{live_rv}, delete expected "
+                                 f"{resource_version}")
             self.deleted.append((namespace, name))
         if self.delete_latency_s > 0:
             t = threading.Timer(self.delete_latency_s, _remove)
@@ -593,6 +657,25 @@ class FakeKubeClient(KubeClient):
             t.start()
         else:
             _remove()
+
+    def patch_pod(self, namespace: str, name: str, patch: dict[str, Any],
+                  resource_version: str | None = None) -> objects.Pod:
+        patch = json.loads(json.dumps(patch))
+        # the precondition is consumed here, not merged into the object
+        patch.get("metadata", {}).pop("resourceVersion", None)
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                raise PodNotFoundError(namespace, name)
+            live_rv = pod.get("metadata", {}).get("resourceVersion", "")
+            if resource_version is not None and live_rv != resource_version:
+                raise K8sApiError(
+                    409, f"Operation cannot be fulfilled on pods "
+                         f"{name!r}: the object has been modified "
+                         f"(have {live_rv}, precondition {resource_version})")
+            _json_merge_patch(pod, patch)
+            self._record("MODIFIED", pod)
+            return json.loads(json.dumps(pod))
 
     def watch_pods(self, namespace: str, label_selector: str | None = None,
                    field_selector: str | None = None,

@@ -49,6 +49,13 @@ def is_running(pod: Pod) -> bool:
     return phase(pod) == "Running"
 
 
+def is_terminal(pod: Pod) -> bool:
+    """Succeeded/Failed — the one lifecycle rule shared by the orphan
+    reconciler and the warm pool, so they can never drift on what counts
+    as a dead pod."""
+    return phase(pod) in ("Succeeded", "Failed")
+
+
 def container_ids(pod: Pod) -> list[str]:
     """Raw containerID strings, e.g. ``containerd://<64hex>`` (GKE default)
     or ``docker://<64hex>`` — the reference only handled docker

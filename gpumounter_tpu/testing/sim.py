@@ -213,7 +213,7 @@ class WorkerRig:
     def __init__(self, fake_host, n_chips=4, pid=4242, actuator="recording",
                  use_kubelet_socket=False, node="node-a",
                  pod_name="workload", schedule_delay_s=0.0,
-                 kubelet_lag_s=0.0):
+                 kubelet_lag_s=0.0, warm_pool: dict[str, int] | None = None):
         from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
         from gpumounter_tpu.actuation.mount import TPUMounter
         from gpumounter_tpu.actuation.nsenter import (ProcRootActuator,
@@ -256,8 +256,19 @@ class WorkerRig:
                                   self.sim.enumerator, fake_host)
         self.allocator = TPUAllocator(self.sim.collector, self.sim.kube,
                                       self.sim.settings)
+        # Warm pool (worker/pool.py): ``warm_pool={"entire:4": 1}`` keeps
+        # one 4-chip entire-mount slave pod pre-scheduled. The loop is NOT
+        # started — tests/bench drive scan_once() for determinism.
+        self.pool = None
+        if warm_pool:
+            from gpumounter_tpu.worker.pool import PoolManager
+            self.sim.settings.warm_pool_sizes = dict(warm_pool)
+            self.sim.settings.warm_pool_enabled = True
+            self.pool = PoolManager(self.allocator, self.sim.kube,
+                                    self.sim.settings)
         self.service = TPUMountService(self.allocator, self.mounter,
-                                       self.sim.kube, self.sim.settings)
+                                       self.sim.kube, self.sim.settings,
+                                       pool=self.pool)
 
     def provision_container(self, pod: objects.Pod,
                             pid: int | None = None) -> dict[str, int]:
@@ -279,6 +290,21 @@ class WorkerRig:
             out[cid] = next_pid
             next_pid += 1
         return out
+
+    def fill_warm_pool(self, timeout_s: float = 30.0) -> None:
+        """Drive pool reconciliation until every configured key holds its
+        target count of Running (adoptable) warm pods."""
+        assert self.pool is not None, "rig built without warm_pool="
+        deadline = time.monotonic() + timeout_s
+        while True:
+            self.pool.scan_once()
+            status = self.pool.status()
+            if all(v["running"] >= v["target"]
+                   for v in status["keys"].values()):
+                return
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"warm pool not filled: {status}")
+            time.sleep(0.05)
 
     def close(self) -> None:
         self.sim.close()

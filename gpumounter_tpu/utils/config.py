@@ -32,6 +32,28 @@ class HostPaths:
     kubelet_socket: str = consts.KUBELET_SOCKET_PATH
 
 
+def parse_warm_pool_sizes(spec: str) -> dict[str, int]:
+    """``"entire:4=1,single:1=2"`` -> {"entire:4": 1, "single:1": 2}.
+    Raises ValueError on malformed entries — a typo'd pool spec must fail
+    the boot, not silently run with no pool."""
+    sizes: dict[str, int] = {}
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        key, sep, count = entry.partition("=")
+        mount, csep, chips = key.partition(":")
+        if (not sep or not csep or mount not in ("entire", "single")
+                or not chips.isdigit() or int(chips) < 1
+                or not count.isdigit()):
+            raise ValueError(
+                f"bad warm-pool entry {entry!r}: want "
+                "'entire:<chips>=<count>' or 'single:1=<count>'")
+        if mount == "single" and int(chips) != 1:
+            raise ValueError(
+                f"bad warm-pool entry {entry!r}: single-mount slave pods "
+                "hold exactly 1 chip")
+        sizes[f"{mount}:{int(chips)}"] = int(count)
+    return {k: v for k, v in sizes.items() if v > 0}
+
+
 @dataclasses.dataclass
 class Settings:
     pool_namespace: str = consts.DEFAULT_POOL_NAMESPACE
@@ -56,6 +78,19 @@ class Settings:
     # Accept regular files as chips (BASELINE config 1 / process-level boot
     # tests on CPU-only hosts). Never set in the shipped DaemonSet.
     allow_fake_devices: bool = False
+    # Warm slave-pod pool (worker/pool.py): how many pre-scheduled unowned
+    # slave pods to keep warm per pool key ("entire:4" = one 4-chip
+    # entire-mount pod). Empty dict = pool disabled; warm_pool_enabled can
+    # additionally force it off without losing the sizing config. Warm pods
+    # go through the normal scheduler path, so node accounting stays honest
+    # — the pool only moves the scheduling wait off the attach critical
+    # path.
+    warm_pool_sizes: dict[str, int] = dataclasses.field(default_factory=dict)
+    warm_pool_enabled: bool = False
+    # Background refill/trim cadence; adoption also kicks the loop
+    # immediately, so this mainly bounds how long a crashed warm pod or a
+    # resize stays unreconciled.
+    warm_pool_interval_s: float = 10.0
     host: HostPaths = dataclasses.field(default_factory=HostPaths)
 
     @classmethod
@@ -76,6 +111,11 @@ class Settings:
         if t := env.get("TPU_KUBELET_LAG_TIMEOUT_S"):
             s.kubelet_lag_timeout_s = float(t)
         s.allow_fake_devices = env.get("TPU_ALLOW_FAKE_DEVICES") == "1"
+        s.warm_pool_sizes = parse_warm_pool_sizes(
+            env.get(consts.ENV_WARM_POOL, ""))
+        s.warm_pool_enabled = bool(s.warm_pool_sizes)
+        if t := env.get(consts.ENV_WARM_POOL_INTERVAL_S):
+            s.warm_pool_interval_s = float(t)
         if p := env.get("TPU_WORKER_GRPC_PORT"):
             s.worker_grpc_port = int(p)
         if p := env.get("TPU_MASTER_HTTP_PORT"):

@@ -58,6 +58,21 @@ TXN_LABEL_KEY = "tpumounter.io/txn-id"
 # instead of allocating a second set — idempotence keyed on cluster state,
 # which survives worker restarts (an in-memory dedupe cache would not).
 REQUEST_ID_LABEL_KEY = "tpumounter.io/request-id"
+# Warm slave pods: pre-scheduled, UNOWNED by design (no owner labels until
+# an AddTPU adopts one by patching ownership in and this label out). The
+# label is the pool membership marker — the reconciler exempts carriers
+# from orphan GC, and adoption's label-removal patch is what atomically
+# takes a pod out of the pool (resourceVersion-guarded, so two claimers
+# cannot both win).
+WARM_POD_LABEL_KEY = "tpumounter.io/warm"
+WARM_POD_LABEL_VALUE = "true"
+# Warm pods have no owner to derive a name from; this prefix + the usual
+# slave infix keeps them recognisable in `kubectl get pods`.
+WARM_POD_NAME_PREFIX = "warm"
+# Node pinning as a LABEL (the nodeSelector spec field cannot be
+# label-selected): lets each worker's pool LIST only its own node's warm
+# pods server-side instead of fetching the whole fleet's and filtering.
+WARM_POD_NODE_LABEL_KEY = "tpumounter.io/node"
 SLAVE_POD_IMAGE = "registry.k8s.io/pause:3.9"
 
 # --- Environment variables (ref: CGROUP_DRIVER cgroup.go:78, GPU_POOL_NAMESPACE
@@ -65,6 +80,11 @@ SLAVE_POD_IMAGE = "registry.k8s.io/pause:3.9"
 ENV_POOL_NAMESPACE = "TPU_POOL_NAMESPACE"
 DEFAULT_POOL_NAMESPACE = "tpu-pool"
 ENV_CGROUP_DRIVER = "CGROUP_DRIVER"
+# Warm-pool sizing, e.g. "entire:4=1,single:1=2" — keep one 4-chip
+# entire-mount pod and two 1-chip single-mount pods warm per node. Empty /
+# unset = pool disabled (exactly today's cold-path behavior).
+ENV_WARM_POOL = "TPU_WARM_POOL"
+ENV_WARM_POOL_INTERVAL_S = "TPU_WARM_POOL_INTERVAL_S"
 
 # --- Ports (ref: master main.go:235 :8080; worker main.go:24 :1200) -----------
 MASTER_HTTP_PORT = 8080

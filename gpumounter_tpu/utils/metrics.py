@@ -253,6 +253,26 @@ class Registry:
         # (the labeled result counters can't be pre-seeded — their label
         # values are open-ended — but this one can).
         self.orphans_reclaimed.inc(0.0)
+        # Warm-pool effectiveness (worker/pool.py): hits = slave pods
+        # adopted from the pool (attach skipped the scheduler wait),
+        # misses = pods the attach had to cold-create with a pool enabled.
+        # hit_rate = hits / (hits + misses); a low rate means the pool is
+        # undersized for the attach mix (or refill can't keep up).
+        self.pool_hits = Counter(
+            "tpumounter_pool_hits_total",
+            "Slave pods adopted from the warm pool by AddTPU")
+        self.pool_misses = Counter(
+            "tpumounter_pool_misses_total",
+            "Slave pods cold-created by AddTPU despite an enabled pool")
+        self.pool_hits.inc(0.0)      # pre-seed: see orphans_reclaimed
+        self.pool_misses.inc(0.0)
+        self.warm_pool_size = Gauge(
+            "tpumounter_warm_pool_size",
+            "Adoptable (Running, unowned) warm slave pods by pool key")
+        self.pool_refill_latency = Histogram(
+            "tpumounter_pool_refill_seconds",
+            "Warm-pod creation to Running (the scheduler cost the pool "
+            "pays off the attach critical path)")
         self.attach_phase = LabeledHistogram(
             "tpumounter_attach_phase_seconds",
             "AddTPU latency by phase "
@@ -267,6 +287,8 @@ class Registry:
         for metric in (self.attach_latency, self.detach_latency,
                        self.attach_results, self.detach_results,
                        self.chips, self.orphans_reclaimed,
+                       self.pool_hits, self.pool_misses,
+                       self.warm_pool_size, self.pool_refill_latency,
                        self.attach_phase, self.detach_phase):
             lines.extend(metric.render())
         return "\n".join(lines) + "\n"

@@ -34,6 +34,7 @@ HEALTH_PORT_OFFSET = 1  # health on grpc_port + 1 (1201 by default)
 
 class _HealthHandler(BaseHTTPRequestHandler):
     ready = False
+    pool = None        # PoolManager, set by main() when the pool is enabled
 
     def log_message(self, *args):
         pass
@@ -42,6 +43,14 @@ class _HealthHandler(BaseHTTPRequestHandler):
         if self.path == "/metrics":
             body = REGISTRY.render_text().encode()
             ctype = "text/plain; version=0.0.4"
+            code = 200
+        elif self.path == "/poolz":
+            # warm-pool introspection: targets vs live counts, hit/miss
+            import json
+            pool = type(self).pool
+            body = json.dumps(pool.status() if pool is not None
+                              else {"enabled": False}).encode()
+            ctype = "application/json"
             code = 200
         elif self.path in ("/healthz", "/readyz"):
             ok = type(self).ready or self.path == "/healthz"
@@ -96,6 +105,14 @@ def main() -> None:
     service = build_stack(settings)
     from gpumounter_tpu.worker.reconciler import OrphanReconciler
     reconciler = OrphanReconciler(service.kube, settings).start()
+    pool = None
+    if settings.warm_pool_enabled:
+        from gpumounter_tpu.worker.pool import PoolManager
+        pool = PoolManager(service.allocator, service.kube,
+                           settings).start()
+        service.pool = pool
+        _HealthHandler.pool = pool
+        logger.info("warm pool enabled: %s", settings.warm_pool_sizes)
     tls = load_tls_config()
     if tls:
         logger.info("worker gRPC TLS enabled (mTLS=%s)",
@@ -108,6 +125,8 @@ def main() -> None:
     try:
         server.wait_for_termination()
     finally:
+        if pool is not None:
+            pool.stop()
         reconciler.stop()
         health.shutdown()
 

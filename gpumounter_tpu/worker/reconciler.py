@@ -13,6 +13,13 @@ actuation rollback is needed — the owner's container is gone, taking its
 cgroup and mount namespace with it; deleting the slave pod releases the
 scheduler accounting, which is the part that outlives the owner.
 
+Warm-pool pods (worker/pool.py) are unowned BY DESIGN and must not be
+treated as orphans: carriers of the warm label are exempt from the
+owner-liveness check. They are still GC'd here when genuinely stale — a
+terminal phase (the pause container exited), or the pool being disabled on
+this worker (nothing maintains them any more, so they would silently hold
+chips forever). A live pool trims its own excess; this is the backstop.
+
 State is re-derived from the cluster on every pass (owner labels stamped at
 creation + pod liveness), so the reconciler is restart-safe with no local
 persistence — the same ground-truth-re-derivation property SURVEY.md §5
@@ -32,8 +39,6 @@ from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 
 logger = get_logger("worker.reconciler")
-
-_TERMINAL_PHASES = ("Succeeded", "Failed")
 
 
 class OrphanReconciler:
@@ -56,6 +61,14 @@ class OrphanReconciler:
         return selector.get("kubernetes.io/hostname") == \
             self.settings.node_name
 
+    def _warm_pod_stale(self, slave: objects.Pod) -> bool:
+        """A warm pod is stale when its holder exited (terminal phase) or
+        no pool maintains it (disabled on this worker) — either way it is
+        dead scheduler accounting that would otherwise live forever."""
+        if objects.is_terminal(slave):
+            return True
+        return not self.settings.warm_pool_enabled
+
     def _owner_alive(self, slave: objects.Pod) -> bool:
         labels = objects.labels(slave)
         owner = labels.get(consts.OWNER_POD_LABEL_KEY)
@@ -72,7 +85,7 @@ class OrphanReconciler:
         owner_uid = labels.get(consts.OWNER_UID_LABEL_KEY)
         if owner_uid and objects.uid(pod) != owner_uid:
             return False
-        return objects.phase(pod) not in _TERMINAL_PHASES
+        return not objects.is_terminal(pod)
 
     def scan_once(self) -> list[str]:
         """Delete orphaned slave pods; returns their names."""
@@ -87,6 +100,27 @@ class OrphanReconciler:
         deleted = []
         for slave in slaves:
             if not self._is_ours(slave):
+                continue
+            if objects.labels(slave).get(consts.WARM_POD_LABEL_KEY) == \
+                    consts.WARM_POD_LABEL_VALUE:
+                # warm-pool pod: unowned by design, not an orphan
+                if not self._warm_pod_stale(slave):
+                    continue
+                name = objects.name(slave)
+                logger.info("deleting stale warm pod %s (%s)", name,
+                            "terminal" if objects.is_terminal(slave)
+                            else "pool disabled")
+                try:
+                    # rv precondition: never race a concurrent adoption
+                    self.kube.delete_pod(
+                        self.settings.pool_namespace, name,
+                        resource_version=slave.get("metadata", {}).get(
+                            "resourceVersion") or None)
+                    deleted.append(name)
+                except K8sApiError as e:
+                    if e.status != 409:
+                        logger.warning("delete warm pod %s failed: %s",
+                                       name, e)
                 continue
             try:
                 if self._owner_alive(slave):

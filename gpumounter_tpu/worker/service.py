@@ -27,7 +27,7 @@ import threading
 import time
 
 from gpumounter_tpu.actuation.mount import TPUMounter, can_mount
-from gpumounter_tpu.allocator import TPUAllocator
+from gpumounter_tpu.allocator import AllocationStats, TPUAllocator
 from gpumounter_tpu.device.model import TPUChip
 from gpumounter_tpu.k8s import objects
 from gpumounter_tpu.k8s.client import KubeClient
@@ -51,6 +51,10 @@ class AddOutcome:
     result: consts.AddResult
     chips: list[TPUChip] = dataclasses.field(default_factory=list)
     message: str = ""
+    # Warm-pool outcome: how many slave pods were adopted warm vs
+    # cold-created (both 0 when the pool is disabled — today's behavior).
+    pool_hits: int = 0
+    pool_misses: int = 0
 
 
 @dataclasses.dataclass
@@ -100,11 +104,16 @@ class TPUMountService:
     """One per worker; owns the node-local orchestration."""
 
     def __init__(self, allocator: TPUAllocator, mounter: TPUMounter,
-                 kube: KubeClient, settings: Settings | None = None):
+                 kube: KubeClient, settings: Settings | None = None,
+                 pool=None):
         self.allocator = allocator
         self.mounter = mounter
         self.kube = kube
         self.settings = settings or Settings()
+        # Optional PoolManager (worker/pool.py): when set, AddTPU adopts
+        # pre-scheduled warm slave pods before falling back to the cold
+        # create+wait path. None ⇒ exactly the historical behavior.
+        self.pool = pool
         # Per-request fencing: a gateway retry can arrive while the original
         # handler is still executing in this process (UNAVAILABLE from a
         # connection blip, not a worker death). Serialising same-request_id
@@ -214,11 +223,13 @@ class TPUMountService:
         # on GKE whole-host granularity); single ⇒ N one-chip slave pods
         # (ref server.go:62-66).
         per_pod = tpu_num if is_entire_mount else 1
+        alloc_stats = AllocationStats()
         try:
             with trace.span("allocate"):
                 chips, slaves = self.allocator.get_available_tpus(
                     pod, tpu_num, per_pod, txn_id=txn_id,
-                    request_id=request_id, adopt=adopt)
+                    request_id=request_id, adopt=adopt,
+                    pool=self.pool, stats=alloc_stats)
         except InsufficientTPUError as e:
             self._record_event(pod, "TPUAttachFailed", str(e), warning=True)
             return AddOutcome(consts.AddResult.INSUFFICIENT_TPU,
@@ -259,9 +270,10 @@ class TPUMountService:
                                f"actuation failed, rolled back: {e}",
                                warning=True)
             raise
-        logger.info("AddTPU ok: %d chips -> %s/%s (%s)", len(chips),
-                    namespace, pod_name,
-                    "entire" if is_entire_mount else "single")
+        logger.info("AddTPU ok: %d chips -> %s/%s (%s, warm=%d cold=%d)",
+                    len(chips), namespace, pod_name,
+                    "entire" if is_entire_mount else "single",
+                    alloc_stats.warm_adopted, alloc_stats.cold_created)
         # A retry that adopted a fully-mounted prior attempt is the SAME
         # logical attach — record it under a distinct reason so the audit
         # trail shows one TPUAttached per attach, not one per retry. "Fully
@@ -275,7 +287,10 @@ class TPUMountService:
             f"attached {len(chips)} TPU chip(s) "
             f"({'entire' if is_entire_mount else 'single'}-mount): "
             f"{[c.uuid for c in chips]}")
-        return AddOutcome(consts.AddResult.SUCCESS, chips=chips)
+        return AddOutcome(consts.AddResult.SUCCESS, chips=chips,
+                          pool_hits=alloc_stats.warm_adopted,
+                          pool_misses=(alloc_stats.cold_created
+                                       if self.pool is not None else 0))
 
     # -- RemoveTPU (ref server.go:102-180) -------------------------------------
 

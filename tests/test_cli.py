@@ -273,3 +273,99 @@ def test_doctor_lifetime_counters_warn_not_crit(live_stack):
     assert rc == 0, out
     assert "exceptions: 0 worker-local" in out
     assert "in the last 0.2s" in out
+
+
+def test_parse_exposition_trailing_timestamp():
+    """Standard exposition lines may carry a trailing timestamp_ms; the
+    sample value is the first token after the name/labels, not the last."""
+    m = cli._parse_exposition("\n".join([
+        'x{result="SUCCESS"} 3 1712345678901',
+        "y 2.5 1712345678901",
+        "z 7",
+    ]))
+    assert m["x"][(("result", "SUCCESS"),)] == 3
+    assert m["y"][()] == 2.5
+    assert m["z"][()] == 7
+
+
+def test_doctor_window_counter_reset_falls_back_to_lifetime(monkeypatch):
+    """A process restart between the two scrapes makes the second sample
+    LOWER: the deltas are meaningless, so doctor must say 'counter reset'
+    and judge lifetime totals (WARN ceiling) instead of printing negative
+    counts or paging CRIT for a restart."""
+    scrapes = ['tpumounter_attach_total{result="EXCEPTION"} 5\n',
+               'tpumounter_attach_total{result="EXCEPTION"} 1\n']
+
+    def fake_fetch(master, path, timeout):
+        if path == "/healthz":
+            return '{"status": "ok"}'
+        return scrapes.pop(0) if len(scrapes) > 1 else scrapes[0]
+
+    monkeypatch.setattr(cli, "_fetch_text", fake_fetch)
+    monkeypatch.setattr(cli.time, "sleep", lambda s: None)
+    rc, out = run_cli("http://unused", "doctor", "--window", "5")
+    assert rc == 1, out                         # WARN, never CRIT
+    assert "counter reset" in out
+    assert "-4" not in out                      # the raw delta, never shown
+    assert "exceptions: 5" in out               # lifetime figure instead
+    assert "lifetime" in out
+
+
+def test_doctor_windowed_p95_diffs_histogram(monkeypatch):
+    """--window judges the p95 of attaches INSIDE the window (bucket
+    deltas), not the lifetime histogram — and says which scope it used."""
+    first = "\n".join([
+        'tpumounter_attach_seconds_bucket{le="0.1"} 0',
+        'tpumounter_attach_seconds_bucket{le="30"} 10',
+        'tpumounter_attach_seconds_bucket{le="+Inf"} 10',
+        "tpumounter_attach_seconds_count 10",
+    ])
+    second = "\n".join([
+        'tpumounter_attach_seconds_bucket{le="0.1"} 2',
+        'tpumounter_attach_seconds_bucket{le="30"} 12',
+        'tpumounter_attach_seconds_bucket{le="+Inf"} 12',
+        "tpumounter_attach_seconds_count 12",
+    ])
+    scrapes = [first, second]
+
+    def fake_fetch(master, path, timeout):
+        if path == "/healthz":
+            return '{"status": "ok"}'
+        return scrapes.pop(0) if len(scrapes) > 1 else scrapes[0]
+
+    monkeypatch.setattr(cli, "_fetch_text", fake_fetch)
+    monkeypatch.setattr(cli.time, "sleep", lambda s: None)
+    rc, out = run_cli("http://unused", "doctor", "--window", "5")
+    # the 10 lifetime ~30s attaches would WARN; the 2 in-window attaches
+    # are fast, so the windowed check is healthy and scoped
+    assert rc == 0, out
+    assert "over 2 attach(es)" in out
+    assert "in the last 5s" in out
+
+    # lifetime mode still reports, but now says it is a lifetime figure
+    scrapes = [first]
+    rc, out = run_cli("http://unused", "doctor")
+    assert rc == 1, out                 # p95 ~30s over 10 attaches: WARN
+    assert "over 10 attach(es)" in out
+    assert "lifetime" in out
+
+
+def test_doctor_window_gauge_decrease_is_not_a_counter_reset(monkeypatch):
+    """Gauges go down in normal operation (chips freed, warm pod adopted);
+    only counter-semantics families may trip the reset fallback."""
+    scrapes = ["\n".join(['tpumounter_node_chips{state="allocated"} 4',
+                          "tpumounter_attach_total 7"]),
+               "\n".join(['tpumounter_node_chips{state="allocated"} 0',
+                          "tpumounter_attach_total 8"])]
+
+    def fake_fetch(master, path, timeout):
+        if path == "/healthz":
+            return '{"status": "ok"}'
+        return scrapes.pop(0) if len(scrapes) > 1 else scrapes[0]
+
+    monkeypatch.setattr(cli, "_fetch_text", fake_fetch)
+    monkeypatch.setattr(cli.time, "sleep", lambda s: None)
+    rc, out = run_cli("http://unused", "doctor", "--window", "5")
+    assert rc == 0, out
+    assert "counter reset" not in out
+    assert "in the last 5s" in out              # windowed judgement kept

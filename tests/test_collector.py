@@ -61,23 +61,30 @@ def test_unknown_device_id_warns_but_continues(collector, fake_kubelet):
     assert collector.get_chip_by_uuid("3").state is DeviceState.ALLOCATED
 
 
-def test_get_pod_tpu_resources_includes_slave_pods(collector, fake_kubelet):
+def test_get_pod_tpu_resources_exact_includes_named_slave_pods(
+        collector, fake_kubelet):
     fake_kubelet.assign("default", "train-pod", ["0"])
     fake_kubelet.assign("tpu-pool", "train-pod-slave-pod-a1b2c3", ["1"])
-    fake_kubelet.assign("tpu-pool", "train-pod-slave-pod-d4e5f6", ["2"])
+    # adopted warm-pool pods keep their warm-* names — exact-name
+    # resolution (owner labels) must still find their chips
+    fake_kubelet.assign("tpu-pool", "warm-slave-pod-d4e5f6", ["2"])
     # a slave pod of a DIFFERENT owner must not match
     fake_kubelet.assign("tpu-pool", "other-slave-pod-ffffff", ["3"])
-    chips = collector.get_pod_tpu_resources("train-pod", "default")
+    chips = collector.get_pod_tpu_resources_exact(
+        "train-pod", "default",
+        {"train-pod-slave-pod-a1b2c3", "warm-slave-pod-d4e5f6"})
     assert sorted(c.uuid for c in chips) == ["0", "1", "2"]
     slave_holders = {c.pod_name for c in chips
                      if c.namespace == "tpu-pool"}
     assert slave_holders == {"train-pod-slave-pod-a1b2c3",
-                             "train-pod-slave-pod-d4e5f6"}
+                             "warm-slave-pod-d4e5f6"}
 
 
 def test_slave_pod_in_wrong_namespace_ignored(collector, fake_kubelet):
+    # a same-named pod OUTSIDE the pool namespace is not a slave pod
     fake_kubelet.assign("default", "train-pod-slave-pod-aaa", ["1"])
-    chips = collector.get_pod_tpu_resources("train-pod", "default")
+    chips = collector.get_pod_tpu_resources_exact(
+        "train-pod", "default", {"train-pod-slave-pod-aaa"})
     assert [c.uuid for c in chips] == []
 
 

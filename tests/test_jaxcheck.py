@@ -428,3 +428,16 @@ def test_measure_with_retry_retries_only_transient():
     with _pytest.raises(RuntimeError, match="deadline"):
         measure_with_retry(always_flaky, attempts=2, backoff_s=0.0)
     assert calls["n"] == 2                # bounded
+
+
+def test_measure_with_retry_rejects_nonpositive_attempts():
+    """attempts < 1 must raise immediately, not silently return None and
+    crash the caller with a TypeError far from the cause."""
+    import pytest as _pytest
+
+    from gpumounter_tpu.jaxcheck.perf import measure_with_retry
+
+    for attempts in (0, -1):
+        with _pytest.raises(ValueError, match="attempts"):
+            measure_with_retry(lambda: 1.0, attempts=attempts)
+    assert measure_with_retry(lambda: 1.0, attempts=1) == 1.0

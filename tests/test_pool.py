@@ -1,0 +1,503 @@
+"""Warm slave-pod pool (worker/pool.py): adoption takes the scheduler off
+the attach critical path.
+
+The contract under test, per invariant:
+- a pool HIT adopts a pre-scheduled warm pod via a resourceVersion-guarded
+  label patch — no pod create, no ``_wait_running`` watch, no scheduler
+  delay paid on the attach path;
+- a MISS falls back to today's cold create+wait path;
+- two concurrent claimers of one warm pod race on the same observed
+  resourceVersion and the apiserver admits exactly one;
+- the pool refills asynchronously after adoption, re-deriving all state
+  from the cluster (restart-safe, no local persistence);
+- the OrphanReconciler exempts warm (unowned-by-design) pods but GCs
+  genuinely stale ones;
+- pool disabled ≡ the historical behavior, bit for bit.
+"""
+
+import threading
+import time
+
+import pytest
+
+from gpumounter_tpu.k8s import objects
+from gpumounter_tpu.k8s.client import FakeKubeClient
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.errors import K8sApiError
+from gpumounter_tpu.utils.metrics import REGISTRY
+from gpumounter_tpu.worker.pool import PoolManager, pool_key
+from gpumounter_tpu.worker.reconciler import OrphanReconciler
+
+from tests.helpers import WorkerRig
+
+
+def warm_pods(rig):
+    return [p for p in rig.sim.slave_pods()
+            if objects.labels(p).get(consts.WARM_POD_LABEL_KEY)
+            == consts.WARM_POD_LABEL_VALUE]
+
+
+# -- pool fill / shape ---------------------------------------------------------
+
+
+def test_fill_creates_running_unowned_warm_pods(fake_host):
+    rig = WorkerRig(fake_host, warm_pool={"single:1": 2})
+    rig.fill_warm_pool()
+    pods = warm_pods(rig)
+    assert len(pods) == 2
+    for pod in pods:
+        labels = objects.labels(pod)
+        assert consts.OWNER_POD_LABEL_KEY not in labels
+        assert consts.OWNER_UID_LABEL_KEY not in labels
+        assert labels[consts.MOUNT_TYPE_LABEL_KEY] == \
+            consts.MountType.SINGLE.value
+        assert objects.is_running(pod)
+    # warm pods went through the real scheduler path: the device plugin
+    # actually assigned chips to them — accounting is honest, not virtual
+    assert len(rig.sim.podresources.assignments) == 2
+    assert REGISTRY.warm_pool_size.value(key="single:1") == 2
+
+
+def test_pool_metrics_are_exported(fake_host):
+    rig = WorkerRig(fake_host, warm_pool={"single:1": 1})
+    before = REGISTRY.pool_refill_latency.count
+    rig.fill_warm_pool()
+    assert REGISTRY.pool_refill_latency.count > before
+    text = REGISTRY.render_text()
+    for family in ("tpumounter_pool_hits_total",
+                   "tpumounter_pool_misses_total",
+                   "tpumounter_warm_pool_size",
+                   "tpumounter_pool_refill_seconds_bucket"):
+        assert family in text, family
+
+
+# -- hit path ------------------------------------------------------------------
+
+
+def test_pool_hit_adopts_without_wait_running(fake_host, monkeypatch):
+    """The whole point: a full pool hit never enters the create+wait state
+    machine, so the per-slave-pod scheduler delay is not paid."""
+    rig = WorkerRig(fake_host, schedule_delay_s=0.5,
+                    warm_pool={"entire:4": 1})
+    rig.fill_warm_pool()
+    waits = []
+    monkeypatch.setattr(rig.allocator, "_wait_running", waits.append)
+    hits0 = REGISTRY.pool_hits.value()
+    t0 = time.monotonic()
+    out = rig.service.add_tpu("workload", "default", 4, True)
+    elapsed = time.monotonic() - t0
+    assert out.result is consts.AddResult.SUCCESS
+    assert len(out.chips) == 4
+    assert waits == []                          # no scheduler wait at all
+    assert elapsed < 0.5                        # delay not paid
+    assert out.pool_hits == 1 and out.pool_misses == 0
+    assert REGISTRY.pool_hits.value() == hits0 + 1
+    # the adopted pod is out of the pool and fully owned
+    slave = rig.sim.slave_pods()[0]
+    labels = objects.labels(slave)
+    assert consts.WARM_POD_LABEL_KEY not in labels
+    assert labels[consts.OWNER_POD_LABEL_KEY] == "workload"
+    assert labels[consts.OWNER_NAMESPACE_LABEL_KEY] == "default"
+    assert labels[consts.OWNER_UID_LABEL_KEY] == "uid-w"
+    assert warm_pods(rig) == []
+
+
+def test_adopted_pod_detaches_and_status_resolves(fake_host):
+    """An adopted warm pod keeps its warm-* NAME: every resolution path
+    (status, mount type, removal) must go through owner labels, never the
+    <owner>-slave-pod- name-prefix convention."""
+    rig = WorkerRig(fake_host, warm_pool={"entire:2": 1})
+    rig.fill_warm_pool()
+    out = rig.service.add_tpu("workload", "default", 2, True)
+    assert out.result is consts.AddResult.SUCCESS and out.pool_hits == 1
+    mount_type, chips = rig.service.tpu_status("workload", "default")
+    assert mount_type is consts.MountType.ENTIRE
+    assert len(chips) == 2
+    assert all(c.slave_pod.startswith(consts.WARM_POD_NAME_PREFIX)
+               for c in chips)
+    removed = rig.service.remove_tpu("workload", "default", [], False)
+    assert removed.result is consts.RemoveResult.SUCCESS
+    assert rig.sim.slave_pods() == []
+    assert rig.sim.podresources.assignments == {}
+
+
+# -- miss fallback -------------------------------------------------------------
+
+
+def test_empty_pool_miss_falls_back_to_cold_create(fake_host):
+    rig = WorkerRig(fake_host, warm_pool={"entire:4": 1})   # never filled
+    misses0 = REGISTRY.pool_misses.value()
+    out = rig.service.add_tpu("workload", "default", 4, True)
+    assert out.result is consts.AddResult.SUCCESS
+    assert out.pool_hits == 0 and out.pool_misses == 1
+    assert REGISTRY.pool_misses.value() == misses0 + 1
+
+
+def test_wrong_key_is_a_miss(fake_host):
+    """A warm entire-mount pod must not satisfy a single-mount attach:
+    pool keys partition on (mount type, chip count)."""
+    rig = WorkerRig(fake_host, warm_pool={"entire:2": 1})
+    rig.fill_warm_pool()
+    out = rig.service.add_tpu("workload", "default", 2, False)  # single x2
+    assert out.result is consts.AddResult.SUCCESS
+    assert out.pool_hits == 0 and out.pool_misses == 2
+    assert len(warm_pods(rig)) == 1             # pool untouched
+
+
+def test_partial_hit_tops_up_cold(fake_host):
+    """3 single chips wanted, 2 warm: adopt both, cold-create the third."""
+    rig = WorkerRig(fake_host, warm_pool={"single:1": 2})
+    rig.fill_warm_pool()
+    out = rig.service.add_tpu("workload", "default", 3, False)
+    assert out.result is consts.AddResult.SUCCESS
+    assert len(out.chips) == 3
+    assert out.pool_hits == 2 and out.pool_misses == 1
+
+
+# -- adoption race -------------------------------------------------------------
+
+
+def test_stale_resource_version_claim_loses(fake_host):
+    """The claim is decided by the apiserver's optimistic concurrency: a
+    claimer acting on a stale observed version gets 409, not the pod."""
+    rig = WorkerRig(fake_host, warm_pool={"single:1": 1})
+    rig.fill_warm_pool()
+    pod = warm_pods(rig)[0]
+    stale_rv = pod["metadata"]["resourceVersion"]
+    claimed = rig.pool.claim(rig.pod, 1, False, 1)
+    assert claimed == [objects.name(pod)]
+    with pytest.raises(K8sApiError) as err:
+        rig.sim.kube.patch_pod(
+            rig.sim.settings.pool_namespace, objects.name(pod),
+            {"metadata": {"labels": {
+                consts.OWNER_POD_LABEL_KEY: "other-pod"}}},
+            resource_version=stale_rv)
+    assert err.value.status == 409
+    # the winner's ownership stamp survived
+    live = rig.sim.kube.get_pod(rig.sim.settings.pool_namespace,
+                                objects.name(pod))
+    assert objects.labels(live)[consts.OWNER_POD_LABEL_KEY] == "workload"
+
+
+def test_concurrent_attaches_one_warm_pod_exactly_one_wins(fake_host):
+    """Two simultaneous single-chip attaches, one warm pod: exactly one
+    adopts, the loser cold-creates, both succeed."""
+    rig = WorkerRig(fake_host, n_chips=4, warm_pool={"single:1": 1})
+    rig.fill_warm_pool()
+    other = rig.sim.add_target_pod(name="workload-b", uid="uid-b")
+    rig.provision_container(other)
+    hits0 = REGISTRY.pool_hits.value()
+    misses0 = REGISTRY.pool_misses.value()
+    outcomes = {}
+
+    def attach(pod_name):
+        outcomes[pod_name] = rig.service.add_tpu(pod_name, "default",
+                                                 1, False)
+
+    threads = [threading.Thread(target=attach, args=(n,))
+               for n in ("workload", "workload-b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert all(o.result is consts.AddResult.SUCCESS
+               for o in outcomes.values()), outcomes
+    assert sum(o.pool_hits for o in outcomes.values()) == 1
+    assert sum(o.pool_misses for o in outcomes.values()) == 1
+    assert REGISTRY.pool_hits.value() - hits0 == 1
+    assert REGISTRY.pool_misses.value() - misses0 == 1
+    # no double-grant: the two attaches hold disjoint chips
+    uuids = [c.uuid for o in outcomes.values() for c in o.chips]
+    assert len(uuids) == len(set(uuids)) == 2
+
+
+# -- refill --------------------------------------------------------------------
+
+
+def test_refill_after_adoption(fake_host):
+    rig = WorkerRig(fake_host, n_chips=4, warm_pool={"single:1": 2})
+    rig.fill_warm_pool()
+    out = rig.service.add_tpu("workload", "default", 1, False)
+    assert out.pool_hits == 1
+    assert len(warm_pods(rig)) == 1
+    result = rig.pool.scan_once()
+    assert len(result["created"]) == 1
+    assert len(warm_pods(rig)) == 2
+    assert REGISTRY.warm_pool_size.value(key="single:1") == 2
+
+
+def test_adoption_kicks_background_refill(fake_host):
+    """The refill loop is woken by claim() immediately — the interval only
+    bounds how long unrelated drift goes unnoticed."""
+    rig = WorkerRig(fake_host, n_chips=4, warm_pool={"single:1": 1})
+    rig.fill_warm_pool()
+    rig.pool.interval_s = 60.0          # only the kick can refill in time
+    rig.pool.start()
+    try:
+        out = rig.service.add_tpu("workload", "default", 1, False)
+        assert out.pool_hits == 1
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not warm_pods(rig):
+            time.sleep(0.02)
+        assert len(warm_pods(rig)) == 1
+    finally:
+        rig.pool.stop()
+
+
+def test_pool_state_rederived_after_worker_restart(fake_host):
+    """A fresh PoolManager over the same cluster adopts the existing warm
+    pods as its own — no local persistence, no duplicate fill."""
+    rig = WorkerRig(fake_host, warm_pool={"single:1": 2})
+    rig.fill_warm_pool()
+    fresh = PoolManager(rig.allocator, rig.sim.kube, rig.sim.settings)
+    result = fresh.scan_once()
+    assert result["created"] == [] and result["deleted"] == []
+    assert len(warm_pods(rig)) == 2
+
+
+def test_resize_trims_excess_and_retargets_keys(fake_host):
+    rig = WorkerRig(fake_host, warm_pool={"single:1": 2})
+    rig.fill_warm_pool()
+    rig.sim.settings.warm_pool_sizes = {"single:1": 1}
+    result = rig.pool.scan_once()
+    assert len(result["deleted"]) == 1
+    assert len(warm_pods(rig)) == 1
+    # retarget to a different key: old-key pods are stale, new key fills
+    rig.sim.settings.warm_pool_sizes = {"entire:4": 1}
+    rig.fill_warm_pool()
+    pods = warm_pods(rig)
+    assert len(pods) == 1
+    assert objects.labels(pods[0])[consts.MOUNT_TYPE_LABEL_KEY] == \
+        consts.MountType.ENTIRE.value
+
+
+def test_allocation_failure_returns_claimed_pod_by_deletion(fake_host):
+    """If the attach dies after claiming (kubelet never reports chips),
+    the claimed pod is cleaned up like a cold-created one — a half-adopted
+    pod must not leak as owned-but-unmounted."""
+    rig = WorkerRig(fake_host, warm_pool={"single:1": 1})
+    rig.fill_warm_pool()
+    rig.sim.settings.kubelet_lag_timeout_s = 0.2
+    name = objects.name(warm_pods(rig)[0])
+    # simulate the kubelet losing the assignment after the pod went Running
+    rig.sim.podresources.unassign(rig.sim.settings.pool_namespace, name)
+    out = rig.service.add_tpu("workload", "default", 1, False)
+    assert out.result is consts.AddResult.INSUFFICIENT_TPU
+    assert rig.sim.slave_pods() == []   # claimed pod deleted, nothing leaks
+
+
+# -- reconciler interplay ------------------------------------------------------
+
+
+def test_reconciler_leaves_live_warm_pods_alone(fake_host):
+    rig = WorkerRig(fake_host, warm_pool={"single:1": 2})
+    rig.fill_warm_pool()
+    deleted = OrphanReconciler(rig.sim.kube, rig.sim.settings).scan_once()
+    assert deleted == []
+    assert len(warm_pods(rig)) == 2
+
+
+def test_reconciler_gcs_terminal_warm_pod(fake_host):
+    rig = WorkerRig(fake_host, warm_pool={"single:1": 2})
+    rig.fill_warm_pool()
+    victim = objects.name(warm_pods(rig)[0])
+    rig.sim.kube.set_pod_status(rig.sim.settings.pool_namespace, victim,
+                                phase="Failed")
+    deleted = OrphanReconciler(rig.sim.kube, rig.sim.settings).scan_once()
+    assert deleted == [victim]
+    assert len(warm_pods(rig)) == 1
+
+
+def test_reconciler_gcs_warm_pods_when_pool_disabled(fake_host):
+    """Disabled pool + leftover warm pods = dead chip reservations with no
+    maintainer; the reconciler is the backstop that frees them."""
+    rig = WorkerRig(fake_host, warm_pool={"single:1": 2})
+    rig.fill_warm_pool()
+    rig.sim.settings.warm_pool_enabled = False
+    deleted = OrphanReconciler(rig.sim.kube, rig.sim.settings).scan_once()
+    assert len(deleted) == 2
+    assert warm_pods(rig) == []
+
+
+# -- disabled == today ---------------------------------------------------------
+
+
+def test_pool_disabled_is_todays_behavior(fake_host):
+    rig = WorkerRig(fake_host)              # no warm_pool: default build
+    assert rig.pool is None and rig.service.pool is None
+    hits0 = REGISTRY.pool_hits.value()
+    misses0 = REGISTRY.pool_misses.value()
+    out = rig.service.add_tpu("workload", "default", 2, False)
+    assert out.result is consts.AddResult.SUCCESS
+    assert out.pool_hits == 0 and out.pool_misses == 0
+    assert REGISTRY.pool_hits.value() == hits0
+    assert REGISTRY.pool_misses.value() == misses0
+    assert warm_pods(rig) == []
+    assert rig.service.remove_tpu("workload", "default", [], False).result \
+        is consts.RemoveResult.SUCCESS
+
+
+# -- pieces --------------------------------------------------------------------
+
+
+def test_pool_key_partitioning():
+    assert pool_key(True, 4) == "entire:4"
+    assert pool_key(False, 1) == "single:1"
+
+
+def test_parse_warm_pool_sizes():
+    from gpumounter_tpu.utils.config import Settings, parse_warm_pool_sizes
+    assert parse_warm_pool_sizes("entire:4=1,single:1=2") == \
+        {"entire:4": 1, "single:1": 2}
+    assert parse_warm_pool_sizes("") == {}
+    assert parse_warm_pool_sizes("entire:4=0") == {}     # 0 = not pooled
+    for bad in ("entire=1", "entire:4", "weird:4=1", "single:2=1",
+                "entire:x=1", "entire:4=x"):
+        with pytest.raises(ValueError):
+            parse_warm_pool_sizes(bad)
+    s = Settings.from_env({"TPU_WARM_POOL": "entire:4=1"})
+    assert s.warm_pool_enabled and s.warm_pool_sizes == {"entire:4": 1}
+    s = Settings.from_env({})
+    assert not s.warm_pool_enabled and s.warm_pool_sizes == {}
+
+
+def test_fake_patch_pod_merge_and_precondition():
+    kube = FakeKubeClient()
+    kube.put_pod({"metadata": {"name": "p", "namespace": "ns",
+                               "labels": {"keep": "1", "drop": "1"}},
+                  "spec": {}, "status": {"phase": "Running"}})
+    rv = kube.get_pod("ns", "p")["metadata"]["resourceVersion"]
+    patched = kube.patch_pod(
+        "ns", "p", {"metadata": {"labels": {"drop": None, "new": "2"}}},
+        resource_version=rv)
+    assert objects.labels(patched) == {"keep": "1", "new": "2"}
+    # the write bumped the version: the old rv is now a losing ticket
+    with pytest.raises(K8sApiError) as err:
+        kube.patch_pod("ns", "p", {"metadata": {"labels": {"x": "y"}}},
+                       resource_version=rv)
+    assert err.value.status == 409
+
+
+def test_pool_status_and_poolz_endpoint(fake_host):
+    rig = WorkerRig(fake_host, warm_pool={"single:1": 1})
+    rig.fill_warm_pool()
+    status = rig.pool.status()
+    assert status["enabled"] is True
+    assert status["keys"]["single:1"]["running"] == 1
+    assert status["keys"]["single:1"]["target"] == 1
+
+    # the worker's health sidecar serves the same view on /poolz
+    import json
+    import urllib.request
+    from gpumounter_tpu.worker import main as worker_main
+    worker_main._HealthHandler.pool = rig.pool
+    server = worker_main.start_health_server(0)
+    try:
+        url = f"http://127.0.0.1:{server.server_port}/poolz"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            body = json.loads(resp.read())
+        assert body["enabled"] is True
+        assert body["keys"]["single:1"]["running"] == 1
+    finally:
+        server.shutdown()
+        worker_main._HealthHandler.pool = None
+
+
+# -- review hardening ----------------------------------------------------------
+
+
+def test_fake_delete_pod_precondition():
+    kube = FakeKubeClient()
+    kube.put_pod({"metadata": {"name": "p", "namespace": "ns"},
+                  "spec": {}, "status": {"phase": "Running"}})
+    rv = kube.get_pod("ns", "p")["metadata"]["resourceVersion"]
+    kube.patch_pod("ns", "p", {"metadata": {"labels": {"x": "y"}}})
+    with pytest.raises(K8sApiError) as err:
+        kube.delete_pod("ns", "p", resource_version=rv)   # stale
+    assert err.value.status == 409
+    kube.get_pod("ns", "p")                               # survived
+    fresh = kube.get_pod("ns", "p")["metadata"]["resourceVersion"]
+    kube.delete_pod("ns", "p", resource_version=fresh)
+    with pytest.raises(Exception):
+        kube.get_pod("ns", "p")
+
+
+def test_scan_trim_cannot_kill_concurrently_adopted_pod(fake_host,
+                                                        monkeypatch):
+    """The trim decides on a LIST snapshot; if an attach adopts the pod
+    after that snapshot, the rv-preconditioned delete 409s and the owned,
+    possibly mid-mount pod survives."""
+    rig = WorkerRig(fake_host, warm_pool={"single:1": 1})
+    rig.fill_warm_pool()
+    stale_view = rig.pool._list_warm()          # scan's stale snapshot
+    claimed = rig.pool.claim(rig.pod, 1, False, 1)
+    assert claimed
+    rig.sim.settings.warm_pool_sizes = {"single:1": 0}   # trim everything
+    monkeypatch.setattr(rig.pool, "_list_warm", lambda: stale_view)
+    result = rig.pool.scan_once()
+    assert result["deleted"] == []              # 409: adoption won
+    live = rig.sim.kube.get_pod(rig.sim.settings.pool_namespace, claimed[0])
+    assert objects.labels(live)[consts.OWNER_POD_LABEL_KEY] == "workload"
+
+
+def test_claim_keeps_partial_wins_on_apiserver_error(fake_host,
+                                                     monkeypatch):
+    """A non-409 apiserver failure mid-claim must not discard pods already
+    adopted — they'd be owned but invisible to the failure cleanup."""
+    rig = WorkerRig(fake_host, warm_pool={"single:1": 2})
+    rig.fill_warm_pool()
+    real_patch = rig.sim.kube.patch_pod
+    calls = {"n": 0}
+
+    def flaky_patch(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise K8sApiError(500, "apiserver on fire")
+        return real_patch(*args, **kwargs)
+
+    monkeypatch.setattr(rig.sim.kube, "patch_pod", flaky_patch)
+    claimed = rig.pool.claim(rig.pod, 1, False, 2)
+    assert len(claimed) == 1                    # the win is kept, no raise
+    live = rig.sim.kube.get_pod(rig.sim.settings.pool_namespace, claimed[0])
+    assert objects.labels(live)[consts.OWNER_POD_LABEL_KEY] == "workload"
+
+
+def test_gauge_zeroes_resized_away_key(fake_host):
+    rig = WorkerRig(fake_host, warm_pool={"single:1": 2})
+    rig.fill_warm_pool()
+    assert REGISTRY.warm_pool_size.value(key="single:1") == 2
+    rig.sim.settings.warm_pool_sizes = {"entire:4": 1}
+    rig.fill_warm_pool()
+    assert REGISTRY.warm_pool_size.value(key="entire:4") == 1
+    # the old key reports 0, not its frozen last value
+    assert REGISTRY.warm_pool_size.value(key="single:1") == 0
+
+
+def test_claim_list_failure_degrades_to_counted_miss(fake_host,
+                                                     monkeypatch):
+    """A transient apiserver failure on the warm LIST must not fail the
+    attach: the pool is an optimization, so it degrades to a miss and the
+    cold path proceeds unchanged."""
+    rig = WorkerRig(fake_host, warm_pool={"single:1": 1})
+    rig.fill_warm_pool()
+
+    def boom():
+        raise K8sApiError(500, "LIST unavailable")
+
+    monkeypatch.setattr(rig.pool, "_list_warm", boom)
+    misses0 = REGISTRY.pool_misses.value()
+    out = rig.service.add_tpu("workload", "default", 1, False)
+    assert out.result is consts.AddResult.SUCCESS
+    assert out.pool_hits == 0 and out.pool_misses == 1
+    assert REGISTRY.pool_misses.value() == misses0 + 1
+
+
+def test_status_buckets_doomed_pods_as_stale(fake_host):
+    """/poolz must not show a dead warm pod as upcoming capacity."""
+    rig = WorkerRig(fake_host, warm_pool={"single:1": 2})
+    rig.fill_warm_pool()
+    victim = objects.name(warm_pods(rig)[0])
+    rig.sim.kube.set_pod_status(rig.sim.settings.pool_namespace, victim,
+                                phase="Failed")
+    entry = rig.pool.status()["keys"]["single:1"]
+    assert entry == {"target": 2, "running": 1, "pending": 0, "stale": 1}

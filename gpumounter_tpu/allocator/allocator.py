@@ -45,6 +45,7 @@ from gpumounter_tpu.utils.errors import (AllocationTimeoutError,
                                          DeviceNotFoundError,
                                          InsufficientTPUError, K8sApiError)
 from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.trace import annotate, span as trace_span
 
 logger = get_logger("allocator")
 
@@ -258,18 +259,23 @@ class TPUAllocator:
                                   extra_labels=extra_labels)
                 created.extend(warm)
                 shortfall -= len(warm)
-            for _ in range(shortfall):
-                spec = self.new_slave_pod(owner, tpus_per_pod, entire,
-                                          txn_id=txn_id,
-                                          extra_labels=extra_labels)
-                self.kube.create_pod(self.settings.pool_namespace, spec)
-                fresh.append(objects.name(spec))
-                created.append(objects.name(spec))
+            if shortfall:
+                with trace_span("slave_pods.create", pods=shortfall):
+                    for _ in range(shortfall):
+                        spec = self.new_slave_pod(owner, tpus_per_pod,
+                                                  entire, txn_id=txn_id,
+                                                  extra_labels=extra_labels)
+                        self.kube.create_pod(self.settings.pool_namespace,
+                                             spec)
+                        fresh.append(objects.name(spec))
+                        created.append(objects.name(spec))
             # Warm pods were Running when claimed (the rv-guarded patch
             # proved the observed state was current); only resumed and
             # cold-created pods still need the scheduler state machine.
             if adopted or fresh:
-                self._wait_running(adopted + fresh)
+                with trace_span("scheduler.wait",
+                                pods=len(adopted) + len(fresh)):
+                    self._wait_running(adopted + fresh)
         except (InsufficientTPUError, AllocationTimeoutError, K8sApiError):
             logger.warning("allocation failed; cleaning up slave pods %s "
                            "(adopted pods %s left for the reconciler/retry)",
@@ -283,7 +289,8 @@ class TPUAllocator:
 
         # Which chips did each slave pod actually get? Ground truth is the
         # kubelet PodResources API (ref allocator.go:84-97 → collector).
-        per_pod_chips, lagging = self._pods_chips_with_lag_retry(created)
+        with trace_span("kubelet.resolve", pods=len(created)):
+            per_pod_chips, lagging = self._pods_chips_with_lag_retry(created)
         if lagging:
             self.delete_slave_pods(fresh + warm, wait=False)
             raise InsufficientTPUError(
@@ -300,6 +307,9 @@ class TPUAllocator:
         logger.info("allocated %d chips via %d slave pods: %s",
                     len(chips), len(created),
                     [c.uuid for c in chips])
+        annotate(chips=len(chips), slave_pods=len(created),
+                 warm_adopted=len(warm), cold_created=len(fresh),
+                 resumed=len(adopted))
         return chips, created
 
     def _pods_chips_with_lag_retry(

@@ -22,6 +22,7 @@ Usage (``python -m gpumounter_tpu.cli`` or the ``tpumounterctl`` entry):
     tpumounterctl slice add    -p ns/pod-a -p ns/pod-b --tpus-per-host 4
     tpumounterctl slice remove -p ns/pod-a -p ns/pod-b --force
     tpumounterctl health
+    tpumounterctl trace <request-id>
     tpumounterctl doctor [--node my-tpu-node]
 
 The master address comes from ``--master`` or ``$TPU_MOUNTER_MASTER``
@@ -234,6 +235,62 @@ def cmd_slice(args) -> int:
     return _finish(status, payload, args.json, "\n".join(lines))
 
 
+def _render_waterfall(trace: dict) -> list[str]:
+    """ASCII waterfall of one trace dict (the /tracez span tree): one row
+    per span, indented by depth, with a timeline bar scaled to the trace
+    total so the dominant hop is visible at a glance."""
+    total_ms = max(float(trace.get("total_ms") or 0.0), 1e-9)
+    root = trace.get("spans") or {}
+    t0 = float(root.get("start_unix") or 0.0)
+    width = 40
+    lines = [f"trace {trace.get('rid')} op={trace.get('op')} "
+             f"result={trace.get('result')} "
+             f"total={total_ms:.1f}ms"]
+
+    def attrs_str(span: dict) -> str:
+        attrs = span.get("attrs") or {}
+        if not attrs:
+            return ""
+        inner = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        return f"  [{inner}]"
+
+    def walk(span: dict, depth: int) -> None:
+        dur_ms = float(span.get("duration_ms") or 0.0)
+        offset_ms = (float(span.get("start_unix") or t0) - t0) * 1e3
+        start = min(width - 1, int(offset_ms / total_ms * width))
+        bar_len = max(1, int(dur_ms / total_ms * width))
+        bar = ("." * start + "#" * bar_len)[:width].ljust(width, ".")
+        name = ("  " * depth + span.get("name", "?"))[:28].ljust(28)
+        lines.append(f"  {name} {dur_ms:>9.1f}ms |{bar}|{attrs_str(span)}")
+        for child in span.get("children", []) or []:
+            walk(child, depth + 1)
+
+    if root:
+        walk(root, 0)
+    return lines
+
+
+def cmd_trace(args) -> int:
+    """Fetch the stitched trace for one request id from the master's
+    /tracez and render it as an ASCII waterfall — master spans
+    (resolve/dial/rpc) and the worker's phase spans in one tree."""
+    query = urllib.parse.urlencode({"rid": args.request_id})
+    status, payload = _request(args.master, "GET", f"/tracez?{query}",
+                               timeout=args.timeout)
+    traces = payload.get("traces") or []
+    if not traces:
+        _emit(payload, args.json,
+              f"no stored trace for request id {args.request_id!r} "
+              "(the store is a bounded ring — old requests rotate out)")
+        return EXIT_OTHER
+    lines = []
+    for trace in traces:
+        lines.extend(_render_waterfall(trace))
+    for err in payload.get("stitch_errors", []):
+        lines.append(f"  (worker spans incomplete: {err})")
+    return _finish(status, payload, args.json, "\n".join(lines))
+
+
 def cmd_health(args) -> int:
     try:
         status, payload = _request(args.master, "GET", "/healthz",
@@ -426,6 +483,15 @@ def cmd_doctor(args) -> int:
         metrics_delta = None
 
     if metrics:
+        # build identity straight from the scraped registry, so "which
+        # version is this master/worker actually running" never needs a
+        # kubectl describe
+        versions = sorted({dict(labels).get("version", "")
+                           for labels in
+                           metrics.get("tpumounter_build_info", {})} - {""})
+        if versions:
+            check("ok", f"target version {', '.join(versions)} "
+                        "(tpumounter_build_info)")
         src = metrics_delta if metrics_delta is not None else metrics
         scope = (f"in the last {window:g}s" if metrics_delta is not None
                  else "lifetime (use --window N for a current-activity "
@@ -483,6 +549,29 @@ def cmd_doctor(args) -> int:
                   "(point --master there to audit a node)")
         else:
             check("ok", f"no attaches recorded — {scope}")
+
+    # Slowest stored trace: WHICH hop ate the worst request's seconds —
+    # the one question the histograms can't answer. Informational (ok
+    # level): the store is lifetime-scoped like the counters, and doctor's
+    # contract is that only current activity pages.
+    try:
+        tracez = json.loads(_fetch_text(args.master, "/tracez?limit=1",
+                                        args.timeout))
+        slowest = (tracez.get("slowest") or [None])[0]
+    except (TransportError, ValueError, AttributeError):
+        slowest = None          # pre-/tracez target or non-JSON answer
+    if isinstance(slowest, dict):
+        dominant = max((slowest.get("spans") or {}).get("children") or [],
+                       key=lambda s: s.get("duration_ms") or 0.0,
+                       default=None)
+        detail = (f", dominant span {dominant.get('name')} "
+                  f"{float(dominant.get('duration_ms') or 0):.0f}ms"
+                  if dominant else "")
+        check("ok",
+              f"slowest stored trace: op={slowest.get('op')} "
+              f"rid={slowest.get('rid')} "
+              f"{float(slowest.get('total_ms') or 0) / 1e3:.2f}s{detail} "
+              f"— `tpumounterctl trace {slowest.get('rid')}` for the tree")
 
     if getattr(args, "node", None):
         try:
@@ -579,6 +668,15 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("health", help="master liveness")
     p.set_defaults(fn=cmd_health)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser(
+        "trace",
+        help="ASCII waterfall of one request's stitched span tree "
+             "(master + worker) from /tracez")
+    p.add_argument("request_id",
+                   help="the X-Request-Id / request_id of the request")
+    p.set_defaults(fn=cmd_trace)
     _add_common(p, suppress=True)
 
     p = sub.add_parser(

@@ -26,6 +26,7 @@ from gpumounter_tpu.api import podresources_v1_pb2 as pb_v1
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.errors import KubeletUnavailableError
 from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.trace import k8s_call
 
 logger = get_logger("collector.podresources")
 
@@ -88,6 +89,13 @@ class KubeletPodResourcesClient(PodResourcesClient):
         return grpc.insecure_channel(f"unix://{self.socket_path}")
 
     def list_pods(self) -> pb.ListPodResourcesResponse:
+        # Kubelet snapshots share the k8s request family (it IS a control-
+        # plane hop of the attach path); resource label "podresources"
+        # keeps them distinguishable from apiserver calls.
+        with k8s_call("LIST", "podresources"):
+            return self._list_pods()
+
+    def _list_pods(self) -> pb.ListPodResourcesResponse:
         channel = self._channel()
         try:
             if self.api_version in (None, "v1"):
@@ -137,23 +145,25 @@ class KubeletPodResourcesClient(PodResourcesClient):
         now = time.monotonic()
         if cached is not None and now < cached[0]:
             return cached[1]
-        channel = self._channel()
-        try:
-            resp = self._call(channel, _ALLOCATABLE_METHOD_V1,
-                              pb_v1.AllocatableResourcesRequest(),
-                              pb_v1.AllocatableResourcesResponse)
-        except grpc.RpcError as e:
-            if e.code() in (_PERMANENT_FALLBACK_CODES
-                            + _TRANSIENT_FALLBACK_CODES):
-                # fake/partial v1 server; cache too — absent stays absent
-                self._alloc_cache[resource_name] = (
-                    now + self.ALLOCATABLE_TTL_S, None)
-                return None
-            raise KubeletUnavailableError(
-                f"GetAllocatableResources failed: {e.code()}: "
-                f"{e.details()}") from e
-        finally:
-            channel.close()
+        with k8s_call("GET", "podresources"):
+            channel = self._channel()
+            try:
+                resp = self._call(channel, _ALLOCATABLE_METHOD_V1,
+                                  pb_v1.AllocatableResourcesRequest(),
+                                  pb_v1.AllocatableResourcesResponse)
+            except grpc.RpcError as e:
+                if e.code() in (_PERMANENT_FALLBACK_CODES
+                                + _TRANSIENT_FALLBACK_CODES):
+                    # fake/partial v1 server; cache too — absent stays
+                    # absent
+                    self._alloc_cache[resource_name] = (
+                        now + self.ALLOCATABLE_TTL_S, None)
+                    return None
+                raise KubeletUnavailableError(
+                    f"GetAllocatableResources failed: {e.code()}: "
+                    f"{e.details()}") from e
+            finally:
+                channel.close()
         ids = {device_id
                for dev in resp.devices if dev.resource_name == resource_name
                for device_id in dev.device_ids}
@@ -183,6 +193,12 @@ class FakePodResourcesClient(PodResourcesClient):
         self.assignments.pop((namespace, pod), None)
 
     def list_pods(self) -> pb.ListPodResourcesResponse:
+        # same instrumentation as the real client: fake-stack traces show
+        # kubelet snapshots exactly where production traces would
+        with k8s_call("LIST", "podresources"):
+            return self._list_pods()
+
+    def _list_pods(self) -> pb.ListPodResourcesResponse:
         self.list_calls += 1
         resp = pb.ListPodResourcesResponse()
         for (ns, pod), containers in self.assignments.items():

@@ -40,8 +40,22 @@ from typing import Any
 from gpumounter_tpu.k8s import objects
 from gpumounter_tpu.utils.errors import K8sApiError, PodNotFoundError
 from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.trace import k8s_call
 
 logger = get_logger("k8s.client")
+
+
+def _path_resource(path: str) -> str:
+    """The resource collection an apiserver path addresses ("pods",
+    "nodes", "events", ...) — the ``resource`` label of
+    ``tpumounter_k8s_request_seconds``."""
+    parts = [p for p in path.split("/") if p]
+    try:
+        if "namespaces" in parts:
+            return parts[parts.index("namespaces") + 2]
+        return parts[2]                       # /api/v1/<resource>/...
+    except IndexError:
+        return "unknown"
 
 SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -155,18 +169,35 @@ class RestKubeClient(KubeClient):
         tok = self._token()
         if tok:
             req.add_header("Authorization", f"Bearer {tok}")
-        try:
-            resp = urllib.request.urlopen(req, context=self._ssl,
-                                          timeout=timeout)
-        except urllib.error.HTTPError as e:
-            msg = e.read().decode(errors="replace")[:512]
-            raise K8sApiError(e.code, msg) from e
-        except urllib.error.URLError as e:
-            raise K8sApiError(0, f"apiserver unreachable: {e.reason}") from e
-        if stream:
-            return resp
-        with resp:
-            return json.loads(resp.read())
+        # WATCH and LIST are verbs of their own on dashboards — a 30s
+        # watch chunk or a fleet-wide LIST averaged into GET latency would
+        # bury every real GET regression. For streams only the connection
+        # setup is timed here; consuming the stream is the caller's
+        # (deliberately unbounded) wait.
+        resource = _path_resource(path)
+        if (query or {}).get("watch") == "true":
+            verb = "WATCH"
+        elif method == "GET" and path.rstrip("/").endswith(f"/{resource}"):
+            verb = "LIST"                     # collection GET
+        else:
+            verb = method
+        with k8s_call(verb, resource):
+            try:
+                resp = urllib.request.urlopen(req, context=self._ssl,
+                                              timeout=timeout)
+            except urllib.error.HTTPError as e:
+                msg = e.read().decode(errors="replace")[:512]
+                raise K8sApiError(e.code, msg) from e
+            except urllib.error.URLError as e:
+                raise K8sApiError(
+                    0, f"apiserver unreachable: {e.reason}") from e
+            if stream:
+                return resp
+            # body transfer + decode inside the timed block: on a big LIST
+            # the multi-MB body is the dominant cost, and excluding it
+            # would make the metric point at the wrong hop
+            with resp:
+                return json.loads(resp.read())
 
     # -- KubeClient ------------------------------------------------------------
 
@@ -556,19 +587,22 @@ class FakeKubeClient(KubeClient):
             self._nodes[node.get("metadata", {}).get("name", "")] = node
 
     def get_node(self, name: str) -> dict[str, Any]:
-        with self._lock:
-            node = self._nodes.get(name)
-            if node is None:
-                raise K8sApiError(404, f"node {name} not found")
-            return json.loads(json.dumps(node))
+        with k8s_call("GET", "nodes"):
+            with self._lock:
+                node = self._nodes.get(name)
+                if node is None:
+                    raise K8sApiError(404, f"node {name} not found")
+                return json.loads(json.dumps(node))
 
     def create_event(self, namespace: str,
                      event: dict[str, Any]) -> dict[str, Any]:
-        event = json.loads(json.dumps(event))
-        event.setdefault("metadata", {}).setdefault("namespace", namespace)
-        with self._lock:
-            self.events.append(event)
-        return event
+        with k8s_call("POST", "events"):
+            event = json.loads(json.dumps(event))
+            event.setdefault("metadata", {}).setdefault("namespace",
+                                                        namespace)
+            with self._lock:
+                self.events.append(event)
+            return event
 
     def set_pod_status(self, namespace: str, name: str,
                        **status: Any) -> None:
@@ -590,12 +624,18 @@ class FakeKubeClient(KubeClient):
 
     # -- KubeClient ------------------------------------------------------------
 
+    # Public KubeClient methods carry the same k8s_call instrumentation as
+    # the REST client, so a fake-stack e2e trace shows the identical
+    # apiserver child spans and k8s_request_seconds series production
+    # would — the instrumentation layer is part of the contract under test.
+
     def get_pod(self, namespace: str, name: str) -> objects.Pod:
-        with self._lock:
-            pod = self._pods.get((namespace, name))
-            if pod is None:
-                raise PodNotFoundError(namespace, name)
-            return json.loads(json.dumps(pod))
+        with k8s_call("GET", "pods"):
+            with self._lock:
+                pod = self._pods.get((namespace, name))
+                if pod is None:
+                    raise PodNotFoundError(namespace, name)
+                return json.loads(json.dumps(pod))
 
     def list_pods(self, namespace: str,
                   label_selector: str | None = None) -> list[objects.Pod]:
@@ -604,26 +644,28 @@ class FakeKubeClient(KubeClient):
     def list_pods_with_version(
             self, namespace: str, label_selector: str | None = None
     ) -> tuple[list[objects.Pod], str]:
-        with self._lock:
-            pods = [json.loads(json.dumps(p))
-                    for (ns, _), p in self._pods.items()
-                    if ns == namespace
-                    and _match_label_selector(p, label_selector)]
-            return pods, str(len(self._events))
+        with k8s_call("LIST", "pods"):
+            with self._lock:
+                pods = [json.loads(json.dumps(p))
+                        for (ns, _), p in self._pods.items()
+                        if ns == namespace
+                        and _match_label_selector(p, label_selector)]
+                return pods, str(len(self._events))
 
     def create_pod(self, namespace: str, pod: objects.Pod) -> objects.Pod:
-        pod = json.loads(json.dumps(pod))
-        pod.setdefault("metadata", {}).setdefault("namespace", namespace)
-        pod["metadata"].setdefault(
-            "uid", f"uid-{objects.name(pod)}")
-        pod.setdefault("status", {}).setdefault("phase", "Pending")
-        key = (namespace, objects.name(pod))
-        with self._lock:
-            if key in self._pods:
-                raise K8sApiError(409, f"pod {key} already exists")
-            self._pods[key] = pod
-            self.created.append(pod)
-            self._record("ADDED", pod)
+        with k8s_call("POST", "pods"):
+            pod = json.loads(json.dumps(pod))
+            pod.setdefault("metadata", {}).setdefault("namespace", namespace)
+            pod["metadata"].setdefault(
+                "uid", f"uid-{objects.name(pod)}")
+            pod.setdefault("status", {}).setdefault("phase", "Pending")
+            key = (namespace, objects.name(pod))
+            with self._lock:
+                if key in self._pods:
+                    raise K8sApiError(409, f"pod {key} already exists")
+                self._pods[key] = pod
+                self.created.append(pod)
+                self._record("ADDED", pod)
         for hook in list(self.on_create):
             threading.Thread(target=hook, args=(pod,), daemon=True).start()
         return json.loads(json.dumps(pod))
@@ -639,7 +681,7 @@ class FakeKubeClient(KubeClient):
             if pod is not None:
                 for hook in list(self.on_delete):
                     hook(pod)
-        with self._lock:
+        with k8s_call("DELETE", "pods"), self._lock:
             if resource_version is not None:
                 pod = self._pods.get((namespace, name))
                 if pod is not None:
@@ -663,7 +705,7 @@ class FakeKubeClient(KubeClient):
         patch = json.loads(json.dumps(patch))
         # the precondition is consumed here, not merged into the object
         patch.get("metadata", {}).pop("resourceVersion", None)
-        with self._lock:
+        with k8s_call("PATCH", "pods"), self._lock:
             pod = self._pods.get((namespace, name))
             if pod is None:
                 raise PodNotFoundError(namespace, name)

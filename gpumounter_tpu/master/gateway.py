@@ -21,7 +21,9 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 import urllib.parse
+import urllib.request
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -36,6 +38,7 @@ from gpumounter_tpu.utils.errors import (K8sApiError, PodNotFoundError,
                                          TopologyError)
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
+from gpumounter_tpu.utils.trace import STORE, Trace, annotate, span
 from gpumounter_tpu.worker.grpc_server import WorkerClient
 
 logger = get_logger("master.gateway")
@@ -94,21 +97,59 @@ _GRPC_HTTP = {
     grpc.StatusCode.DEADLINE_EXCEEDED: 504,
 }
 
+# Route labels for tpumounter_gateway_request_seconds{route} and for the
+# op field of master request traces. Fixed vocabulary — the histogram's
+# label cardinality must not scale with attacker-chosen paths.
+_ROUTE_LABELS = (
+    ("addtpu", lambda p: _ADD_RE.match(p) or _ADD_GPU_RE.match(p)),
+    ("removetpu", lambda p: _REMOVE_RE.match(p) or _REMOVE_GPU_RE.match(p)),
+    ("tpustatus", lambda p: _STATUS_RE.match(p)),
+    ("nodestatus", lambda p: _NODE_STATUS_RE.match(p)),
+)
+_PLAIN_ROUTES = {"/healthz": "healthz", "/version": "version",
+                 "/tracez": "tracez", "/addtpuslice": "addtpuslice",
+                 "/removetpuslice": "removetpuslice"}
+# Pure introspection requests would drown the mount traces in the ring
+# buffer; they are measured (histogram) but not stored.
+_UNTRACED_ROUTES = {"healthz", "version", "tracez", "unknown"}
+
+
+def _route_label(path: str) -> str:
+    p = urllib.parse.urlparse(path).path
+    for label, match in _ROUTE_LABELS:
+        if match(p):
+            return label
+    return _PLAIN_ROUTES.get(p, "unknown")
+
 
 class MasterGateway:
     """Route handling decoupled from the HTTP server so it is unit-testable;
     ``serve()`` wraps it in a ThreadingHTTPServer."""
 
     def __init__(self, kube: KubeClient, directory: WorkerDirectory,
-                 worker_client_factory=WorkerClient):
+                 worker_client_factory=WorkerClient,
+                 worker_tracez_base=None):
         self.kube = kube
         self.directory = directory
         self._worker_client_factory = worker_client_factory
+        # gRPC target "ip:port" -> base URL of that worker's health/tracez
+        # HTTP endpoint. The default follows the worker's fixed convention
+        # (health on grpc_port + 1, worker/main.py HEALTH_PORT_OFFSET);
+        # test stacks with ephemeral ports inject their own resolver.
+        self.worker_tracez_base = (worker_tracez_base
+                                   or self._default_tracez_base)
         # Per-target client cache: gRPC channels are long-lived by design;
         # re-dialing per request would put TCP+HTTP/2 setup on the
         # latency-benchmarked hot path.
         self._clients: dict[str, WorkerClient] = {}
         self._clients_lock = threading.Lock()
+
+    @staticmethod
+    def _default_tracez_base(target: str) -> str | None:
+        host, _, port = target.rpartition(":")
+        if not host or not port.isdigit():
+            return None
+        return f"http://{host}:{int(port) + 1}"
 
     def _client(self, target: str) -> WorkerClient:
         with self._clients_lock:
@@ -157,8 +198,18 @@ class MasterGateway:
                     "request_id": rid[:63]}
         else:
             rid = uuid.uuid4().hex[:12]
+        # Master-side request trace (route → resolve → dial → rpc): the
+        # master half of every SLO-counted second was previously invisible
+        # — only result counters moved here.
+        route = _route_label(path)
+        trace = Trace(route, rid) if route not in _UNTRACED_ROUTES else None
+        t0 = time.monotonic()
         try:
-            status, payload = self._route(method, path, body, rid)
+            if trace is not None:
+                with trace.activate():
+                    status, payload = self._route(method, path, body, rid)
+            else:
+                status, payload = self._route(method, path, body, rid)
         except PodNotFoundError as e:
             status, payload = 404, {"result": "PodNotFound",
                                     "message": str(e)}
@@ -179,6 +230,10 @@ class MasterGateway:
             # don't know — answer with JSON instead of dropping the socket
             status, payload = 502, {"result": "UnknownWorkerResult",
                                     "message": str(e)}
+        REGISTRY.gateway_requests.observe(time.monotonic() - t0, route=route)
+        if trace is not None:
+            trace.root.attrs.update(route=route, status=status)
+            trace.finish(str(payload.get("result", status)))
         # error paths especially need the id — they're what gets debugged
         payload.setdefault("request_id", rid)
         return status, payload
@@ -222,7 +277,103 @@ class MasterGateway:
             return self._slice_attach(body, rid)
         if parsed.path == "/removetpuslice" and method == "POST":
             return self._slice_detach(body, rid)
+        if parsed.path == "/tracez" and method == "GET":
+            return self._tracez(urllib.parse.parse_qs(parsed.query))
         return 404, {"result": "NoSuchRoute", "message": path}
+
+    # -- /tracez: trace introspection + master↔worker stitching ----------------
+
+    def _tracez(self, params: dict[str, list[str]]) -> tuple[int, dict]:
+        """Recent/slowest master traces; with ``rid=`` the master also
+        fetches the worker's spans for the same request id (over the
+        worker's health port) and grafts each worker trace under the
+        master trace's ``rpc`` span — ONE combined tree per request, the
+        cross-process view neither binary has alone."""
+        rid = (params.get("rid") or [None])[0]
+        result = (params.get("result") or [None])[0]
+        try:
+            limit = int((params.get("limit") or ["32"])[0])
+        except ValueError:
+            limit = 32
+        if not rid:
+            return 200, STORE.snapshot(result=result, limit=limit)
+        # deep-copy: grafting must never mutate the store's own entries
+        # (a second query would otherwise double-graft). Worker-op entries
+        # are excluded from the top level — in a split deployment they
+        # never appear in the master's store, and in a shared-process
+        # stack they would list once raw and again grafted.
+        traces = [json.loads(json.dumps(t)) for t in STORE.find(rid)
+                  if (result is None or t["result"] == result)
+                  and t["op"] not in self._WORKER_OPS]
+        errors: list[str] = []
+        worker_traces = self._fetch_worker_traces(traces, rid, errors)
+        for trace in traces:
+            self._graft_worker_spans(trace, worker_traces)
+        payload: dict = {"rid": rid, "traces": traces,
+                         "worker_traces": len(worker_traces)}
+        if errors:
+            payload["stitch_errors"] = errors
+        return (200 if traces else 404), payload
+
+    # worker ops whose traces belong under a master rpc span (a worker's
+    # /tracez can also hold foreign entries when master and worker share a
+    # process, as the in-process test stacks do)
+    _WORKER_OPS = ("attach", "detach", "status", "node_status")
+
+    def _fetch_worker_traces(self, traces: list[dict], rid: str,
+                             errors: list[str]) -> list[dict]:
+        """GET /tracez?rid= from every worker the master traces name."""
+        targets: list[str] = []
+        for trace in traces:
+            for rpc in _find_spans(trace.get("spans", {}), "rpc"):
+                worker = (rpc.get("attrs") or {}).get("worker")
+                if worker and worker not in targets:
+                    targets.append(worker)
+        fetched: list[dict] = []
+        for target in targets:
+            base = self.worker_tracez_base(target)
+            if not base:
+                continue
+            url = (f"{base}/tracez?"
+                   + urllib.parse.urlencode({"rid": rid}))
+            try:
+                with urllib.request.urlopen(url, timeout=5.0) as resp:
+                    remote = json.loads(resp.read())
+            except Exception as e:          # stitch is best-effort
+                errors.append(f"worker {target}: {e}")
+                continue
+            for entry in remote.get("recent", []):
+                if entry.get("op") in self._WORKER_OPS \
+                        and entry not in fetched:
+                    entry.setdefault("process", "worker")
+                    entry["worker"] = target
+                    fetched.append(entry)
+        return fetched
+
+    def _graft_worker_spans(self, trace: dict,
+                            worker_traces: list[dict]) -> None:
+        rpcs = _find_spans(trace.get("spans", {}), "rpc")
+        if not rpcs:
+            if worker_traces:
+                trace["worker_spans"] = [w["spans"] for w in worker_traces]
+            return
+        for rpc in rpcs:
+            rpc_worker = (rpc.get("attrs") or {}).get("worker")
+            for worker in worker_traces:
+                # graft only under the rpc that actually talked to this
+                # worker — a retried request has two rpc spans, a slice
+                # has one per host, and misplacing spans would make the
+                # waterfall lie about who did the work
+                if rpc_worker and worker.get("worker") \
+                        and worker["worker"] != rpc_worker:
+                    continue
+                child = dict(worker["spans"])
+                child["name"] = f"worker:{worker['op']}"
+                attrs = dict(child.get("attrs") or {})
+                attrs.update(result=worker.get("result"),
+                             worker=worker.get("worker"))
+                child["attrs"] = attrs
+                rpc.setdefault("children", []).append(child)
 
     # -- multi-host slice transactions (BASELINE config 5) ---------------------
 
@@ -285,24 +436,34 @@ class MasterGateway:
         """Resolve pod -> node -> worker and run ``fn(client)``. On
         UNAVAILABLE the cached worker IP is presumed dead (pod restarted):
         invalidate both caches and retry once against a fresh resolve."""
-        pod = self.kube.get_pod(namespace, pod_name)   # ref main.go:52-66
-        node = objects.node_name(pod)
-        if not node:
-            raise PodNotFoundError(namespace, pod_name)
+        with span("resolve", pod=f"{namespace}/{pod_name}"):
+            pod = self.kube.get_pod(namespace, pod_name)  # ref main.go:52-66
+            node = objects.node_name(pod)
+            if not node:
+                raise PodNotFoundError(namespace, pod_name)
+            annotate(node=node)
         return self._call_node_worker(node, fn)
 
     def _call_node_worker(self, node: str, fn):
-        target = self.directory.worker_target(node)
+        with span("dial", node=node):
+            target = self.directory.worker_target(node)
+            client = self._client(target)
+            annotate(worker=target)
         try:
-            return fn(self._client(target))
+            with span("rpc", node=node, worker=target):
+                return fn(client)
         except grpc.RpcError as e:
             if (not hasattr(e, "code")
                     or e.code() != grpc.StatusCode.UNAVAILABLE):
                 raise
             self._drop_client(target)
             self.directory.invalidate(node)
-            fresh = self.directory.worker_target(node)
-            return fn(self._client(fresh))
+            with span("dial", node=node, retry=True):
+                fresh = self.directory.worker_target(node)
+                client = self._client(fresh)
+                annotate(worker=fresh)
+            with span("rpc", node=node, worker=fresh, retry=True):
+                return fn(client)
 
     def _add(self, namespace: str, pod_name: str, tpu_num: int,
              entire: bool, rid: str = "-") -> tuple[int, dict]:
@@ -414,6 +575,16 @@ class MasterGateway:
         logger.info("master gateway serving on %s:%d", address,
                     server.server_port)
         return server
+
+
+def _find_spans(span_dict: dict, name: str) -> list[dict]:
+    """All spans named ``name`` in a span-tree dict, depth-first."""
+    hits = []
+    if span_dict.get("name") == name:
+        hits.append(span_dict)
+    for child in span_dict.get("children", []) or []:
+        hits.extend(_find_spans(child, name))
+    return hits
 
 
 def _parse_uuids(body: bytes, query: str) -> list[str]:

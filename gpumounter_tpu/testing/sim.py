@@ -318,23 +318,33 @@ class LiveStack:
         from gpumounter_tpu.master.discovery import WorkerDirectory
         from gpumounter_tpu.master.gateway import MasterGateway
         from gpumounter_tpu.worker.grpc_server import build_server
+        from gpumounter_tpu.worker.main import start_health_server
 
         self.rig = rig
         self.grpc_server, grpc_port = build_server(rig.service, port=0,
                                                    address="127.0.0.1")
         self.grpc_port = grpc_port
         self.grpc_server.start()
+        # the worker's real health/metrics/tracez sidecar port, on an
+        # ephemeral port (production convention is grpc_port + 1, which an
+        # ephemeral gRPC bind can't honour) — the master's /tracez stitch
+        # resolves it through worker_tracez_base below
+        self.health_server = start_health_server(0)
+        health_port = self.health_server.server_port
         self.master_kube = FakeKubeClient()
         self.master_kube.put_pod(worker_pod(rig.sim.node, "127.0.0.1"))
         self.master_kube.put_pod(rig.pod)
         self.gateway = MasterGateway(
             self.master_kube,
-            WorkerDirectory(self.master_kube, grpc_port=grpc_port))
+            WorkerDirectory(self.master_kube, grpc_port=grpc_port),
+            worker_tracez_base=lambda target:
+                f"http://127.0.0.1:{health_port}")
         self.http_server = self.gateway.serve(port=0, address="127.0.0.1")
         self.base = f"http://127.0.0.1:{self.http_server.server_port}"
 
     def close(self) -> None:
         self.http_server.shutdown()
+        self.health_server.shutdown()
         self.grpc_server.stop(grace=0)
         self.rig.close()
 

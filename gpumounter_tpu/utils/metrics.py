@@ -203,7 +203,7 @@ def _fmt_num(v: float) -> str:
 class Gauge:
     def __init__(self, name: str, help_text: str):
         self.name = name
-        self.help_text = help_text
+        self.help = help_text     # same attribute as Counter/Histogram
         self._values: dict[tuple, float] = {}
         self._lock = threading.Lock()
 
@@ -216,7 +216,7 @@ class Gauge:
             return self._values.get(tuple(sorted(labels.items())), 0.0)
 
     def render(self) -> Iterator[str]:
-        yield f"# HELP {self.name} {self.help_text}"
+        yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} gauge"
         with self._lock:
             for labels, value in sorted(self._values.items()):
@@ -281,15 +281,46 @@ class Registry:
         self.detach_phase = LabeledHistogram(
             "tpumounter_detach_phase_seconds",
             "RemoveTPU latency by phase (resolve/actuate/cleanup)")
+        # Master-side request latency by route (addtpu/removetpu/...): the
+        # master previously recorded no latency at all — only the worker's
+        # phases were timed, leaving the HTTP half of every SLO-counted
+        # second invisible.
+        self.gateway_requests = LabeledHistogram(
+            "tpumounter_gateway_request_seconds",
+            "Master gateway HTTP request latency by route")
+        # Every apiserver / kubelet PodResources round-trip, by verb and
+        # resource (pods/nodes/events/podresources) — the per-hop
+        # decomposition of the control plane's blind spots. Buckets skew
+        # low: a healthy apiserver call is milliseconds, and the question
+        # these answer is "which hop ate the attach budget".
+        self.k8s_latency = LabeledHistogram(
+            "tpumounter_k8s_request_seconds",
+            "Kubernetes apiserver and kubelet PodResources call latency "
+            "by verb and resource",
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                     5.0, 10.0, 30.0))
+        self.k8s_errors = Counter(
+            "tpumounter_k8s_request_errors_total",
+            "Kubernetes apiserver and kubelet calls that raised, by verb "
+            "and resource (includes expected 404s — same convention as "
+            "client-go's rest_client metrics)")
+        # Identifies the build on every /metrics surface (standard
+        # <name>_info pattern: constant 1, the payload is the label).
+        from gpumounter_tpu import __version__
+        self.build_info = Gauge(
+            "tpumounter_build_info",
+            "Build identity of this binary (value is always 1; the "
+            "version label carries the payload)")
+        self.build_info.set(1, version=__version__)
+
+    def families(self) -> list:
+        """Every registered metric family, in registration order — the
+        single source for rendering and for the naming-convention lint."""
+        return [m for m in vars(self).values() if hasattr(m, "render")]
 
     def render_text(self) -> str:
         lines: list[str] = []
-        for metric in (self.attach_latency, self.detach_latency,
-                       self.attach_results, self.detach_results,
-                       self.chips, self.orphans_reclaimed,
-                       self.pool_hits, self.pool_misses,
-                       self.warm_pool_size, self.pool_refill_latency,
-                       self.attach_phase, self.detach_phase):
+        for metric in self.families():
             lines.extend(metric.render())
         return "\n".join(lines) + "\n"
 

@@ -18,6 +18,7 @@ from gpumounter_tpu.api import tpu_mount_pb2 as pb
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.errors import MountPolicyError, TPUMounterError
 from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.trace import Trace
 from gpumounter_tpu.worker.service import TPUMountService
 
 logger = get_logger("worker.grpc")
@@ -86,14 +87,24 @@ def _status_handler(service: TPUMountService):
     def handle(request: pb.TPUStatusRequest,
                context: grpc.ServicerContext) -> pb.TPUStatusResponse:
         from gpumounter_tpu.utils.errors import PodNotFoundError
+        # Status RPCs get a trace too: they are the read path operators
+        # lean on while debugging, and they hit both the apiserver and the
+        # kubelet — the k8s child spans join via trace.activate().
+        trace = Trace("status", _request_id(context))
+        result = "EXCEPTION"
         try:
-            mount_type, chips = service.tpu_status(request.pod_name,
-                                                   request.namespace)
+            with trace.activate():
+                mount_type, chips = service.tpu_status(request.pod_name,
+                                                       request.namespace)
+            result = "SUCCESS"
         except PodNotFoundError as e:
+            result = "POD_NOT_FOUND"
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         except TPUMounterError as e:
             logger.exception("TPUStatus internal failure")
             context.abort(grpc.StatusCode.INTERNAL, str(e))
+        finally:
+            trace.finish(result)
         resp = pb.TPUStatusResponse(mount_type=mount_type.value)
         for chip in chips:
             entry = resp.chips.add(device_id=chip.device_id,
@@ -107,11 +118,17 @@ def _status_handler(service: TPUMountService):
 def _node_status_handler(service: TPUMountService):
     def handle(request: pb.TPUNodeStatusRequest,
                context: grpc.ServicerContext) -> pb.TPUNodeStatusResponse:
+        trace = Trace("node_status", _request_id(context))
+        result = "EXCEPTION"
         try:
-            chips = service.node_status()
+            with trace.activate():
+                chips = service.node_status()
+            result = "SUCCESS"
         except TPUMounterError as e:
             logger.exception("TPUNodeStatus internal failure")
             context.abort(grpc.StatusCode.INTERNAL, str(e))
+        finally:
+            trace.finish(result)
         resp = pb.TPUNodeStatusResponse(
             node=service.settings.node_name)
         for chip in chips:

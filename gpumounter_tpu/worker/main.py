@@ -44,6 +44,25 @@ class _HealthHandler(BaseHTTPRequestHandler):
             body = REGISTRY.render_text().encode()
             ctype = "text/plain; version=0.0.4"
             code = 200
+        elif self.path.split("?", 1)[0] == "/tracez":
+            # recent + slowest completed traces (span trees), filterable
+            # by rid= and result= — the master stitches this node's view
+            # into its own for cross-process request archaeology
+            import json
+            import urllib.parse
+            from gpumounter_tpu.utils.trace import STORE
+            params = urllib.parse.parse_qs(
+                urllib.parse.urlparse(self.path).query)
+            try:
+                limit = int((params.get("limit") or ["32"])[0])
+            except ValueError:
+                limit = 32
+            body = json.dumps(STORE.snapshot(
+                rid=(params.get("rid") or [None])[0],
+                result=(params.get("result") or [None])[0],
+                limit=limit)).encode()
+            ctype = "application/json"
+            code = 200
         elif self.path == "/poolz":
             # warm-pool introspection: targets vs live counts, hit/miss
             import json

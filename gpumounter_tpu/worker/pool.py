@@ -38,6 +38,7 @@ from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.errors import K8sApiError, PodNotFoundError
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
+from gpumounter_tpu.utils.trace import annotate, span as trace_span
 
 logger = get_logger("worker.pool")
 
@@ -136,6 +137,14 @@ class PoolManager:
         if not self.enabled or count <= 0:
             return []
         key = pool_key(entire, tpus_per_pod)
+        with trace_span("pool.claim", key=key, requested=count):
+            return self._claim(owner, key, count, txn_id=txn_id,
+                               request_id=request_id,
+                               extra_labels=extra_labels)
+
+    def _claim(self, owner: objects.Pod, key: str, count: int,
+               txn_id: str = "", request_id: str = "",
+               extra_labels: dict[str, str] | None = None) -> list[str]:
         try:
             warm = self._list_warm()
         except K8sApiError as e:
@@ -144,6 +153,7 @@ class PoolManager:
             # new hard-failure mode to the attach.
             logger.warning("warm LIST failed, treating as miss: %s", e)
             REGISTRY.pool_misses.inc(count)
+            annotate(adopted=0, list_failed=True)
             return []
         candidates = sorted(
             (p for p in warm
@@ -196,6 +206,7 @@ class PoolManager:
                         len(claimed), count, claimed,
                         objects.namespace(owner), objects.name(owner))
             self.notify()           # refill asynchronously, off this path
+        annotate(adopted=len(claimed))
         return claimed
 
     def notify(self) -> None:

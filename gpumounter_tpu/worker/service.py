@@ -148,6 +148,8 @@ class TPUMountService:
                 is_entire_mount: bool, txn_id: str = "",
                 request_id: str = "") -> AddOutcome:
         trace = Trace("attach", request_id or txn_id)
+        trace.root.attrs.update(pod=f"{namespace}/{pod_name}",
+                                tpus=tpu_num, entire=is_entire_mount)
         result_name = "EXCEPTION"
         try:
             with REGISTRY.attach_latency.time():
@@ -165,6 +167,9 @@ class TPUMountService:
                                                 is_entire_mount, txn_id,
                                                 request_id, trace=trace)
             result_name = outcome.result.name
+            trace.root.attrs.update(chips=len(outcome.chips),
+                                    pool_hits=outcome.pool_hits,
+                                    pool_misses=outcome.pool_misses)
         except MountPolicyError:
             # a routine, expected denial (gRPC FAILED_PRECONDITION) — not
             # the "worker blew up" signal EXCEPTION must keep meaning
@@ -298,6 +303,8 @@ class TPUMountService:
                    force: bool, txn_id: str = "",
                    request_id: str = "") -> RemoveOutcome:
         trace = Trace("detach", request_id or txn_id)
+        trace.root.attrs.update(pod=f"{namespace}/{pod_name}",
+                                uuids=len(uuids), force=force)
         result_name = "EXCEPTION"
         try:
             with REGISTRY.detach_latency.time():

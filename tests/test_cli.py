@@ -180,6 +180,73 @@ def test_slice_remove_retry_converges(fake_host, tmp_path, monkeypatch):
         stack.close()
 
 
+def test_exposition_round_trip_registry_to_parser():
+    """Both ends of the hand-rolled text format guard each other: a fully
+    populated Registry must render text that cli._parse_exposition parses
+    back into EVERY series with its exact value — histogram buckets,
+    sums/counts, labeled counters, gauges, build_info included."""
+    from gpumounter_tpu.utils.metrics import Registry
+    reg = Registry()
+    reg.attach_latency.observe(0.3)
+    reg.attach_latency.observe(7.5)
+    reg.detach_latency.observe(0.01)
+    reg.attach_results.inc(result="SUCCESS")
+    reg.attach_results.inc(2, result="EXCEPTION")
+    reg.chips.set(3, state="free")
+    reg.chips.set(1, state="allocated")
+    reg.warm_pool_size.set(2, key="entire:4")
+    reg.pool_refill_latency.observe(1.25)
+    reg.attach_phase.observe(0.2, phase="allocate")
+    reg.attach_phase.observe(0.05, phase="actuate")
+    reg.detach_phase.observe(0.1, phase="cleanup")
+    reg.gateway_requests.observe(0.4, route="addtpu")
+    reg.k8s_latency.observe(0.02, verb="GET", resource="pods")
+    reg.k8s_errors.inc(verb="LIST", resource="pods")
+
+    text = reg.render_text()
+    parsed = cli._parse_exposition(text)
+
+    reproduced = 0
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name = line.partition("{")[0].split()[0]
+        value = float(line.rsplit(" ", 1)[1])
+        labels = {}
+        if "{" in line:
+            inner = line.partition("{")[2].rpartition("}")[0]
+            for part in inner.split(","):
+                k, _, v = part.partition("=")
+                labels[k] = v.strip('"')
+        key = tuple(sorted(labels.items()))
+        assert name in parsed, line
+        assert parsed[name].get(key) == value, line
+        reproduced += 1
+    # every rendered series came back out, and there were plenty of them
+    assert reproduced == sum(len(s) for s in parsed.values())
+    assert reproduced > 60
+    # spot checks through the parser's own accessors
+    assert cli._counter_total(parsed, "tpumounter_attach_total",
+                              result="EXCEPTION") == 2
+    p50 = cli._histogram_quantile(parsed, "tpumounter_attach_phase_seconds",
+                                  0.5, phase="allocate")
+    assert p50 is not None and 0 < p50 <= 0.25
+    assert parsed["tpumounter_build_info"]
+
+
+def test_doctor_reports_version_and_slowest_trace(live_stack):
+    """Satellites: doctor surfaces the scraped tpumounter_build_info
+    version, and the slowest stored trace with its dominant span."""
+    import gpumounter_tpu
+    _, base = live_stack
+    run_cli(base, "add", "workload", "--tpus", "1")
+    rc, out = run_cli(base, "doctor")
+    assert f"target version {gpumounter_tpu.__version__}" in out
+    assert "slowest stored trace" in out
+    assert "dominant span" in out
+    assert "tpumounterctl trace" in out
+
+
 def test_doctor_healthy_stack(live_stack):
     """The global REGISTRY accumulates across the whole test process, so
     expectations derive from its current state instead of assuming zeros

@@ -1,0 +1,58 @@
+"""Metric naming lint: every registered family must follow the repo's
+Prometheus conventions, so a new metric can't silently break the shipped
+dashboards/alerts (which select on the ``tpumounter_`` prefix and the
+unit suffixes)."""
+
+import re
+
+from gpumounter_tpu.utils import metrics
+
+
+NAME_RE = re.compile(r"^tpumounter_[a-z0-9_]+$")
+
+# Gauges describe a current level, named for the noun they measure (or the
+# standard _info pattern); cumulative/unit suffixes on a gauge would lie
+# about its semantics to every PromQL consumer.
+GAUGE_FORBIDDEN_SUFFIXES = ("_total", "_seconds", "_count", "_sum")
+
+
+def test_every_family_matches_naming_convention():
+    reg = metrics.Registry()
+    families = reg.families()
+    assert len(families) >= 12          # the registry is non-trivial
+    for fam in families:
+        assert NAME_RE.match(fam.name), \
+            f"{fam.name}: not tpumounter_[a-z0-9_]+"
+        if isinstance(fam, metrics.Counter):
+            assert fam.name.endswith("_total"), \
+                f"counter {fam.name} must end in _total"
+        elif isinstance(fam, (metrics.Histogram, metrics.LabeledHistogram)):
+            assert fam.name.endswith("_seconds"), \
+                f"histogram {fam.name} must end in _seconds (this repo " \
+                "only measures durations)"
+        elif isinstance(fam, metrics.Gauge):
+            assert not fam.name.endswith(GAUGE_FORBIDDEN_SUFFIXES), \
+                f"gauge {fam.name} carries a counter/unit suffix"
+        else:
+            raise AssertionError(f"unknown family type {type(fam)}")
+
+
+def test_every_family_has_help_and_renders_headers():
+    reg = metrics.Registry()
+    for fam in reg.families():
+        # uniform attribute across Counter/Histogram/Gauge (the Gauge used
+        # to store help_text, breaking generic consumers)
+        assert isinstance(fam.help, str) and fam.help, fam.name
+        rendered = list(fam.render())
+        assert rendered[0] == f"# HELP {fam.name} {fam.help}"
+        assert rendered[1].startswith(f"# TYPE {fam.name} ")
+
+
+def test_build_info_identifies_the_binary():
+    import gpumounter_tpu
+    reg = metrics.Registry()
+    assert reg.build_info.value(
+        version=gpumounter_tpu.__version__) == 1.0
+    text = reg.render_text()
+    assert (f'tpumounter_build_info{{version='
+            f'"{gpumounter_tpu.__version__}"}} 1') in text

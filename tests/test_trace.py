@@ -1,12 +1,18 @@
-"""Per-request phase tracing (utils/trace.py + the labeled phase
-histograms): the attach/detach latency decomposition the reference never
-had (SURVEY.md §5: no tracing/profiling of any kind)."""
+"""Per-request tracing (utils/trace.py: span trees, contextvars
+propagation, the TraceStore ring buffer) + the labeled phase histograms:
+the attach/detach latency decomposition the reference never had
+(SURVEY.md §5: no tracing/profiling of any kind)."""
+
+import json
+import urllib.request
+import uuid
 
 import pytest
 
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.metrics import REGISTRY, LabeledHistogram
-from gpumounter_tpu.utils.trace import Trace
+from gpumounter_tpu.utils.trace import (NO_STORE, STORE, Trace, TraceStore,
+                                        annotate, current_span, span)
 
 from tests.helpers import WorkerRig
 
@@ -131,6 +137,170 @@ def test_labeled_histogram_labelless_series_renders_plain():
     text = "\n".join(hist.render())
     assert 'z_seconds_bucket{le="1"} 1' in text
     assert "{," not in text              # no malformed leading comma
+
+
+def test_span_tree_nests_under_active_phase():
+    """Module-level span() joins the active trace's current phase via the
+    contextvar — the deep-layer propagation the tentpole is built on."""
+    trace = Trace("attach", "rid-tree")
+    with trace.span("allocate"):
+        with span("k8s.post", resource="pods"):
+            with span("inner"):
+                pass
+        with span("k8s.list", resource="pods"):
+            pass
+    trace.finish("SUCCESS", store=NO_STORE)
+    allocate = trace.root.children[0]
+    assert [c.name for c in allocate.children] == ["k8s.post", "k8s.list"]
+    assert allocate.children[0].children[0].name == "inner"
+    assert allocate.children[0].attrs == {"resource": "pods"}
+    # the flat phase view stays flat: nested spans never become phases
+    assert set(trace.spans) == {"allocate"}
+
+
+def test_span_without_active_trace_is_noop():
+    assert current_span() is None
+    with span("orphan") as got:
+        assert got is None          # body still ran
+    annotate(ignored=True)          # no-op, must not raise
+
+
+def test_trace_span_does_not_nest_into_foreign_trace():
+    """A trace opened while another trace's span is current (the master's
+    request trace around a slice transaction) keeps its own tree."""
+    outer = Trace("request", "rid-outer")
+    with outer.activate():
+        inner = Trace("slice_attach", "rid-inner")
+        with inner.span("validate"):
+            pass
+    assert [c.name for c in inner.root.children] == ["validate"]
+    assert outer.root.children == []
+
+
+def test_trace_finish_lands_in_store_with_result_and_attrs():
+    store = TraceStore()
+    trace = Trace("attach", "rid-s1")
+    trace.root.attrs["chips"] = 4
+    with trace.span("actuate"):
+        pass
+    trace.finish("SUCCESS", store=store)
+    (entry,) = store.find("rid-s1")
+    assert entry["op"] == "attach" and entry["result"] == "SUCCESS"
+    assert entry["spans"]["attrs"] == {"chips": 4}
+    assert entry["spans"]["children"][0]["name"] == "actuate"
+    assert entry["total_ms"] >= entry["spans"]["children"][0]["duration_ms"]
+
+
+def test_trace_store_ring_is_bounded_and_keeps_slowest():
+    store = TraceStore(recent_max=5, slowest_max=2)
+    slow = Trace("attach", "rid-slow")
+    slow._t0 -= 10.0                # fake a 10s-old start: slowest entry
+    slow.finish("SUCCESS", store=store)
+    for i in range(20):
+        Trace("attach", f"rid-{i}").finish("SUCCESS", store=store)
+    assert len(store.recent(limit=100)) == 5
+    assert store.find("rid-slow") == []          # rotated out of recent
+    slowest = store.slowest()
+    assert len(slowest) == 2
+    assert slowest[0]["rid"] == "rid-slow"       # survived in the top-N
+
+
+def test_trace_store_filters():
+    store = TraceStore()
+    t1 = Trace("attach", "rid-a")
+    t1.finish("SUCCESS", store=store)
+    t2 = Trace("detach", "rid-a")
+    t2.finish("EXCEPTION", store=store)
+    assert [t["op"] for t in store.recent(rid="rid-a")] == \
+        ["detach", "attach"]                     # newest first
+    assert [t["op"] for t in store.recent(rid="rid-a",
+                                          result="EXCEPTION")] == ["detach"]
+    snap = store.snapshot(rid="rid-a", result="SUCCESS")
+    assert [t["op"] for t in snap["recent"]] == ["attach"]
+    assert all(t["result"] == "SUCCESS" for t in snap["slowest"])
+
+
+def test_attach_trace_carries_k8s_child_spans(rig):
+    """The blind spots, lit: apiserver and kubelet round-trips appear as
+    k8s.* child spans inside the phases, and feed the
+    tpumounter_k8s_request_seconds{verb,resource} family."""
+    lists_before = REGISTRY.k8s_latency.count(verb="LIST",
+                                              resource="podresources")
+    rid = "trace-k8s-" + uuid.uuid4().hex[:8]
+    out = rig.service.add_tpu("workload", "default", 2, False,
+                              request_id=rid)
+    assert out.result is consts.AddResult.SUCCESS
+    (entry,) = STORE.find(rid)
+
+    def names(span_dict):
+        yield span_dict["name"]
+        for child in span_dict.get("children", []):
+            yield from names(child)
+
+    seen = list(names(entry["spans"]))
+    assert "k8s.get" in seen          # policy's get_pod
+    assert "k8s.list" in seen         # kubelet snapshot / slave LISTs
+    assert "scheduler.wait" in seen and "kubelet.resolve" in seen
+    # metrics moved with the spans
+    assert REGISTRY.k8s_latency.count(
+        verb="LIST", resource="podresources") > lists_before
+    assert REGISTRY.k8s_latency.count(verb="GET", resource="pods") > 0
+    text = REGISTRY.render_text()
+    assert ('tpumounter_k8s_request_seconds_bucket{resource="podresources"'
+            ',verb="LIST",le="0.005"}') in text
+    assert "tpumounter_k8s_request_errors_total" in text
+
+
+def test_warm_pool_claim_joins_attach_trace(fake_host):
+    rig = WorkerRig(fake_host, warm_pool={"entire:2": 1})
+    try:
+        rig.fill_warm_pool()
+        rid = "trace-pool-" + uuid.uuid4().hex[:8]
+        out = rig.service.add_tpu("workload", "default", 2, True,
+                                  request_id=rid)
+        assert out.result is consts.AddResult.SUCCESS
+        assert out.pool_hits == 1
+        (entry,) = STORE.find(rid)
+        allocate = next(c for c in entry["spans"]["children"]
+                        if c["name"] == "allocate")
+        claim = next(c for c in allocate["children"]
+                     if c["name"] == "pool.claim")
+        assert claim["attrs"]["key"] == "entire:2"
+        assert claim["attrs"]["adopted"] == 1
+        assert entry["spans"]["attrs"]["pool_hits"] == 1
+    finally:
+        rig.close()
+
+
+def test_failed_attach_trace_reaches_worker_tracez(rig):
+    """Satellite: an attach whose actuation raises still records every
+    phase it ran plus rollback, lands in the store as EXCEPTION, and is
+    served by the worker health port's /tracez — the breakdown matters
+    most exactly then."""
+    from gpumounter_tpu.utils.errors import ActuationError
+    from gpumounter_tpu.worker.main import start_health_server
+    rig.actuator.fail_on_create = True
+    rid = "trace-fail-" + uuid.uuid4().hex[:8]
+    with pytest.raises(ActuationError):
+        rig.service.add_tpu("workload", "default", 2, False,
+                            request_id=rid)
+    (entry,) = STORE.find(rid)
+    assert entry["result"] == "EXCEPTION"
+    phases = [c["name"] for c in entry["spans"]["children"]]
+    for phase in ("policy", "allocate", "resolve", "actuate", "rollback"):
+        assert phase in phases, phase
+    server = start_health_server(0)
+    try:
+        url = (f"http://127.0.0.1:{server.server_port}/tracez"
+               f"?rid={rid}&result=EXCEPTION")
+        with urllib.request.urlopen(url) as resp:
+            payload = json.loads(resp.read())
+    finally:
+        server.shutdown()
+    assert [t["rid"] for t in payload["recent"]] == [rid]
+    assert payload["recent"][0]["result"] == "EXCEPTION"
+    assert "rollback" in [c["name"]
+                          for c in payload["recent"][0]["spans"]["children"]]
 
 
 def test_failed_mount_records_rollback_span(rig):

@@ -1,0 +1,151 @@
+"""End-to-end request tracing over the live stack: the master's request
+trace (route/resolve/dial/rpc), the gateway/k8s metric families, and the
+/tracez stitch — ``GET /tracez?rid=X`` on the master returns ONE combined
+tree holding both the master-side spans and the worker's phase spans for
+the same request id (fetched over the worker's health port)."""
+
+import json
+import urllib.error
+import urllib.request
+import uuid
+
+import pytest
+
+from gpumounter_tpu import cli
+from tests.helpers import LiveStack, WorkerRig
+
+
+@pytest.fixture
+def live_stack(fake_host):
+    stack = LiveStack(WorkerRig(fake_host, use_kubelet_socket=True))
+    yield stack
+    stack.close()
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _span_names(span_dict):
+    yield span_dict["name"]
+    for child in span_dict.get("children", []):
+        yield from _span_names(child)
+
+
+def _attach(base, rid, tpus=2, entire="false"):
+    status, body = _get(
+        f"{base}/addtpu/namespace/default/pod/workload/tpu/{tpus}"
+        f"/isEntireMount/{entire}", headers={"X-Request-Id": rid})
+    assert status == 200 and body["result"] == "SUCCESS", body
+    return body
+
+
+def test_master_tracez_returns_stitched_master_and_worker_spans(live_stack):
+    base = live_stack.base
+    rid = "e2e-stitch-" + uuid.uuid4().hex[:8]
+    _attach(base, rid)
+
+    status, payload = _get(f"{base}/tracez?rid={rid}")
+    assert status == 200
+    assert payload["rid"] == rid
+    assert payload.get("stitch_errors") is None, payload
+    # the master kept exactly one request trace for this rid
+    (trace,) = [t for t in payload["traces"] if t["op"] == "addtpu"]
+    assert trace["result"] == "SUCCESS"
+    names = list(_span_names(trace["spans"]))
+    # master-side hops...
+    for name in ("resolve", "dial", "rpc"):
+        assert name in names, name
+    # ...and the worker's phase spans, grafted under the rpc span
+    (rpc,) = [s for s in trace["spans"]["children"] if s["name"] == "rpc"]
+    (worker,) = [c for c in rpc.get("children", [])
+                 if c["name"] == "worker:attach"]
+    worker_names = list(_span_names(worker))
+    for phase in ("policy", "allocate", "resolve", "actuate"):
+        assert phase in worker_names, phase
+    assert worker["attrs"]["result"] == "SUCCESS"
+    # the worker's own deep spans rode along (kubelet snapshot et al)
+    assert "k8s.list" in worker_names
+
+
+def test_tracez_unknown_rid_is_404_and_plain_view_lists_recent(live_stack):
+    base = live_stack.base
+    rid = "e2e-miss-" + uuid.uuid4().hex[:8]
+    status, payload = _get(f"{base}/tracez?rid={rid}")
+    assert status == 404 and payload["traces"] == []
+
+    done = "e2e-plain-" + uuid.uuid4().hex[:8]
+    _attach(base, done)
+    status, payload = _get(f"{base}/tracez")
+    assert status == 200
+    assert any(t["rid"] == done for t in payload["recent"])
+    assert "slowest" in payload
+
+
+def test_gateway_request_histogram_by_route(live_stack):
+    base = live_stack.base
+    rid = "e2e-hist-" + uuid.uuid4().hex[:8]
+    _attach(base, rid)
+    with urllib.request.urlopen(f"{base}/metrics") as resp:
+        text = resp.read().decode()
+    assert 'tpumounter_gateway_request_seconds_count{route="addtpu"}' in text
+    assert 'tpumounter_k8s_request_seconds' in text
+    assert 'tpumounter_build_info{version=' in text
+
+
+def test_cli_trace_renders_stitched_waterfall(live_stack):
+    import contextlib
+    import io
+    base = live_stack.base
+    rid = "e2e-cli-" + uuid.uuid4().hex[:8]
+    _attach(base, rid)
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli.main(["--master", base, "trace", rid])
+    text = out.getvalue()
+    assert rc == 0, text
+    assert f"trace {rid} op=addtpu result=SUCCESS" in text
+    for name in ("resolve", "rpc", "worker:attach", "allocate", "actuate"):
+        assert name in text, name
+    assert "|" in text and "#" in text          # the waterfall bars
+
+    # unknown rid: explicit miss, scriptable exit code
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli.main(["--master", base, "trace", "nope-" + rid])
+    assert rc == cli.EXIT_OTHER
+    assert "no stored trace" in out.getvalue()
+
+
+def test_worker_tracez_serves_rid_filtered_span_trees(live_stack):
+    """The worker health port's /tracez — the endpoint the master's
+    stitch consumes — answers rid/result-filtered span trees directly."""
+    base = live_stack.base
+    worker_base = f"http://127.0.0.1:{live_stack.health_server.server_port}"
+    rid = "e2e-worker-" + uuid.uuid4().hex[:8]
+    _attach(base, rid)
+    status, payload = _get(f"{worker_base}/tracez?rid={rid}")
+    assert status == 200
+    attaches = [t for t in payload["recent"] if t["op"] == "attach"]
+    assert len(attaches) == 1
+    assert attaches[0]["result"] == "SUCCESS"
+    assert "allocate" in [c["name"]
+                          for c in attaches[0]["spans"]["children"]]
+    # result filter: nothing failed under this rid
+    status, payload = _get(
+        f"{worker_base}/tracez?rid={rid}&result=EXCEPTION")
+    assert payload["recent"] == []
+    # each master trace grafts each worker trace exactly once
+    status, payload = _get(f"{base}/tracez?rid={rid}")
+    (trace,) = [t for t in payload["traces"] if t["op"] == "addtpu"]
+    (rpc,) = [s for s in trace["spans"]["children"] if s["name"] == "rpc"]
+    workers = [c for c in rpc.get("children", [])
+               if c["name"].startswith("worker:")]
+    assert len(workers) == 1

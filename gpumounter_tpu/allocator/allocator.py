@@ -45,6 +45,7 @@ from gpumounter_tpu.utils.errors import (AllocationTimeoutError,
                                          DeviceNotFoundError,
                                          InsufficientTPUError, K8sApiError)
 from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.retry import retryable
 from gpumounter_tpu.utils.trace import annotate, span as trace_span
 
 logger = get_logger("allocator")
@@ -422,9 +423,15 @@ class TPUAllocator:
                         if not pending:
                             return
             except K8sApiError as e:
-                if e.status != 410:
+                # 410: version expired. Transient (429/5xx/status-0 beyond
+                # the client's own resume budget): the wait survives by
+                # re-seeding too — the deadline, not one broken stream,
+                # decides when this state machine gives up.
+                if e.status != 410 and not retryable(e):
                     raise
-                rv = sync()     # version expired: re-seed from a fresh LIST
+                logger.warning("slave-pod watch interrupted (%s); "
+                               "re-seeding from a fresh LIST", e)
+                rv = sync()     # re-seed from a fresh LIST
 
     @staticmethod
     def _note_pod_state(pod: objects.Pod | None, pending: set[str]) -> None:
@@ -523,15 +530,22 @@ class TPUAllocator:
     # -- slave pod deletion (ref allocator.go:129-157 DeleteSlavePods) ---------
 
     def delete_slave_pods(self, names: Iterable[str],
-                          wait: bool = True) -> None:
+                          wait: bool = True) -> list[str]:
+        """Delete the named slave pods; returns the names whose delete
+        FAILED (apiserver error beyond the client's retries) so rollback
+        paths can journal the leftover instead of assuming clean state.
+        404s count as success — the pod being gone is the goal."""
         names = list(names)
+        failed: list[str] = []
         for name in names:
             try:
                 self.kube.delete_pod(self.settings.pool_namespace, name)
             except K8sApiError as e:
                 logger.warning("delete slave pod %s: %s", name, e)
+                failed.append(name)
         if wait:
-            self._wait_deleted(names)
+            self._wait_deleted([n for n in names if n not in failed])
+        return failed
 
     def _wait_deleted(self, names: list[str]) -> None:
         """Watch until every pod is gone (replaces checkDeleteState,
@@ -569,8 +583,10 @@ class TPUAllocator:
                         if not pending:
                             return
             except K8sApiError as e:
-                if e.status != 410:
+                if e.status != 410 and not retryable(e):
                     raise
+                # sync() also prunes pods already gone, so a DELETED event
+                # lost to the broken stream cannot wedge the wait
                 rv = sync()
 
     # -- mount type (ref allocator.go:159-187 GetMountType) --------------------

@@ -550,6 +550,58 @@ def cmd_doctor(args) -> int:
         else:
             check("ok", f"no attaches recorded — {scope}")
 
+    if metrics:
+        # Resilience layer: circuit breakers are CURRENT state (a gauge),
+        # so an open circuit may page — it means a worker is failing fast
+        # right now. Retry volume is cumulative: windowed deltas judge
+        # current flakiness, lifetime totals only inform.
+        circuits = metrics.get("tpumounter_circuit_state", {})
+        open_targets = sorted(dict(labels).get("target", "?")
+                              for labels, value in circuits.items()
+                              if value >= 2)
+        half_open = sorted(dict(labels).get("target", "?")
+                           for labels, value in circuits.items()
+                           if value == 1)
+        if open_targets:
+            check("crit", f"circuit OPEN for {', '.join(open_targets)} — "
+                          "those workers are failing fast (429s)")
+        elif half_open:
+            check("warn", f"circuit half-open (probing) for "
+                          f"{', '.join(half_open)}")
+        elif circuits:
+            check("ok", f"all {len(circuits)} circuit(s) closed")
+        src = metrics_delta if metrics_delta is not None else metrics
+        scope = (f"in the last {window:g}s" if metrics_delta is not None
+                 else "lifetime")
+        retries = _counter_total(src, "tpumounter_retry_attempts_total")
+        check("warn" if (metrics_delta is not None and retries) else "ok",
+              f"transient-fault retries absorbed: {int(retries)} — {scope}")
+        replay_failures = _counter_total(
+            src, "tpumounter_journal_replays_total", outcome="failed")
+        replays = _counter_total(src, "tpumounter_journal_replays_total")
+        if replay_failures:
+            check("warn", f"journal replays unresolved: "
+                          f"{int(replay_failures)} of {int(replays)} — "
+                          f"{scope}")
+        elif replays:
+            check("ok", f"journal replays (crash recoveries): "
+                        f"{int(replays)}, all resolved — {scope}")
+
+    # Attach-journal backlog: worker-local /journalz (present when doctor
+    # is pointed at a worker's :1201; the master answers 404 → skipped).
+    # Backlog on a LIVE worker means a replay was deferred (e.g. devices
+    # busy) — incomplete actuation state is sitting on the node.
+    try:
+        journalz = json.loads(_fetch_text(args.master, "/journalz",
+                                          args.timeout))
+    except (TransportError, ValueError):
+        journalz = None
+    if isinstance(journalz, dict) and "backlog" in journalz:
+        backlog = journalz.get("backlog", 0)
+        check("warn" if backlog else "ok",
+              f"attach-journal backlog: {backlog} incomplete record(s)"
+              + (" — inspect /journalz" if backlog else ""))
+
     # Slowest stored trace: WHICH hop ate the worst request's seconds —
     # the one question the histograms can't answer. Informational (ok
     # level): the store is lifetime-scoped like the counters, and doctor's

@@ -26,6 +26,7 @@ from gpumounter_tpu.api import podresources_v1_pb2 as pb_v1
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.errors import KubeletUnavailableError
 from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.retry import RetryPolicy, call_with_retry
 from gpumounter_tpu.utils.trace import k8s_call
 
 logger = get_logger("collector.podresources")
@@ -45,11 +46,33 @@ _TRANSIENT_FALLBACK_CODES = (grpc.StatusCode.UNKNOWN,)
 
 class PodResourcesClient(abc.ABC):
     """Interface so the collector can run against a fake in tests
-    (SURVEY.md §4: interface-extract the kubelet PodResources client)."""
+    (SURVEY.md §4: interface-extract the kubelet PodResources client).
+
+    :meth:`list_pods` is a template: subclasses implement the one-shot
+    :meth:`_list_pods_once`, and the base class runs it under the unified
+    retry layer — a kubelet socket flap (kubelet restart, device-plugin
+    re-registration window) is absorbed here instead of failing the whole
+    attach. The backoff is short and aggressive: the socket is node-local,
+    and the caller is holding an attach request open.
+    """
+
+    retry_policy = RetryPolicy(max_attempts=3, base_delay_s=0.05,
+                               max_delay_s=0.5, deadline_s=10.0)
 
     @abc.abstractmethod
-    def list_pods(self) -> pb.ListPodResourcesResponse:
+    def _list_pods_once(self) -> pb.ListPodResourcesResponse:
         ...
+
+    def list_pods(self) -> pb.ListPodResourcesResponse:
+        # Kubelet snapshots share the k8s request family (it IS a control-
+        # plane hop of the attach path); resource label "podresources"
+        # keeps them distinguishable from apiserver calls. One k8s_call
+        # per attempt, like the apiserver client.
+        def attempt() -> pb.ListPodResourcesResponse:
+            with k8s_call("LIST", "podresources"):
+                return self._list_pods_once()
+        return call_with_retry(attempt, policy=self.retry_policy,
+                               target="kubelet")
 
     def allocatable_tpu_ids(self, resource_name: str) -> set[str] | None:
         """Device ids the kubelet will actually schedule for
@@ -88,14 +111,7 @@ class KubeletPodResourcesClient(PodResourcesClient):
                 f"kubelet PodResources socket missing: {self.socket_path}")
         return grpc.insecure_channel(f"unix://{self.socket_path}")
 
-    def list_pods(self) -> pb.ListPodResourcesResponse:
-        # Kubelet snapshots share the k8s request family (it IS a control-
-        # plane hop of the attach path); resource label "podresources"
-        # keeps them distinguishable from apiserver calls.
-        with k8s_call("LIST", "podresources"):
-            return self._list_pods()
-
-    def _list_pods(self) -> pb.ListPodResourcesResponse:
+    def _list_pods_once(self) -> pb.ListPodResourcesResponse:
         channel = self._channel()
         try:
             if self.api_version in (None, "v1"):
@@ -145,31 +161,39 @@ class KubeletPodResourcesClient(PodResourcesClient):
         now = time.monotonic()
         if cached is not None and now < cached[0]:
             return cached[1]
-        with k8s_call("GET", "podresources"):
-            channel = self._channel()
-            try:
-                resp = self._call(channel, _ALLOCATABLE_METHOD_V1,
-                                  pb_v1.AllocatableResourcesRequest(),
-                                  pb_v1.AllocatableResourcesResponse)
-            except grpc.RpcError as e:
-                if e.code() in (_PERMANENT_FALLBACK_CODES
-                                + _TRANSIENT_FALLBACK_CODES):
-                    # fake/partial v1 server; cache too — absent stays
-                    # absent
-                    self._alloc_cache[resource_name] = (
-                        now + self.ALLOCATABLE_TTL_S, None)
-                    return None
-                raise KubeletUnavailableError(
-                    f"GetAllocatableResources failed: {e.code()}: "
-                    f"{e.details()}") from e
-            finally:
-                channel.close()
+
+        def attempt():
+            with k8s_call("GET", "podresources"):
+                return self._allocatable_once(resource_name, now)
+        resp = call_with_retry(attempt, policy=self.retry_policy,
+                               target="kubelet")
+        if resp is None:        # fallback-code path cached None already
+            return None
         ids = {device_id
                for dev in resp.devices if dev.resource_name == resource_name
                for device_id in dev.device_ids}
         self._alloc_cache[resource_name] = (
             now + self.ALLOCATABLE_TTL_S, ids)
         return ids
+
+    def _allocatable_once(self, resource_name: str, now: float):
+        channel = self._channel()
+        try:
+            return self._call(channel, _ALLOCATABLE_METHOD_V1,
+                              pb_v1.AllocatableResourcesRequest(),
+                              pb_v1.AllocatableResourcesResponse)
+        except grpc.RpcError as e:
+            if e.code() in (_PERMANENT_FALLBACK_CODES
+                            + _TRANSIENT_FALLBACK_CODES):
+                # fake/partial v1 server; cache too — absent stays absent
+                self._alloc_cache[resource_name] = (
+                    now + self.ALLOCATABLE_TTL_S, None)
+                return None
+            raise KubeletUnavailableError(
+                f"GetAllocatableResources failed: {e.code()}: "
+                f"{e.details()}") from e
+        finally:
+            channel.close()
 
 
 class FakePodResourcesClient(PodResourcesClient):
@@ -182,6 +206,9 @@ class FakePodResourcesClient(PodResourcesClient):
         # {resource: [ids]} — what a v1 kubelet's GetAllocatableResources
         # reports. None = "no v1 allocatable view" (v1alpha1-era behaviour).
         self.allocatable: dict[str, list[str]] | None = None
+        # testing/chaos.py FaultInjector: kubelet socket-flap injection
+        # fires inside the base class's retry layer, same as production.
+        self.faults = None
 
     def assign(self, namespace: str, pod: str, device_ids: list[str],
                container: str = "main",
@@ -192,13 +219,9 @@ class FakePodResourcesClient(PodResourcesClient):
     def unassign(self, namespace: str, pod: str) -> None:
         self.assignments.pop((namespace, pod), None)
 
-    def list_pods(self) -> pb.ListPodResourcesResponse:
-        # same instrumentation as the real client: fake-stack traces show
-        # kubelet snapshots exactly where production traces would
-        with k8s_call("LIST", "podresources"):
-            return self._list_pods()
-
-    def _list_pods(self) -> pb.ListPodResourcesResponse:
+    def _list_pods_once(self) -> pb.ListPodResourcesResponse:
+        if self.faults is not None:
+            self.faults.fire("LIST", "podresources")
         self.list_calls += 1
         resp = pb.ListPodResourcesResponse()
         for (ns, pod), containers in self.assignments.items():

@@ -27,8 +27,10 @@ Three implementations of one interface:
 from __future__ import annotations
 
 import abc
+import http.client
 import json
 import os
+import socket
 import ssl
 import threading
 import time
@@ -40,9 +42,88 @@ from typing import Any
 from gpumounter_tpu.k8s import objects
 from gpumounter_tpu.utils.errors import K8sApiError, PodNotFoundError
 from gpumounter_tpu.utils.log import get_logger
-from gpumounter_tpu.utils.trace import k8s_call
+from gpumounter_tpu.utils.retry import (RetryBudget, RetryPolicy,
+                                        call_with_retry, retryable,
+                                        retryable_non_idempotent)
+from gpumounter_tpu.utils.trace import annotate, k8s_call
 
 logger = get_logger("k8s.client")
+
+# Apiserver backoff shape shared by the REST clients and the fake (tests
+# override per instance). max_attempts counts the first try, so the
+# fault-free path issues exactly one round-trip — retries only exist when
+# a call actually failed with a transient error.
+DEFAULT_APISERVER_RETRY = RetryPolicy(max_attempts=4, base_delay_s=0.1,
+                                      max_delay_s=2.0, deadline_s=30.0)
+
+
+def _parse_retry_after(value: str | None) -> float | None:
+    """Seconds form of a Retry-After header; HTTP-date form is rare from
+    an apiserver and not worth a date parser — ignored."""
+    if not value:
+        return None
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        return None
+
+
+def _transport_cause(reason: object) -> str:
+    """Classify a transport-level failure (no HTTP response) so the retry
+    classifier and trace error attributes can tell a socket timeout — the
+    request may have landed — from connection refusal, which certainly
+    did not (pre-PR both were an indistinguishable status-0)."""
+    if isinstance(reason, (TimeoutError, socket.timeout)):
+        return "timeout"
+    if isinstance(reason, ConnectionRefusedError):
+        return "refused"
+    if isinstance(reason, (ConnectionResetError, BrokenPipeError,
+                           ConnectionAbortedError)):
+        return "reset"
+    if isinstance(reason, socket.gaierror):
+        return "dns"
+    if isinstance(reason, str) and "timed out" in reason:
+        return "timeout"
+    return "unreachable"
+
+
+def _resilient_watch(watch_once, timeout_s: float,
+                     resource_version: str | None,
+                     policy: RetryPolicy) -> Iterator[WatchEvent]:
+    """Run ``watch_once(remaining_s, rv)`` streams back-to-back until the
+    deadline, RESUMING from the last seen resourceVersion when a stream
+    dies mid-flight (transport-level status-0 error) instead of aborting
+    the caller's wait. Events between the death and the resume are not
+    lost: the resume starts from the last event the consumer already saw.
+    HTTP-level errors (410 Gone etc.) propagate — those need a re-LIST,
+    which only the caller can do."""
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    deadline = time.monotonic() + timeout_s
+    rv = resource_version
+    resumes = 0
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return
+        try:
+            for etype, obj in watch_once(remaining, rv):
+                if isinstance(obj, dict):
+                    rv = obj.get("metadata", {}).get(
+                        "resourceVersion") or rv
+                yield etype, obj
+            return                       # clean server-side timeout
+        except K8sApiError as e:
+            if e.status != 0 or resumes + 1 >= policy.max_attempts:
+                raise
+            resumes += 1
+            REGISTRY.retry_attempts.inc(target="watch")
+            logger.warning(
+                "watch stream died (%s); resuming from "
+                "resourceVersion=%s (resume %d)", e, rv, resumes)
+            delay = min(policy.delay_s(resumes),
+                        max(0.0, deadline - time.monotonic()))
+            if delay > 0:
+                time.sleep(delay)
 
 
 def _path_resource(path: str) -> str:
@@ -150,14 +231,46 @@ class RestKubeClient(KubeClient):
     base: str
     _ssl: ssl.SSLContext | None
 
+    # Overridable per instance (tests shrink the delays); the budget is
+    # lazily shared across this client's request threads so a hard outage
+    # cannot multiply load by max_attempts on every caller at once.
+    retry_policy: RetryPolicy = DEFAULT_APISERVER_RETRY
+
     def _token(self) -> str:
         return ""
+
+    @property
+    def _retry_budget(self) -> RetryBudget:
+        budget = getattr(self, "_retry_budget_obj", None)
+        if budget is None:
+            budget = self._retry_budget_obj = RetryBudget()
+        return budget
 
     def _request(self, method: str, path: str,
                  query: dict[str, str] | None = None,
                  body: dict[str, Any] | None = None,
                  stream: bool = False, timeout: float = 30.0,
                  content_type: str = "application/json"):
+        """EVERY apiserver round-trip goes through here: one-shot
+        :meth:`_request_once` under the unified retry layer
+        (utils/retry.py). Only transiently-failed calls re-issue — the
+        fault-free path is exactly one round-trip. POST (create) is not
+        idempotent, so it uses the stricter classifier: replay only when
+        the request provably never landed."""
+        classify = retryable_non_idempotent if method == "POST" \
+            else retryable
+        return call_with_retry(
+            lambda: self._request_once(method, path, query=query, body=body,
+                                       stream=stream, timeout=timeout,
+                                       content_type=content_type),
+            policy=self.retry_policy, target="apiserver",
+            classify=classify, budget=self._retry_budget)
+
+    def _request_once(self, method: str, path: str,
+                      query: dict[str, str] | None = None,
+                      body: dict[str, Any] | None = None,
+                      stream: bool = False, timeout: float = 30.0,
+                      content_type: str = "application/json"):
         url = self.base + path
         if query:
             url += "?" + urllib.parse.urlencode(query)
@@ -187,17 +300,51 @@ class RestKubeClient(KubeClient):
                                               timeout=timeout)
             except urllib.error.HTTPError as e:
                 msg = e.read().decode(errors="replace")[:512]
-                raise K8sApiError(e.code, msg) from e
-            except urllib.error.URLError as e:
+                annotate(error_status=e.code)
                 raise K8sApiError(
-                    0, f"apiserver unreachable: {e.reason}") from e
+                    e.code, msg,
+                    retry_after_s=_parse_retry_after(
+                        e.headers.get("Retry-After"))) from e
+            except urllib.error.URLError as e:
+                cause = _transport_cause(e.reason)
+                annotate(error_cause=cause)
+                raise K8sApiError(
+                    0, f"apiserver unreachable ({cause}): {e.reason}",
+                    cause=cause) from e
+            except (TimeoutError, socket.timeout) as e:
+                # read-phase timeout after the connection was established —
+                # unlike "refused", the request MAY have landed
+                annotate(error_cause="timeout")
+                raise K8sApiError(0, f"apiserver timed out: {e}",
+                                  cause="timeout") from e
+            except ConnectionError as e:
+                # e.g. http.client.RemoteDisconnected: the server closed
+                # the connection before answering — urlopen raises these
+                # raw (only request-phase OSErrors get URLError-wrapped)
+                annotate(error_cause="reset")
+                raise K8sApiError(0, f"apiserver connection broken: {e}",
+                                  cause="reset") from e
+            except http.client.HTTPException as e:
+                # torn/garbled response (BadStatusLine et al)
+                annotate(error_cause="reset")
+                raise K8sApiError(0, f"apiserver response broken: {e}",
+                                  cause="reset") from e
             if stream:
                 return resp
             # body transfer + decode inside the timed block: on a big LIST
             # the multi-MB body is the dominant cost, and excluding it
             # would make the metric point at the wrong hop
-            with resp:
-                return json.loads(resp.read())
+            try:
+                with resp:
+                    return json.loads(resp.read())
+            except (TimeoutError, socket.timeout) as e:
+                annotate(error_cause="timeout")
+                raise K8sApiError(0, f"apiserver body read timed out: {e}",
+                                  cause="timeout") from e
+            except ConnectionError as e:
+                annotate(error_cause="reset")
+                raise K8sApiError(0, f"apiserver body read broken: {e}",
+                                  cause="reset") from e
 
     # -- KubeClient ------------------------------------------------------------
 
@@ -271,6 +418,23 @@ class RestKubeClient(KubeClient):
                    timeout_s: float = 60.0,
                    resource_version: str | None = None
                    ) -> Iterator[WatchEvent]:
+        # Mid-stream death (connection reset, apiserver rolling restart)
+        # RESUMES from the last seen resourceVersion instead of aborting
+        # the caller's wait — a watch-based state machine survives a
+        # flaky stream without losing events.
+        return _resilient_watch(
+            lambda remaining_s, rv: self._watch_stream(
+                namespace, label_selector, field_selector, remaining_s, rv),
+            timeout_s, resource_version, self.retry_policy)
+
+    def _watch_stream(self, namespace: str, label_selector: str | None,
+                      field_selector: str | None, timeout_s: float,
+                      resource_version: str | None
+                      ) -> Iterator[WatchEvent]:
+        """ONE watch connection; ends at the server-side timeout, raises a
+        status-0 :class:`K8sApiError` on mid-stream transport death (the
+        resume layer's signal) and propagates ERROR events (410 Gone ⇒
+        caller re-LISTs)."""
         query = {"watch": "true",
                  "timeoutSeconds": str(max(1, int(timeout_s)))}
         if label_selector:
@@ -303,10 +467,12 @@ class RestKubeClient(KubeClient):
                                                   "watch error event"))
                     yield etype, obj
         except OSError as e:
-            # Mid-stream network failure: surface a typed error so callers'
-            # cleanup paths (allocator rollback) engage instead of a raw
-            # ConnectionResetError escaping the iterator.
-            raise K8sApiError(0, f"watch stream broken: {e}") from e
+            # Mid-stream network failure: surface a typed status-0 error
+            # so the resume layer re-establishes the stream from the last
+            # seen resourceVersion (and exhausted resumes still reach the
+            # caller's cleanup paths as a typed error).
+            raise K8sApiError(0, f"watch stream broken: {e}",
+                              cause="reset") from e
 
 
 class InClusterKubeClient(RestKubeClient):
@@ -571,6 +737,28 @@ class FakeKubeClient(KubeClient):
         # When >0, delete_pod keeps the pod visible for this long (simulates
         # graceful termination) before it disappears.
         self.delete_latency_s: float = 0.0
+        # Deterministic fault injection (testing/chaos.py FaultInjector):
+        # every verb consults it INSIDE the retry layer, so injected error
+        # bursts/latency exercise the identical backoff machinery
+        # production sees — the fake carries the resilience layer the same
+        # way it carries the k8s_call instrumentation.
+        self.faults = None
+        # Fast backoff for tests; chaos plans can swap their own.
+        self.retry_policy = RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                                        max_delay_s=0.1, deadline_s=10.0,
+                                        jitter=0.0)
+        self._retry_budget = RetryBudget(capacity=1000.0,
+                                         deposit_per_success=1.0)
+
+    def _fault(self, verb: str, resource: str) -> None:
+        injector = self.faults
+        if injector is not None:
+            injector.fire(verb, resource)
+
+    def _retry(self, fn, classify=retryable):
+        return call_with_retry(fn, policy=self.retry_policy,
+                               target="apiserver", classify=classify,
+                               budget=self._retry_budget)
 
     # -- test scripting API ----------------------------------------------------
 
@@ -587,7 +775,11 @@ class FakeKubeClient(KubeClient):
             self._nodes[node.get("metadata", {}).get("name", "")] = node
 
     def get_node(self, name: str) -> dict[str, Any]:
+        return self._retry(lambda: self._get_node_once(name))
+
+    def _get_node_once(self, name: str) -> dict[str, Any]:
         with k8s_call("GET", "nodes"):
+            self._fault("GET", "nodes")
             with self._lock:
                 node = self._nodes.get(name)
                 if node is None:
@@ -596,7 +788,13 @@ class FakeKubeClient(KubeClient):
 
     def create_event(self, namespace: str,
                      event: dict[str, Any]) -> dict[str, Any]:
+        return self._retry(lambda: self._create_event_once(namespace, event),
+                           classify=retryable_non_idempotent)
+
+    def _create_event_once(self, namespace: str,
+                           event: dict[str, Any]) -> dict[str, Any]:
         with k8s_call("POST", "events"):
+            self._fault("POST", "events")
             event = json.loads(json.dumps(event))
             event.setdefault("metadata", {}).setdefault("namespace",
                                                         namespace)
@@ -630,7 +828,11 @@ class FakeKubeClient(KubeClient):
     # would — the instrumentation layer is part of the contract under test.
 
     def get_pod(self, namespace: str, name: str) -> objects.Pod:
+        return self._retry(lambda: self._get_pod_once(namespace, name))
+
+    def _get_pod_once(self, namespace: str, name: str) -> objects.Pod:
         with k8s_call("GET", "pods"):
+            self._fault("GET", "pods")
             with self._lock:
                 pod = self._pods.get((namespace, name))
                 if pod is None:
@@ -644,7 +846,14 @@ class FakeKubeClient(KubeClient):
     def list_pods_with_version(
             self, namespace: str, label_selector: str | None = None
     ) -> tuple[list[objects.Pod], str]:
+        return self._retry(
+            lambda: self._list_pods_once(namespace, label_selector))
+
+    def _list_pods_once(
+            self, namespace: str, label_selector: str | None = None
+    ) -> tuple[list[objects.Pod], str]:
         with k8s_call("LIST", "pods"):
+            self._fault("LIST", "pods")
             with self._lock:
                 pods = [json.loads(json.dumps(p))
                         for (ns, _), p in self._pods.items()
@@ -653,7 +862,16 @@ class FakeKubeClient(KubeClient):
                 return pods, str(len(self._events))
 
     def create_pod(self, namespace: str, pod: objects.Pod) -> objects.Pod:
+        # POST is not idempotent: a timed-out create may have landed, and
+        # replaying it would 409 against our own object — stricter
+        # classifier, same as the REST client
+        return self._retry(lambda: self._create_pod_once(namespace, pod),
+                           classify=retryable_non_idempotent)
+
+    def _create_pod_once(self, namespace: str,
+                         pod: objects.Pod) -> objects.Pod:
         with k8s_call("POST", "pods"):
+            self._fault("POST", "pods")
             pod = json.loads(json.dumps(pod))
             pod.setdefault("metadata", {}).setdefault("namespace", namespace)
             pod["metadata"].setdefault(
@@ -673,6 +891,11 @@ class FakeKubeClient(KubeClient):
     def delete_pod(self, namespace: str, name: str,
                    grace_period_seconds: int = 0,
                    resource_version: str | None = None) -> None:
+        self._retry(lambda: self._delete_pod_once(namespace, name,
+                                                  resource_version))
+
+    def _delete_pod_once(self, namespace: str, name: str,
+                         resource_version: str | None = None) -> None:
         def _remove():
             with self._lock:
                 pod = self._pods.pop((namespace, name), None)
@@ -681,18 +904,20 @@ class FakeKubeClient(KubeClient):
             if pod is not None:
                 for hook in list(self.on_delete):
                     hook(pod)
-        with k8s_call("DELETE", "pods"), self._lock:
-            if resource_version is not None:
-                pod = self._pods.get((namespace, name))
-                if pod is not None:
-                    live_rv = pod.get("metadata", {}).get(
-                        "resourceVersion", "")
-                    if live_rv != resource_version:
-                        raise K8sApiError(
-                            409, f"Precondition failed: pod {name!r} is at "
-                                 f"{live_rv}, delete expected "
-                                 f"{resource_version}")
-            self.deleted.append((namespace, name))
+        with k8s_call("DELETE", "pods"):
+            self._fault("DELETE", "pods")
+            with self._lock:
+                if resource_version is not None:
+                    pod = self._pods.get((namespace, name))
+                    if pod is not None:
+                        live_rv = pod.get("metadata", {}).get(
+                            "resourceVersion", "")
+                        if live_rv != resource_version:
+                            raise K8sApiError(
+                                409, f"Precondition failed: pod {name!r} is "
+                                     f"at {live_rv}, delete expected "
+                                     f"{resource_version}")
+                self.deleted.append((namespace, name))
         if self.delete_latency_s > 0:
             t = threading.Timer(self.delete_latency_s, _remove)
             t.daemon = True
@@ -702,28 +927,53 @@ class FakeKubeClient(KubeClient):
 
     def patch_pod(self, namespace: str, name: str, patch: dict[str, Any],
                   resource_version: str | None = None) -> objects.Pod:
+        return self._retry(lambda: self._patch_pod_once(namespace, name,
+                                                        patch,
+                                                        resource_version))
+
+    def _patch_pod_once(self, namespace: str, name: str,
+                        patch: dict[str, Any],
+                        resource_version: str | None = None) -> objects.Pod:
         patch = json.loads(json.dumps(patch))
         # the precondition is consumed here, not merged into the object
         patch.get("metadata", {}).pop("resourceVersion", None)
-        with k8s_call("PATCH", "pods"), self._lock:
-            pod = self._pods.get((namespace, name))
-            if pod is None:
-                raise PodNotFoundError(namespace, name)
-            live_rv = pod.get("metadata", {}).get("resourceVersion", "")
-            if resource_version is not None and live_rv != resource_version:
-                raise K8sApiError(
-                    409, f"Operation cannot be fulfilled on pods "
-                         f"{name!r}: the object has been modified "
-                         f"(have {live_rv}, precondition {resource_version})")
-            _json_merge_patch(pod, patch)
-            self._record("MODIFIED", pod)
-            return json.loads(json.dumps(pod))
+        with k8s_call("PATCH", "pods"):
+            self._fault("PATCH", "pods")
+            with self._lock:
+                pod = self._pods.get((namespace, name))
+                if pod is None:
+                    raise PodNotFoundError(namespace, name)
+                live_rv = pod.get("metadata", {}).get("resourceVersion", "")
+                if resource_version is not None \
+                        and live_rv != resource_version:
+                    raise K8sApiError(
+                        409, f"Operation cannot be fulfilled on pods "
+                             f"{name!r}: the object has been modified "
+                             f"(have {live_rv}, precondition "
+                             f"{resource_version})")
+                _json_merge_patch(pod, patch)
+                self._record("MODIFIED", pod)
+                return json.loads(json.dumps(pod))
 
     def watch_pods(self, namespace: str, label_selector: str | None = None,
                    field_selector: str | None = None,
                    timeout_s: float = 60.0,
                    resource_version: str | None = None
                    ) -> Iterator[WatchEvent]:
+        # Same resume-on-stream-death semantics as the REST client: an
+        # injected mid-stream fault re-enters _watch_once from the last
+        # seen resourceVersion, so chaos plans exercise production's
+        # resume machinery through the fake.
+        return _resilient_watch(
+            lambda remaining_s, rv: self._watch_once(
+                namespace, label_selector, field_selector, remaining_s, rv),
+            timeout_s, resource_version, self.retry_policy)
+
+    def _watch_once(self, namespace: str, label_selector: str | None = None,
+                    field_selector: str | None = None,
+                    timeout_s: float = 60.0,
+                    resource_version: str | None = None
+                    ) -> Iterator[WatchEvent]:
         # Replays the event log from ``resource_version`` (default: from the
         # beginning, equivalent to resourceVersion=0) then follows new
         # events. Event index == resourceVersion, matching
@@ -737,6 +987,9 @@ class FakeKubeClient(KubeClient):
         if field_selector and field_selector.startswith("metadata.name="):
             field_name = field_selector.split("=", 1)[1]
         while True:
+            # fault check per poll round: a WATCH fault can hang the stream
+            # (latency) or kill it mid-flight (status-0 error → resume)
+            self._fault("WATCH", "pods")
             with self._lock:
                 while cursor >= len(self._events):
                     remaining = deadline - time.monotonic()

@@ -34,10 +34,11 @@ from gpumounter_tpu.k8s.client import KubeClient
 from gpumounter_tpu.master.discovery import (WorkerDirectory,
                                              WorkerNotFoundError)
 from gpumounter_tpu.utils import consts
-from gpumounter_tpu.utils.errors import (K8sApiError, PodNotFoundError,
-                                         TopologyError)
+from gpumounter_tpu.utils.errors import (CircuitOpenError, K8sApiError,
+                                         PodNotFoundError, TopologyError)
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
+from gpumounter_tpu.utils.retry import CircuitBreaker, RetryPolicy
 from gpumounter_tpu.utils.trace import STORE, Trace, annotate, span
 from gpumounter_tpu.worker.grpc_server import WorkerClient
 
@@ -95,7 +96,13 @@ _GRPC_HTTP = {
     grpc.StatusCode.INTERNAL: 502,
     grpc.StatusCode.UNAVAILABLE: 502,
     grpc.StatusCode.DEADLINE_EXCEEDED: 504,
+    # The worker is alive but saturated — a retryable-by-the-client
+    # condition, so 429 + Retry-After, not a generic 500.
+    grpc.StatusCode.RESOURCE_EXHAUSTED: 429,
 }
+# Default client backoff hint when the worker said RESOURCE_EXHAUSTED
+# without its own timing.
+_RESOURCE_EXHAUSTED_RETRY_AFTER_S = 1.0
 
 # Route labels for tpumounter_gateway_request_seconds{route} and for the
 # op field of master request traces. Fixed vocabulary — the histogram's
@@ -143,6 +150,19 @@ class MasterGateway:
         # latency-benchmarked hot path.
         self._clients: dict[str, WorkerClient] = {}
         self._clients_lock = threading.Lock()
+        # Per-worker circuit breakers: a dead node fails fast (429 +
+        # Retry-After) instead of eating a gateway thread per request for
+        # the full dial timeout — one dead worker cannot starve the pool.
+        # UNAVAILABLE retries are safe because the worker's per-request-id
+        # fencing makes AddTPU idempotent (worker/service.py).
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._breakers_lock = threading.Lock()
+        self.breaker_failure_threshold = 5
+        self.breaker_reset_timeout_s = 15.0
+        self.rpc_retry_policy = RetryPolicy(max_attempts=3,
+                                            base_delay_s=0.05,
+                                            max_delay_s=1.0,
+                                            deadline_s=60.0)
 
     @staticmethod
     def _default_tracez_base(target: str) -> str | None:
@@ -159,14 +179,29 @@ class MasterGateway:
                 self._clients[target] = client
             return client
 
+    def _breaker(self, target: str) -> CircuitBreaker:
+        with self._breakers_lock:
+            breaker = self._breakers.get(target)
+            if breaker is None:
+                breaker = self._breakers[target] = CircuitBreaker(
+                    target,
+                    failure_threshold=self.breaker_failure_threshold,
+                    reset_timeout_s=self.breaker_reset_timeout_s)
+            return breaker
+
     def _drop_client(self, target: str) -> None:
         with self._clients_lock:
             client = self._clients.pop(target, None)
         if client is not None:
             try:
                 client.close()
-            except Exception:
-                pass
+            except (grpc.RpcError, ValueError, OSError) as e:
+                # a channel that fails to close is an annoyance, not an
+                # outage — but only expected teardown kinds are swallowed;
+                # a genuine bug (TypeError, AttributeError) must surface,
+                # not masquerade as a resolve miss
+                logger.warning("closing worker channel %s failed: %s",
+                               target, e)
 
     # -- request handling ------------------------------------------------------
 
@@ -219,12 +254,21 @@ class MasterGateway:
         except K8sApiError as e:
             status, payload = 502, {"result": "ApiserverError",
                                     "message": str(e)}
+        except CircuitOpenError as e:
+            # the worker's breaker is open: tell the client exactly when a
+            # retry has a chance instead of letting it hammer a dead node
+            status, payload = 429, {
+                "result": "WorkerCircuitOpen",
+                "message": str(e),
+                "retry_after_s": round(max(0.1, e.retry_after_s), 1)}
         except grpc.RpcError as e:
             code = e.code() if hasattr(e, "code") else None
             status, payload = (_GRPC_HTTP.get(code, 502),
                                {"result": str(code and code.name),
                                 "message": e.details()
                                 if hasattr(e, "details") else str(e)})
+            if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                payload["retry_after_s"] = _RESOURCE_EXHAUSTED_RETRY_AFTER_S
         except ValueError as e:
             # e.g. a version-skewed worker returning a result enum value we
             # don't know — answer with JSON instead of dropping the socket
@@ -339,7 +383,10 @@ class MasterGateway:
             try:
                 with urllib.request.urlopen(url, timeout=5.0) as resp:
                     remote = json.loads(resp.read())
-            except Exception as e:          # stitch is best-effort
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                # stitch is best-effort, but only expected network/parse
+                # failures degrade silently — a coding bug must not
+                # vanish into "worker spans incomplete"
                 errors.append(f"worker {target}: {e}")
                 continue
             for entry in remote.get("recent", []):
@@ -445,25 +492,72 @@ class MasterGateway:
         return self._call_node_worker(node, fn)
 
     def _call_node_worker(self, node: str, fn):
-        with span("dial", node=node):
-            target = self.directory.worker_target(node)
-            client = self._client(target)
-            annotate(worker=target)
-        try:
-            with span("rpc", node=node, worker=target):
-                return fn(client)
-        except grpc.RpcError as e:
-            if (not hasattr(e, "code")
-                    or e.code() != grpc.StatusCode.UNAVAILABLE):
+        """Resolve the node's worker and run ``fn(client)`` under the rpc
+        retry policy + that worker's circuit breaker.
+
+        UNAVAILABLE means the cached worker IP is presumed dead (pod
+        restarted / connection blip): invalidate both caches and retry
+        against a fresh resolve, with backoff, up to the policy's attempt
+        budget — safe because the worker's per-request-id fencing makes
+        the RPCs idempotent. Every UNAVAILABLE feeds the breaker; enough
+        of them open it and subsequent requests fail fast with
+        :class:`CircuitOpenError` (→ 429 + Retry-After) instead of eating
+        a gateway thread each for the full dial timeout."""
+        # Hand-rolled rather than call_with_retry: each attempt may
+        # RE-RESOLVE to a different target (worker pod restarted with a
+        # new IP), so the breaker is chosen per attempt — call_with_retry
+        # binds one breaker for the whole call.
+        policy = self.rpc_retry_policy
+        deadline = time.monotonic() + policy.deadline_s
+        attempt = 0
+        while True:
+            attempt += 1
+            extra = {"retry": True} if attempt > 1 else {}
+            with span("dial", node=node, **extra):
+                target = self.directory.worker_target(node)
+                client = self._client(target)
+                annotate(worker=target)
+            breaker = self._breaker(target)
+            breaker.allow()              # CircuitOpenError → 429 upstream
+            try:
+                with span("rpc", node=node, worker=target, **extra):
+                    result = fn(client)
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                    # a hung worker proves nothing about liveness and ate
+                    # a gateway thread for the full deadline — that is a
+                    # breaker FAILURE (enough of them must fail fast), but
+                    # not worth re-waiting a whole deadline in-request
+                    breaker.record_failure()
+                    raise
+                if code != grpc.StatusCode.UNAVAILABLE:
+                    # the worker ANSWERED (policy denial, internal error,
+                    # saturation): the channel is alive — that is breaker
+                    # success even when the answer is a failure
+                    breaker.record_success()
+                    raise
+                breaker.record_failure()
+                self._drop_client(target)
+                self.directory.invalidate(node)
+                delay = policy.delay_s(attempt)
+                if attempt >= policy.max_attempts \
+                        or time.monotonic() + delay >= deadline:
+                    raise
+                REGISTRY.retry_attempts.inc(target="worker_rpc")
+                annotate(unavailable_retries=attempt)
+                time.sleep(delay)
+                continue
+            except Exception:
+                # non-gRPC failure AFTER a delivered response (e.g. a
+                # version-skewed result enum): transport worked, and the
+                # half-open probe slot must not leak — without this a
+                # ValueError mid-probe would leave the breaker failing
+                # fast forever
+                breaker.record_success()
                 raise
-            self._drop_client(target)
-            self.directory.invalidate(node)
-            with span("dial", node=node, retry=True):
-                fresh = self.directory.worker_target(node)
-                client = self._client(fresh)
-                annotate(worker=fresh)
-            with span("rpc", node=node, worker=fresh, retry=True):
-                return fn(client)
+            breaker.record_success()
+            return result
 
     def _add(self, namespace: str, pod_name: str, tpu_num: int,
              entire: bool, rid: str = "-") -> tuple[int, dict]:
@@ -564,6 +658,12 @@ class MasterGateway:
                 payload = (json.dumps(obj) + "\n").encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
+                retry_after = obj.get("retry_after_s")
+                if retry_after is not None:
+                    # RFC 9110 Retry-After is whole seconds; round up so
+                    # the client never comes back before the hint
+                    self.send_header("Retry-After",
+                                     str(max(1, int(-(-retry_after // 1)))))
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)

@@ -1,0 +1,329 @@
+"""Deterministic fault injection for the fake control plane.
+
+Transient faults are rare in test rigs, so resilience code rots unless
+failures can be scripted: this module is the chaos harness that keeps the
+retry layer, the circuit breakers, the watch-resume machinery, and the
+attach journal honest (tests/test_chaos.py). It threads through the fake
+stack at the SAME seams production faults hit:
+
+- :class:`FaultInjector` plugs into ``FakeKubeClient.faults`` and
+  ``FakePodResourcesClient.faults``: every verb consults it inside the
+  retry layer, so an injected 500 burst exercises the identical backoff
+  path a real apiserver hiccup would. ``HttpApiserver`` consults it at
+  the HTTP layer for genuine connection drops.
+- :class:`FaultPlan` is a named, ordered set of :class:`Fault` rules —
+  error bursts, added latency, connection drops, watch hangs and
+  mid-stream watch death, kubelet socket flaps.
+- :class:`ChaosRig` wraps a WorkerRig with crash points
+  (:data:`CRASH_POINTS`): a simulated worker death before / in the middle
+  of / right after actuation, followed by :meth:`ChaosRig.restart_worker`
+  which rebuilds the service over the same cluster + journal file and
+  runs the startup replay — the crash-recovery loop, in-process and
+  deterministic.
+
+:func:`assert_invariants` states the contract every fault plan must
+preserve: attaches converge or roll back cleanly — no leaked slave-pod
+reservations, no partial device grants, no journal backlog, and at most
+one logical TPUAttached per attach (idempotency).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from gpumounter_tpu.utils.errors import K8sApiError, KubeletUnavailableError
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("testing.chaos")
+
+
+class ConnectionDropped(K8sApiError):
+    """Injected connection drop. Subclasses the status-0 "reset" apiserver
+    error so the in-process fake treats it exactly like a torn TCP
+    stream; the HTTP facade catches it and actually closes the socket."""
+
+    def __init__(self):
+        super().__init__(0, "injected connection drop", cause="reset")
+
+
+class WorkerCrash(Exception):
+    """Simulated worker death at a crash point. Deliberately NOT a
+    TPUMounterError: a crash runs no rollback handler, no journal commit
+    — exactly the state a SIGKILL'd worker leaves behind."""
+
+
+@dataclasses.dataclass
+class Fault:
+    """One injection rule, applied to calls matching (op, resource).
+
+    ``op`` is the instrumentation verb (GET/LIST/POST/DELETE/PATCH/WATCH)
+    or ``*``; ``resource`` is pods/nodes/events/podresources or ``*``.
+    The first ``after`` matching calls pass untouched, then ``times``
+    calls are affected: sleep ``latency_s`` (a watch hang when op=WATCH),
+    then raise — ``status``+``cause`` as a :class:`K8sApiError`,
+    ``kubelet=True`` as :class:`KubeletUnavailableError`, ``drop=True``
+    as :class:`ConnectionDropped`. Latency-only faults just delay.
+    """
+
+    op: str = "*"
+    resource: str = "*"
+    times: int = 1
+    after: int = 0
+    latency_s: float = 0.0
+    status: int | None = None
+    cause: str = ""
+    retry_after_s: float | None = None
+    kubelet: bool = False
+    drop: bool = False
+
+    def matches(self, op: str, resource: str) -> bool:
+        return (self.op in ("*", op)
+                and self.resource in ("*", resource))
+
+
+class FaultInjector:
+    """Stateful executor of a plan's rules; one per installed plan.
+
+    ``fired`` logs every applied fault as (op, resource, description) so
+    tests can assert the plan actually bit — a chaos test whose fault
+    never fired proves nothing.
+    """
+
+    def __init__(self, faults: list[Fault]):
+        self._faults = [dataclasses.replace(f) for f in faults]
+        self._lock = threading.Lock()
+        self.fired: list[tuple[str, str, str]] = []
+
+    def fire(self, op: str, resource: str) -> None:
+        fault = None
+        with self._lock:
+            for candidate in self._faults:
+                if not candidate.matches(op, resource):
+                    continue
+                if candidate.after > 0:
+                    candidate.after -= 1
+                    continue
+                if candidate.times <= 0:
+                    continue
+                candidate.times -= 1
+                fault = candidate
+                self.fired.append((op, resource, self._describe(fault)))
+                break
+        if fault is None:
+            return
+        if fault.latency_s > 0:
+            time.sleep(fault.latency_s)
+        if fault.drop:
+            raise ConnectionDropped()
+        if fault.kubelet:
+            raise KubeletUnavailableError(
+                "injected kubelet socket flap")
+        if fault.status is not None:
+            raise K8sApiError(fault.status,
+                              "injected fault", cause=fault.cause,
+                              retry_after_s=fault.retry_after_s)
+
+    @staticmethod
+    def _describe(fault: Fault) -> str:
+        if fault.drop:
+            return "drop"
+        if fault.kubelet:
+            return "kubelet-flap"
+        if fault.status is not None:
+            return f"error-{fault.status}" + (f"-{fault.cause}"
+                                              if fault.cause else "")
+        return f"latency-{fault.latency_s:g}s"
+
+    @property
+    def remaining(self) -> int:
+        with self._lock:
+            return sum(max(0, f.times) for f in self._faults)
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A named chaos scenario: the unit of the test matrix."""
+
+    name: str
+    faults: list[Fault]
+    description: str = ""
+
+    def injector(self) -> FaultInjector:
+        return FaultInjector(self.faults)
+
+
+# Where a simulated worker death can be armed, relative to actuation —
+# the window the attach journal exists to cover:
+#   before_actuate: intent journaled, slave pods reserved, nothing granted
+#   mid_actuate:    cgroup synced + first device node created, rest missing
+#   before_commit:  actuation complete, commit record never written
+CRASH_POINTS = ("before_actuate", "mid_actuate", "before_commit")
+
+
+class ChaosRig:
+    """A WorkerRig under a fault plan, with worker crash-restart.
+
+    ``crash`` semantics: :meth:`arm_crash` plants a :class:`WorkerCrash`
+    at the named point; the attach raises it without running rollback
+    (like a real SIGKILL). :meth:`restart_worker` then "boots a new
+    worker process": fresh service + fresh journal object over the same
+    journal file and the same cluster state, and runs the startup replay.
+    """
+
+    def __init__(self, fake_host, n_chips: int = 4, plan: FaultPlan | None
+                 = None, **rig_kwargs):
+        from gpumounter_tpu.testing.sim import WorkerRig
+        self.rig = WorkerRig(fake_host, n_chips=n_chips, **rig_kwargs)
+        self.injector: FaultInjector | None = None
+        self._unwind: list = []
+        if plan is not None:
+            self.install(plan)
+
+    def install(self, plan: FaultPlan) -> FaultInjector:
+        self.injector = plan.injector()
+        self.rig.sim.kube.faults = self.injector
+        self.rig.sim.podresources.faults = self.injector
+        return self.injector
+
+    # -- crash points ----------------------------------------------------------
+
+    def arm_crash(self, point: str) -> None:
+        assert point in CRASH_POINTS, point
+        if point == "before_actuate":
+            mounter = self.rig.mounter
+            orig = mounter.mount_chips
+
+            def crash_mount(*args, **kwargs):
+                raise WorkerCrash(point)
+            mounter.mount_chips = crash_mount
+            self._unwind.append(
+                lambda: setattr(mounter, "mount_chips", orig))
+        elif point == "mid_actuate":
+            actuator = self.rig.actuator
+            orig = actuator.create_device_node
+            calls = {"n": 0}
+
+            def crash_after_first(*args, **kwargs):
+                if calls["n"] >= 1:
+                    raise WorkerCrash(point)
+                calls["n"] += 1
+                return orig(*args, **kwargs)
+            actuator.create_device_node = crash_after_first
+            self._unwind.append(
+                lambda: setattr(actuator, "create_device_node", orig))
+        elif point == "before_commit":
+            journal = self.rig.service.journal
+            orig = journal.commit
+
+            def crash_commit(jid):
+                raise WorkerCrash(point)
+            journal.commit = crash_commit
+            self._unwind.append(lambda: setattr(journal, "commit", orig))
+
+    def disarm(self) -> None:
+        while self._unwind:
+            self._unwind.pop()()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def restart_worker(self) -> dict[str, int]:
+        """Boot a "new worker process" over the same node state: fresh
+        journal object from the on-disk file, fresh service, startup
+        replay. Returns the replay outcome counts."""
+        from gpumounter_tpu.worker.journal import AttachJournal
+        from gpumounter_tpu.worker.service import TPUMountService
+        self.disarm()
+        journal = AttachJournal(self.rig.sim.settings.journal_path)
+        self.rig.journal = journal
+        self.rig.service = TPUMountService(
+            self.rig.allocator, self.rig.mounter, self.rig.sim.kube,
+            self.rig.sim.settings, pool=self.rig.pool, journal=journal)
+        return self.rig.service.replay_journal()
+
+    def close(self) -> None:
+        self.disarm()
+        self.rig.close()
+
+
+def wait_events_drained(service, timeout_s: float = 5.0) -> None:
+    """Block until the service's async audit-event queue has flushed (two
+    consecutive empty observations — the worker thread may be mid-POST on
+    the first)."""
+    deadline = time.monotonic() + timeout_s
+    stable = 0
+    while time.monotonic() < deadline:
+        if not service._event_queue:
+            stable += 1
+            if stable >= 2:
+                return
+        else:
+            stable = 0
+        time.sleep(0.03)
+
+
+def assert_invariants(rig, expected_uuids: set[str],
+                      owner: str = "workload",
+                      namespace: str = "default",
+                      max_attached_events: int | None = None) -> None:
+    """The post-plan contract every chaos scenario must uphold.
+
+    ``expected_uuids``: chips the surviving state should grant the owner
+    (empty set = the attach must have rolled back / reverted completely).
+
+    1. **No leaked reservations**: the slave pods holding chips are
+       exactly the ones backing ``expected_uuids`` — a failed attach left
+       none behind, a converged one leaked no extras.
+    2. **No partial device grants**: the device nodes present in the
+       owner's container are exactly the expected chips' nodes.
+    3. **No journal backlog**: every journaled intent reached a terminal
+       state (committed/reverted).
+    4. **Idempotency**: across every retry/replay, at most ONE logical
+       TPUAttached event per logical attach (resumes record
+       TPUAttachResumed instead).
+    """
+    sim = rig.sim
+    # 1. reservations: chips assigned to live non-warm slave pods
+    from gpumounter_tpu.k8s import objects
+    from gpumounter_tpu.utils import consts
+    held: set[str] = set()
+    for pod in sim.slave_pods():
+        labels = objects.labels(pod)
+        if labels.get(consts.WARM_POD_LABEL_KEY) == \
+                consts.WARM_POD_LABEL_VALUE:
+            continue
+        key = (objects.namespace(pod), objects.name(pod))
+        for containers in (sim.podresources.assignments.get(key) or {}
+                           ).values():
+            for ids in containers.values():
+                held.update(ids)
+    assert held == expected_uuids, \
+        f"slave-pod reservations {sorted(held)} != expected " \
+        f"{sorted(expected_uuids)} (leak or lost grant)"
+
+    # 2. device nodes actually present in the owner's container
+    chips_by_uuid = {c.uuid: c for c in sim.enumerator.chips}
+    expected_paths = {chips_by_uuid[u].container_path
+                      for u in expected_uuids}
+    created_paths = {path for _, path, _, _ in rig.actuator.created}
+    assert created_paths == expected_paths, \
+        f"device nodes {sorted(created_paths)} != expected " \
+        f"{sorted(expected_paths)} (partial grant)"
+
+    # 3. journal fully resolved
+    backlog = rig.service.journal.backlog() \
+        if rig.service.journal is not None else 0
+    assert backlog == 0, \
+        f"journal still holds {backlog} incomplete record(s)"
+
+    # 4. ≤ one logical TPUAttached per attach. Default: one when chips are
+    # expected, zero when the plan should have reverted everything; a test
+    # that legitimately attached then detached passes its own bound.
+    if max_attached_events is None:
+        max_attached_events = 1 if expected_uuids else 0
+    wait_events_drained(rig.service)
+    attached = [e for e in sim.kube.events
+                if e.get("reason") == "TPUAttached"]
+    assert len(attached) <= max_attached_events, \
+        f"double TPUAttached: {[e['message'] for e in attached]}"

@@ -14,6 +14,7 @@ works unchanged across the process boundary.
 from __future__ import annotations
 
 import json
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -23,10 +24,19 @@ from gpumounter_tpu.utils.errors import K8sApiError, PodNotFoundError
 
 
 class HttpApiserver:
-    """``serve(FakeKubeClient)`` → base URL; ``close()`` stops it."""
+    """``serve(FakeKubeClient)`` → base URL; ``close()`` stops it.
+
+    ``faults`` (a testing/chaos.py FaultInjector) is consulted at the
+    HTTP layer before dispatch, so chaos plans can inject GENUINE
+    connection drops and latency against the real REST client — the
+    in-process FakeKubeClient seam can only simulate them. A
+    ``ConnectionDropped`` fault tears the TCP connection with no HTTP
+    response, which the client surfaces as a status-0 "reset" error.
+    """
 
     def __init__(self, kube: FakeKubeClient, address: str = "127.0.0.1"):
         self.kube = kube
+        self.faults = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -34,6 +44,25 @@ class HttpApiserver:
 
             def log_message(self, *args):
                 pass
+
+            def _inject(self, verb: str, resource: str) -> bool:
+                """Fire the fault hook; True = connection torn, abort."""
+                if outer.faults is None:
+                    return False
+                from gpumounter_tpu.testing.chaos import ConnectionDropped
+                try:
+                    outer.faults.fire(verb, resource)
+                except ConnectionDropped:
+                    try:
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    self.close_connection = True
+                    return True
+                except K8sApiError as e:
+                    self._json(e.status or 500, {"message": str(e)})
+                    return True
+                return False
 
             def _json(self, code: int, obj) -> None:
                 body = json.dumps(obj).encode()
@@ -47,6 +76,11 @@ class HttpApiserver:
                 url = urlparse(self.path)
                 q = {k: v[0] for k, v in parse_qs(url.query).items()}
                 parts = url.path.strip("/").split("/")
+                verb = ("WATCH" if q.get("watch") == "true"
+                        else "GET" if len(parts) in (4, 6) else "LIST")
+                resource = "nodes" if parts[2:3] == ["nodes"] else "pods"
+                if self._inject(verb, resource):
+                    return
                 try:
                     if parts[:2] == ["api", "v1"] and \
                             parts[2:3] == ["nodes"] and len(parts) == 4:
@@ -92,6 +126,9 @@ class HttpApiserver:
                 obj = json.loads(self.rfile.read(length) or b"{}")
                 parts = self.path.strip("/").split("/")
                 ns = parts[3]
+                if self._inject("POST", "events" if parts[4:5] == ["events"]
+                                else "pods"):
+                    return
                 try:
                     if parts[4:5] == ["events"]:
                         return self._json(
@@ -102,6 +139,8 @@ class HttpApiserver:
 
             def do_DELETE(self):
                 parts = urlparse(self.path).path.strip("/").split("/")
+                if self._inject("DELETE", "pods"):
+                    return
                 outer.kube.delete_pod(parts[3], parts[5])
                 return self._json(200, {"status": "Success"})
 

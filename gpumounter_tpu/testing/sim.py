@@ -266,9 +266,18 @@ class WorkerRig:
             self.sim.settings.warm_pool_enabled = True
             self.pool = PoolManager(self.allocator, self.sim.kube,
                                     self.sim.settings)
+        # Crash-safe attach journal on the fixture tree — enabled by
+        # default so every rig-driven attach exercises the production
+        # write-ahead path; chaos tests "restart the worker" by building a
+        # fresh service over the same journal (testing/chaos.py).
+        from gpumounter_tpu.worker.journal import AttachJournal
+        self.sim.settings.journal_path = os.path.join(
+            os.path.dirname(fake_host.proc_root), "attach-journal.jsonl")
+        self.journal = AttachJournal(self.sim.settings.journal_path)
         self.service = TPUMountService(self.allocator, self.mounter,
                                        self.sim.kube, self.sim.settings,
-                                       pool=self.pool)
+                                       pool=self.pool,
+                                       journal=self.journal)
 
     def provision_container(self, pod: objects.Pod,
                             pid: int | None = None) -> dict[str, int]:
@@ -328,7 +337,11 @@ class LiveStack:
         # the worker's real health/metrics/tracez sidecar port, on an
         # ephemeral port (production convention is grpc_port + 1, which an
         # ephemeral gRPC bind can't honour) — the master's /tracez stitch
-        # resolves it through worker_tracez_base below
+        # resolves it through worker_tracez_base below. The journal is
+        # attached exactly as worker/main.py does, so /journalz serves the
+        # rig's journal.
+        from gpumounter_tpu.worker.main import _HealthHandler
+        _HealthHandler.journal = rig.service.journal
         self.health_server = start_health_server(0)
         health_port = self.health_server.server_port
         self.master_kube = FakeKubeClient()
@@ -343,6 +356,8 @@ class LiveStack:
         self.base = f"http://127.0.0.1:{self.http_server.server_port}"
 
     def close(self) -> None:
+        from gpumounter_tpu.worker.main import _HealthHandler
+        _HealthHandler.journal = None
         self.http_server.shutdown()
         self.health_server.shutdown()
         self.grpc_server.stop(grace=0)

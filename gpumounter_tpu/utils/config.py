@@ -91,6 +91,13 @@ class Settings:
     # immediately, so this mainly bounds how long a crashed warm pod or a
     # resize stays unreconciled.
     warm_pool_interval_s: float = 10.0
+    # Crash-safe attach journal file (worker/journal.py): intent records
+    # before actuation, replayed at boot. Empty = journaling disabled
+    # (direct Settings() construction, e.g. unit rigs that build their
+    # own); from_env defaults it ON at consts.DEFAULT_JOURNAL_PATH so a
+    # production worker always journals unless explicitly opted out with
+    # TPU_JOURNAL_PATH="".
+    journal_path: str = ""
     host: HostPaths = dataclasses.field(default_factory=HostPaths)
 
     @classmethod
@@ -116,6 +123,8 @@ class Settings:
         s.warm_pool_enabled = bool(s.warm_pool_sizes)
         if t := env.get(consts.ENV_WARM_POOL_INTERVAL_S):
             s.warm_pool_interval_s = float(t)
+        s.journal_path = env.get(consts.ENV_JOURNAL_PATH,
+                                 consts.DEFAULT_JOURNAL_PATH)
         if p := env.get("TPU_WORKER_GRPC_PORT"):
             s.worker_grpc_port = int(p)
         if p := env.get("TPU_MASTER_HTTP_PORT"):

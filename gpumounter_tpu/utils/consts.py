@@ -85,6 +85,10 @@ ENV_CGROUP_DRIVER = "CGROUP_DRIVER"
 # unset = pool disabled (exactly today's cold-path behavior).
 ENV_WARM_POOL = "TPU_WARM_POOL"
 ENV_WARM_POOL_INTERVAL_S = "TPU_WARM_POOL_INTERVAL_S"
+# Crash-safe attach journal (worker/journal.py). Set to "" to disable;
+# the default lives on a hostPath so it survives worker-pod restarts.
+ENV_JOURNAL_PATH = "TPU_JOURNAL_PATH"
+DEFAULT_JOURNAL_PATH = "/var/lib/tpu-mounter/attach-journal.jsonl"
 
 # --- Ports (ref: master main.go:235 :8080; worker main.go:24 :1200) -----------
 MASTER_HTTP_PORT = 8080

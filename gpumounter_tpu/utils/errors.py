@@ -73,8 +73,43 @@ class KubeletUnavailableError(TPUMounterError):
 
 
 class K8sApiError(TPUMounterError):
-    """Non-404 failure talking to the kube-apiserver."""
+    """Non-404 failure talking to the kube-apiserver.
 
-    def __init__(self, status: int, message: str):
-        super().__init__(f"apiserver error {status}: {message}")
+    ``status`` is the HTTP status, or 0 when no HTTP response was received
+    at all. Status 0 used to conflate every transport failure; ``cause``
+    now carries the underlying kind so the retry classifier and trace
+    error attributes can tell a socket timeout (the request may have
+    LANDED) from connection refusal (it certainly did not):
+
+    - ``"timeout"``   — connect/read deadline expired mid-request
+    - ``"refused"``   — TCP connection refused (nothing listening)
+    - ``"reset"``     — established connection reset/broken mid-stream
+    - ``"dns"``       — name resolution failed
+    - ``"unreachable"`` — other transport-level failure
+    - ``""``          — an HTTP-level error (status > 0) or legacy callers
+
+    ``retry_after_s`` carries a parsed ``Retry-After`` header (429/503)
+    for the backoff layer to honor.
+    """
+
+    def __init__(self, status: int, message: str, cause: str = "",
+                 retry_after_s: float | None = None):
+        detail = f" [{cause}]" if cause else ""
+        super().__init__(f"apiserver error {status}{detail}: {message}")
         self.status = status
+        self.cause = cause
+        self.retry_after_s = retry_after_s
+
+
+class CircuitOpenError(TPUMounterError):
+    """A circuit breaker is open: the target has failed enough consecutive
+    calls that further attempts are refused without dialing, until the
+    half-open probe succeeds. ``retry_after_s`` is the time until the next
+    probe slot — surfaced to HTTP callers as a Retry-After header."""
+
+    def __init__(self, target: str, retry_after_s: float):
+        super().__init__(
+            f"circuit open for {target}: failing fast "
+            f"(probe in {retry_after_s:.1f}s)")
+        self.target = target
+        self.retry_after_s = retry_after_s

@@ -304,6 +304,25 @@ class Registry:
             "Kubernetes apiserver and kubelet calls that raised, by verb "
             "and resource (includes expected 404s — same convention as "
             "client-go's rest_client metrics)")
+        # Resilience layer (utils/retry.py): every re-attempt against a
+        # coarse target (apiserver/kubelet/worker_rpc/watch) — the rate of
+        # transient faults the retry layer is absorbing. A quiet fleet
+        # shows ~0; a climbing rate is an outage being papered over.
+        self.retry_attempts = Counter(
+            "tpumounter_retry_attempts_total",
+            "Retried control-plane calls by target (each increment is one "
+            "re-attempt after a transient failure)")
+        # 0 closed / 1 half-open / 2 open, exported on every transition.
+        self.circuit_state = Gauge(
+            "tpumounter_circuit_state",
+            "Circuit breaker state per target "
+            "(0 closed, 1 half-open, 2 open)")
+        # Crash-safe attach journal (worker/journal.py): startup replays of
+        # records a crashed worker left incomplete, by what the replay did
+        # (completed / reverted / noop / failed).
+        self.journal_replays = Counter(
+            "tpumounter_journal_replays_total",
+            "Attach-journal records replayed at worker startup, by outcome")
         # Identifies the build on every /metrics surface (standard
         # <name>_info pattern: constant 1, the payload is the label).
         from gpumounter_tpu import __version__

@@ -1,0 +1,211 @@
+"""Crash-safe attach journal: write-ahead intent records for actuation.
+
+The worker mutates state the Kubernetes control plane cannot see — cgroup
+device programs and device nodes inside the target container. A worker
+crash between "slave pods allocated" and "actuation finished" used to
+leave that half-written state invisible to every repair loop: the
+reconciler (worker/reconciler.py) only reasons about slave pods whose
+OWNER died, and the request-id adoption machinery only helps if the
+caller retries. A pod could keep device access nobody accounted for.
+
+This journal closes the window with the classic write-ahead pattern:
+
+1. ``begin()`` appends an **intent** record (request id, owner pod,
+   device uuids, slave pods) to a node-local JSONL file *before* any
+   cgroup/mknod actuation;
+2. ``commit()`` marks it done after actuation + audit events succeed;
+3. ``revert()`` marks it undone after a clean rollback, and
+   ``revert_pending()`` records a rollback that was itself interrupted
+   (e.g. the apiserver died mid-revert) so the remainder is not lost.
+
+On startup the worker replays every record that is not terminal
+(worker/service.py ``replay_journal``): it re-derives ground truth from
+the cluster — owner pod liveness, surviving slave pods, the kubelet's
+device assignments — then either *completes* the attach (actuation is
+idempotent: existing device nodes short-circuit, cgroup sync is
+whole-set) or *reverts* it (unmount + release the slave pods). Either
+way, a crash mid-attach can no longer leak device access.
+
+Every line is one JSON object (append-only; a torn final line from the
+crash itself is detected and dropped). ``compact()`` rewrites the file
+to just the still-incomplete records after replay, so the journal stays
+small across restarts. Durability note: appends are flushed to the OS on
+every event, which survives any process crash; ``fsync=True`` adds
+power-loss durability at ~ms write cost.
+
+Served as ``GET /journalz`` on the worker health port alongside
+``/poolz`` and ``/tracez``; replay outcomes feed
+``tpumounter_journal_replays_total{outcome}``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import threading
+import time
+
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("worker.journal")
+
+# Record lifecycle: intent -> committed | reverted, with revert_pending as
+# the "rollback started but did not finish" intermediate. intent and
+# revert_pending are the INCOMPLETE states startup replay must resolve.
+INCOMPLETE_STATES = ("intent", "revert_pending")
+
+
+class AttachJournal:
+    """Append-only JSONL journal of attach actuations on one node."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        # jid -> {"state": ..., **intent payload}; insertion order is
+        # journal order (Python dicts preserve it), so replay handles
+        # crashes in the order the attaches happened.
+        self._records: dict[str, dict] = {}
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._load()
+
+    # -- persistence -----------------------------------------------------------
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        dropped = 0
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line)
+                except json.JSONDecodeError:
+                    # a torn final line IS the crash signature — the event
+                    # it described never fully happened; drop it
+                    dropped += 1
+                    continue
+                self._apply(event)
+        if dropped:
+            logger.warning("journal %s: dropped %d torn line(s)",
+                           self.path, dropped)
+        backlog = len(self.incomplete())
+        if backlog:
+            logger.warning("journal %s: %d incomplete attach record(s) "
+                           "await replay", self.path, backlog)
+
+    def _apply(self, event: dict) -> None:
+        jid = event.get("jid")
+        if not jid:
+            return
+        kind = event.get("event")
+        if kind == "intent":
+            record = dict(event)
+            record.pop("event", None)
+            record["state"] = "intent"
+            self._records[jid] = record
+        elif jid in self._records and kind in ("commit", "revert",
+                                               "revert_pending"):
+            self._records[jid]["state"] = {
+                "commit": "committed", "revert": "reverted",
+                "revert_pending": "revert_pending"}[kind]
+
+    def _append(self, event: dict) -> None:
+        line = json.dumps(event, sort_keys=True)
+        with open(self.path, "a") as f:
+            f.write(line + "\n")
+            f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+
+    # -- write side (the attach path) ------------------------------------------
+
+    def begin(self, rid: str, namespace: str, pod: str, uid: str,
+              devices: list[str], slaves: list[str],
+              entire: bool) -> str:
+        """Append the intent record BEFORE actuation; returns the journal
+        id the later commit/revert cites."""
+        jid = f"{rid or 'txn'}-{secrets.token_hex(4)}"
+        event = {"jid": jid, "event": "intent", "rid": rid,
+                 "namespace": namespace, "pod": pod, "uid": uid,
+                 "devices": sorted(devices), "slaves": sorted(slaves),
+                 "entire": entire, "ts": round(time.time(), 3)}
+        with self._lock:
+            self._append(event)
+            self._apply(event)
+        return jid
+
+    def _mark(self, jid: str, kind: str) -> None:
+        with self._lock:
+            if jid not in self._records:
+                logger.warning("journal %s: %s for unknown jid %s",
+                               self.path, kind, jid)
+                return
+            event = {"jid": jid, "event": kind,
+                     "ts": round(time.time(), 3)}
+            self._append(event)
+            self._apply(event)
+
+    def commit(self, jid: str) -> None:
+        self._mark(jid, "commit")
+
+    def revert(self, jid: str) -> None:
+        self._mark(jid, "revert")
+
+    def revert_pending(self, jid: str) -> None:
+        self._mark(jid, "revert_pending")
+
+    # -- read side (replay + /journalz) ----------------------------------------
+
+    def incomplete(self) -> list[dict]:
+        """Records startup replay must resolve, in journal order."""
+        with self._lock:
+            return [dict(r) for r in self._records.values()
+                    if r["state"] in INCOMPLETE_STATES]
+
+    def backlog(self) -> int:
+        return len(self.incomplete())
+
+    def compact(self) -> None:
+        """Rewrite the file keeping only incomplete records (terminal ones
+        are history the trace/event stores already tell better)."""
+        with self._lock:
+            keep = [r for r in self._records.values()
+                    if r["state"] in INCOMPLETE_STATES]
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                for record in keep:
+                    intent = {k: v for k, v in record.items()
+                              if k != "state"}
+                    intent["event"] = "intent"
+                    f.write(json.dumps(intent, sort_keys=True) + "\n")
+                    if record["state"] == "revert_pending":
+                        f.write(json.dumps(
+                            {"jid": record["jid"],
+                             "event": "revert_pending",
+                             "ts": round(time.time(), 3)}) + "\n")
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            self._records = {r["jid"]: r for r in keep}
+
+    def snapshot(self) -> dict:
+        """The /journalz payload: backlog + recent record states."""
+        from gpumounter_tpu.utils.metrics import REGISTRY
+        with self._lock:
+            records = [dict(r) for r in self._records.values()]
+        incomplete = [r for r in records
+                      if r["state"] in INCOMPLETE_STATES]
+        return {
+            "path": self.path,
+            "backlog": len(incomplete),
+            "incomplete": incomplete,
+            "records": records[-64:],
+            "replays": {outcome: int(REGISTRY.journal_replays.value(
+                outcome=outcome))
+                for outcome in ("completed", "reverted", "noop", "failed")},
+        }

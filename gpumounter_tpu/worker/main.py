@@ -35,6 +35,7 @@ HEALTH_PORT_OFFSET = 1  # health on grpc_port + 1 (1201 by default)
 class _HealthHandler(BaseHTTPRequestHandler):
     ready = False
     pool = None        # PoolManager, set by main() when the pool is enabled
+    journal = None     # AttachJournal, set by main() when journaling is on
 
     def log_message(self, *args):
         pass
@@ -71,6 +72,15 @@ class _HealthHandler(BaseHTTPRequestHandler):
                               else {"enabled": False}).encode()
             ctype = "application/json"
             code = 200
+        elif self.path == "/journalz":
+            # attach-journal introspection: backlog of incomplete records
+            # (should be 0 outside a crash window) + replay outcomes
+            import json
+            journal = type(self).journal
+            body = json.dumps(journal.snapshot() if journal is not None
+                              else {"enabled": False}).encode()
+            ctype = "application/json"
+            code = 200
         elif self.path in ("/healthz", "/readyz"):
             ok = type(self).ready or self.path == "/healthz"
             body = (b"ok" if ok else b"not ready")
@@ -91,6 +101,23 @@ def start_health_server(port: int) -> ThreadingHTTPServer:
     return server
 
 
+def _build_journal(settings: Settings):
+    """The attach journal, or None when disabled/unwritable. An unwritable
+    journal dir is LOUD but non-fatal: a worker that can't journal still
+    serves attaches (with the pre-journal crash window), which beats a
+    crash-looping DaemonSet on a misconfigured hostPath."""
+    if not settings.journal_path:
+        return None
+    from gpumounter_tpu.worker.journal import AttachJournal
+    try:
+        return AttachJournal(settings.journal_path)
+    except OSError as e:
+        logger.error("attach journal %s unusable (%s); running WITHOUT "
+                     "crash-safe attach journaling", settings.journal_path,
+                     e)
+        return None
+
+
 def build_stack(settings: Settings) -> TPUMountService:
     """Wire the production object graph (ref server.go:22-33 NewGPUMounter →
     NewGPUAllocator → NewGPUCollector; composition instead of embedding)."""
@@ -106,7 +133,8 @@ def build_stack(settings: Settings) -> TPUMountService:
                                      driver=settings.cgroup_driver)
     actuator = ProcRootActuator(settings.host)
     mounter = TPUMounter(cgroups, actuator, enumerator, settings.host)
-    return TPUMountService(allocator, mounter, kube, settings)
+    return TPUMountService(allocator, mounter, kube, settings,
+                           journal=_build_journal(settings))
 
 
 def main() -> None:
@@ -122,6 +150,13 @@ def main() -> None:
     # the kubelet socket is unavailable) — the nodeSelector guarantees TPU
     # nodes, so a broken stack here is a deploy error worth crashing on.
     service = build_stack(settings)
+    _HealthHandler.journal = service.journal
+    if service.journal is not None:
+        # BEFORE serving: a crash mid-attach must be repaired before new
+        # requests can race the leftover state
+        outcomes = service.replay_journal()
+        if outcomes:
+            logger.info("attach-journal replay: %s", outcomes)
     from gpumounter_tpu.worker.reconciler import OrphanReconciler
     reconciler = OrphanReconciler(service.kube, settings).start()
     pool = None

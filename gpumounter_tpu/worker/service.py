@@ -105,7 +105,7 @@ class TPUMountService:
 
     def __init__(self, allocator: TPUAllocator, mounter: TPUMounter,
                  kube: KubeClient, settings: Settings | None = None,
-                 pool=None):
+                 pool=None, journal=None):
         self.allocator = allocator
         self.mounter = mounter
         self.kube = kube
@@ -114,6 +114,11 @@ class TPUMountService:
         # pre-scheduled warm slave pods before falling back to the cold
         # create+wait path. None ⇒ exactly the historical behavior.
         self.pool = pool
+        # Optional AttachJournal (worker/journal.py): intent before
+        # actuation, commit after — a worker crash mid-attach is replayed
+        # at the next boot (replay_journal) instead of leaking device
+        # access. None ⇒ no journaling (unit rigs that predate it).
+        self.journal = journal
         # Per-request fencing: a gateway retry can arrive while the original
         # handler is still executing in this process (UNAVAILABLE from a
         # connection blip, not a worker death). Serialising same-request_id
@@ -253,6 +258,16 @@ class TPUMountService:
                 pod_name, namespace,
                 self.allocator.slave_pod_names(pod_name, namespace),
                 refresh=False)
+        # Write-ahead intent BEFORE any cgroup/mknod actuation: if the
+        # worker dies anywhere past this point, startup replay re-derives
+        # ground truth and completes or reverts — partial device grants
+        # cannot outlive a crash (worker/journal.py).
+        jid = None
+        if self.journal is not None:
+            jid = self.journal.begin(
+                request_id or txn_id, namespace, pod_name,
+                objects.uid(pod), [c.uuid for c in chips], list(slaves),
+                is_entire_mount)
         try:
             with trace.span("actuate"):
                 created_nodes = self.mounter.mount_chips(pod, chips,
@@ -263,14 +278,25 @@ class TPUMountService:
                          len(slaves), e)
             remaining = [c for c in all_after
                          if c.uuid not in {x.uuid for x in chips}]
+            rollback_clean = True
             with trace.span("rollback"):
                 try:
                     self.mounter.unmount_chips(pod, chips, remaining,
                                                force=False)
                 except TPUMounterError as cleanup_err:
+                    rollback_clean = False
                     logger.warning("rollback unmount incomplete: %s",
                                    cleanup_err)
-                self.allocator.delete_slave_pods(slaves, wait=False)
+                if self.allocator.delete_slave_pods(slaves, wait=False):
+                    rollback_clean = False
+            if jid is not None:
+                # a clean rollback closes the record; an interrupted one
+                # (apiserver died mid-revert, busy device) journals the
+                # leftover so the next boot finishes the revert
+                if rollback_clean:
+                    self.journal.revert(jid)
+                else:
+                    self.journal.revert_pending(jid)
             self._record_event(pod, "TPUAttachFailed",
                                f"actuation failed, rolled back: {e}",
                                warning=True)
@@ -287,6 +313,8 @@ class TPUMountService:
         # between allocate and mount) is the completing attempt and records
         # the real TPUAttached.
         resumed = bool(adopt) and set(slaves) <= adopt and created_nodes == 0
+        if jid is not None:
+            self.journal.commit(jid)
         self._record_event(
             pod, "TPUAttachResumed" if resumed else "TPUAttached",
             f"attached {len(chips)} TPU chip(s) "
@@ -520,6 +548,121 @@ class TPUMountService:
                                          topology=topo.topology)
                      for c in chips]
         return chips
+
+    # -- crash recovery: attach-journal replay (worker/journal.py) ------------
+
+    def replay_journal(self) -> dict[str, int]:
+        """Resolve every incomplete journal record at worker startup.
+
+        Ground truth is re-derived from the cluster per record (owner pod
+        liveness + surviving slave pods + the kubelet's device map), never
+        trusted from the journal alone — the cluster moved on while this
+        worker was down. Returns {outcome: count}; each outcome also feeds
+        ``tpumounter_journal_replays_total``."""
+        if self.journal is None:
+            return {}
+        outcomes: collections.Counter = collections.Counter()
+        for record in self.journal.incomplete():
+            try:
+                outcome = self._replay_record(record)
+            except TPUMounterError:
+                # a record that cannot be resolved now stays incomplete
+                # (retried next boot); a broken record must not block boot
+                logger.exception("journal replay of %s failed",
+                                 record.get("jid"))
+                outcome = "failed"
+            outcomes[outcome] += 1
+            REGISTRY.journal_replays.inc(outcome=outcome)
+            logger.info("journal replay %s (%s/%s devices=%s): %s",
+                        record.get("jid"), record.get("namespace"),
+                        record.get("pod"), record.get("devices"), outcome)
+        self.journal.compact()
+        return dict(outcomes)
+
+    def _replay_record(self, record: dict) -> str:
+        namespace, pod_name = record["namespace"], record["pod"]
+        devices = set(record.get("devices") or [])
+        slaves = set(record.get("slaves") or [])
+        try:
+            pod = self.kube.get_pod(namespace, pod_name)
+        except PodNotFoundError:
+            pod = None
+        # A same-named recreated pod is NOT the pod this attach targeted.
+        owner_alive = (pod is not None and objects.is_running(pod)
+                       and (not record.get("uid")
+                            or objects.uid(pod) == record["uid"]))
+        live_slaves = {name for name in slaves
+                       if self._slave_pod_exists(name)}
+
+        if record["state"] == "intent" and owner_alive \
+                and live_slaves == slaves:
+            # Crash was mid-attach and everything still stands: COMPLETE
+            # it. Actuation is idempotent (existing nodes short-circuit,
+            # cgroup sync is whole-set), so re-running is safe whether the
+            # crash hit before, during, or after the original actuation.
+            self.allocator.collector.update_status()
+            all_names = self.allocator.slave_pod_names(pod_name, namespace)
+            all_chips = self.allocator.collector.get_pod_tpu_resources_exact(
+                pod_name, namespace, all_names, refresh=False)
+            chips = [c for c in all_chips if c.uuid in devices]
+            if {c.uuid for c in chips} == devices:
+                self.mounter.mount_chips(pod, chips, all_chips)
+                self.journal.commit(record["jid"])
+                # TPUAttachResumed, not TPUAttached: the original attempt's
+                # event (if it got that far) plus this one must not read as
+                # two logical attaches
+                self._record_event(
+                    pod, "TPUAttachResumed",
+                    f"journal replay completed attach of {sorted(devices)}")
+                return "completed"
+            # kubelet no longer maps those devices to these pods: the
+            # reservation is gone — fall through to revert
+
+        if not owner_alive and not live_slaves:
+            self.journal.revert(record["jid"])
+            return "noop"
+
+        # REVERT: undo whatever was partially actuated, then release the
+        # slave-pod reservations. Owner gone ⇒ its cgroup/mount ns died
+        # with it, only the reservations remain.
+        if owner_alive:
+            self.allocator.collector.update_status()
+            all_names = self.allocator.slave_pod_names(pod_name, namespace)
+            all_chips = self.allocator.collector.get_pod_tpu_resources_exact(
+                pod_name, namespace, all_names, refresh=False)
+            doomed = [c for c in all_chips if c.uuid in devices]
+            remaining = [c for c in all_chips if c.uuid not in devices]
+            try:
+                self.mounter.unmount_chips(pod, doomed, remaining,
+                                           force=False)
+            except DeviceBusyError:
+                # the pod IS using a device from an uncommitted attach:
+                # yanking it would kill the workload. Leave the record
+                # incomplete (next boot retries) and surface the conflict.
+                self._record_event(
+                    pod, "TPUAttachFailed",
+                    "journal replay found uncommitted devices in use; "
+                    "revert deferred", warning=True)
+                return "failed"
+        if self.allocator.delete_slave_pods(sorted(live_slaves),
+                                            wait=False):
+            # apiserver trouble mid-revert AGAIN: keep the record pending
+            self.journal.revert_pending(record["jid"])
+            return "failed"
+        self.journal.revert(record["jid"])
+        if pod is not None:
+            self._record_event(
+                pod, "TPUAttachReverted",
+                f"journal replay reverted uncommitted attach of "
+                f"{sorted(devices)}", warning=True)
+        return "reverted"
+
+    def _slave_pod_exists(self, name: str) -> bool:
+        try:
+            self.kube.get_pod(self.settings.pool_namespace, name)
+            return True
+        except PodNotFoundError:
+            return False
 
     @staticmethod
     def _partially_covered_holders(chips: list[TPUChip], holders: list[str],

@@ -436,3 +436,73 @@ def test_doctor_window_gauge_decrease_is_not_a_counter_reset(monkeypatch):
     assert rc == 0, out
     assert "counter reset" not in out
     assert "in the last 5s" in out              # windowed judgement kept
+
+
+def test_doctor_reports_open_circuit_as_crit(monkeypatch):
+    """An open breaker is CURRENT state (the target is failing fast right
+    now), so it may page — unlike cumulative counters."""
+    metrics = "\n".join([
+        'tpumounter_circuit_state{target="10.0.0.5:1200"} 2',
+        'tpumounter_circuit_state{target="10.0.0.6:1200"} 0',
+        "tpumounter_retry_attempts_total 12",
+    ])
+
+    def fake_fetch(master, path, timeout):
+        if path == "/healthz":
+            return '{"status": "ok"}'
+        if path == "/journalz":
+            raise cli.TransportError("no journal here")
+        return metrics
+
+    monkeypatch.setattr(cli, "_fetch_text", fake_fetch)
+    rc, out = run_cli("http://unused", "doctor")
+    assert rc == cli.EXIT_DOCTOR_CRIT, out
+    assert "circuit OPEN for 10.0.0.5:1200" in out
+    assert "retries absorbed: 12" in out
+
+
+def test_doctor_reports_closed_circuits_and_journal_backlog(monkeypatch):
+    """Healthy circuits are an OK line; a worker /journalz backlog WARNs
+    (incomplete actuation state is sitting on the node)."""
+    metrics = 'tpumounter_circuit_state{target="10.0.0.5:1200"} 0\n'
+
+    def fake_fetch(master, path, timeout):
+        if path == "/healthz":
+            return "ok"                          # worker-style healthz
+        if path == "/journalz":
+            return json.dumps({"backlog": 2, "incomplete": [],
+                               "records": [], "replays": {}})
+        return metrics
+
+    monkeypatch.setattr(cli, "_fetch_text", fake_fetch)
+    rc, out = run_cli("http://unused", "doctor")
+    assert rc == 1, out
+    assert "all 1 circuit(s) closed" in out
+    assert "attach-journal backlog: 2" in out
+
+
+def test_doctor_windowed_retry_activity_warns(monkeypatch):
+    """Retries inside the window mean the control plane is absorbing
+    faults RIGHT NOW — warn; the same lifetime total alone is just
+    history."""
+    scrapes = ["tpumounter_retry_attempts_total 100\n",
+               "tpumounter_retry_attempts_total 104\n"]
+
+    def fake_fetch(master, path, timeout):
+        if path == "/healthz":
+            return '{"status": "ok"}'
+        if path == "/journalz":
+            raise cli.TransportError("no journal here")
+        return scrapes.pop(0) if len(scrapes) > 1 else scrapes[0]
+
+    monkeypatch.setattr(cli, "_fetch_text", fake_fetch)
+    monkeypatch.setattr(cli.time, "sleep", lambda s: None)
+    rc, out = run_cli("http://unused", "doctor", "--window", "5")
+    assert rc == 1, out
+    assert "retries absorbed: 4" in out
+    assert "in the last 5s" in out
+
+    rc, out = run_cli("http://unused", "doctor")
+    assert rc == 0, out
+    assert "retries absorbed: 104" in out
+    assert "lifetime" in out

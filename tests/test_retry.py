@@ -1,0 +1,330 @@
+"""Unit tests for the unified retry/backoff + circuit-breaker layer
+(utils/retry.py) and the transport-cause classification it keys on."""
+
+import threading
+
+import pytest
+
+from gpumounter_tpu.utils.errors import (CircuitOpenError, DeviceBusyError,
+                                         K8sApiError,
+                                         KubeletUnavailableError,
+                                         MountPolicyError, PodNotFoundError)
+from gpumounter_tpu.utils.retry import (CircuitBreaker, RetryBudget,
+                                        RetryPolicy, call_with_retry,
+                                        retryable,
+                                        retryable_non_idempotent)
+
+
+# -- classifier ----------------------------------------------------------------
+
+@pytest.mark.parametrize("exc,expected", [
+    (K8sApiError(429, "throttled"), True),
+    (K8sApiError(500, "boom"), True),
+    (K8sApiError(503, "unavailable"), True),
+    (K8sApiError(0, "refused", cause="refused"), True),
+    (K8sApiError(0, "timeout", cause="timeout"), True),
+    (K8sApiError(400, "bad request"), False),
+    (K8sApiError(404, "gone"), False),
+    (K8sApiError(409, "conflict"), False),   # optimistic-concurrency loss
+    (K8sApiError(410, "expired"), False),    # needs a re-LIST, not a retry
+    (PodNotFoundError("ns", "p"), False),
+    (KubeletUnavailableError("socket flap"), True),
+    (MountPolicyError("denied"), False),
+    (DeviceBusyError("0", [42]), False),
+    (ValueError("a bug"), False),
+])
+def test_retryable_classifier(exc, expected):
+    assert retryable(exc) is expected
+
+
+@pytest.mark.parametrize("exc,expected", [
+    # provably-never-landed failures: replay is safe even for a create
+    (K8sApiError(0, "refused", cause="refused"), True),
+    (K8sApiError(0, "dns", cause="dns"), True),
+    (K8sApiError(429, "throttled"), True),
+    # ambiguous failures: the request MAY have landed — never replayed
+    (K8sApiError(0, "timeout", cause="timeout"), False),
+    (K8sApiError(0, "reset", cause="reset"), False),
+    (K8sApiError(500, "boom"), False),
+    (K8sApiError(503, "unavailable"), False),
+    (K8sApiError(409, "already exists"), False),
+    (PodNotFoundError("ns", "p"), False),
+])
+def test_non_idempotent_classifier_only_replays_provably_unlanded(
+        exc, expected):
+    assert retryable_non_idempotent(exc) is expected
+
+
+def test_grpc_unavailable_is_retryable_other_codes_not():
+    import grpc
+
+    class Unavailable(grpc.RpcError):
+        def code(self):
+            return grpc.StatusCode.UNAVAILABLE
+
+    class Internal(grpc.RpcError):
+        def code(self):
+            return grpc.StatusCode.INTERNAL
+
+    assert retryable(Unavailable()) is True
+    assert retryable(Internal()) is False
+
+
+def test_k8s_api_error_carries_cause_and_retry_after():
+    e = K8sApiError(0, "conn refused", cause="refused")
+    assert e.cause == "refused"
+    assert "[refused]" in str(e)
+    e = K8sApiError(429, "slow down", retry_after_s=2.5)
+    assert e.retry_after_s == 2.5
+
+
+# -- policy --------------------------------------------------------------------
+
+def test_policy_delays_grow_and_cap():
+    policy = RetryPolicy(base_delay_s=0.1, max_delay_s=0.5, jitter=0.0)
+    assert policy.delay_s(1) == pytest.approx(0.1)
+    assert policy.delay_s(2) == pytest.approx(0.2)
+    assert policy.delay_s(3) == pytest.approx(0.4)
+    assert policy.delay_s(4) == pytest.approx(0.5)     # capped
+    assert policy.delay_s(10) == pytest.approx(0.5)
+
+
+def test_policy_jitter_bounds():
+    policy = RetryPolicy(base_delay_s=1.0, max_delay_s=1.0, jitter=0.25)
+    for _ in range(50):
+        assert 0.75 <= policy.delay_s(1) <= 1.25
+
+
+def _fail_n_times(n, exc_factory, result="ok"):
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] <= n:
+            raise exc_factory()
+        return result
+    fn.calls = calls
+    return fn
+
+
+FAST = RetryPolicy(max_attempts=4, base_delay_s=0.001, max_delay_s=0.002,
+                   deadline_s=5.0, jitter=0.0)
+
+
+def test_call_with_retry_recovers_from_transient_burst():
+    fn = _fail_n_times(2, lambda: K8sApiError(500, "blip"))
+    assert call_with_retry(fn, policy=FAST, target="t") == "ok"
+    assert fn.calls["n"] == 3
+
+
+def test_call_with_retry_gives_up_after_max_attempts():
+    fn = _fail_n_times(99, lambda: K8sApiError(500, "down"))
+    with pytest.raises(K8sApiError):
+        call_with_retry(fn, policy=FAST, target="t")
+    assert fn.calls["n"] == FAST.max_attempts
+
+
+def test_call_with_retry_never_retries_deterministic_denials():
+    fn = _fail_n_times(99, lambda: K8sApiError(404, "no such pod"))
+    with pytest.raises(K8sApiError):
+        call_with_retry(fn, policy=FAST, target="t")
+    assert fn.calls["n"] == 1       # one-shot: retrying can't change a 404
+
+
+def test_call_with_retry_honors_server_retry_after():
+    slept = []
+    fn = _fail_n_times(
+        1, lambda: K8sApiError(429, "throttled", retry_after_s=0.123))
+    call_with_retry(fn, policy=FAST, target="t", sleep=slept.append)
+    assert slept == [0.123]         # server hint beats computed backoff
+
+
+def test_call_with_retry_respects_deadline():
+    # retry_after far beyond the deadline: fail now instead of sleeping
+    fn = _fail_n_times(
+        99, lambda: K8sApiError(429, "throttled", retry_after_s=60.0))
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.001,
+                         deadline_s=0.05, jitter=0.0)
+    with pytest.raises(K8sApiError):
+        call_with_retry(fn, policy=policy, target="t")
+    assert fn.calls["n"] == 1
+
+
+def test_call_with_retry_counts_attempts_metric():
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    before = REGISTRY.retry_attempts.value(target="unit-test")
+    fn = _fail_n_times(2, lambda: K8sApiError(500, "blip"))
+    call_with_retry(fn, policy=FAST, target="unit-test")
+    assert REGISTRY.retry_attempts.value(target="unit-test") == before + 2
+
+
+def test_retry_budget_exhaustion_turns_failures_terminal():
+    budget = RetryBudget(capacity=1.0, deposit_per_success=0.0)
+    fn = _fail_n_times(99, lambda: K8sApiError(500, "down"))
+    with pytest.raises(K8sApiError):
+        call_with_retry(fn, policy=FAST, target="t", budget=budget)
+    assert fn.calls["n"] == 2       # 1 retry spent the whole budget
+    fn2 = _fail_n_times(99, lambda: K8sApiError(500, "down"))
+    with pytest.raises(K8sApiError):
+        call_with_retry(fn2, policy=FAST, target="t", budget=budget)
+    assert fn2.calls["n"] == 1      # empty bucket: no retries at all
+
+
+def test_retry_budget_refills_on_success():
+    budget = RetryBudget(capacity=2.0, deposit_per_success=1.0)
+    assert budget.try_spend() and budget.try_spend()
+    assert not budget.try_spend()
+    budget.deposit()
+    assert budget.try_spend()
+
+
+# -- circuit breaker -----------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_breaker_opens_after_threshold_and_fails_fast():
+    clock = _Clock()
+    breaker = CircuitBreaker("w1", failure_threshold=3,
+                             reset_timeout_s=10.0, clock=clock)
+    for _ in range(3):
+        breaker.allow()
+        breaker.record_failure()
+    with pytest.raises(CircuitOpenError) as exc:
+        breaker.allow()
+    assert exc.value.target == "w1"
+    assert 0 < exc.value.retry_after_s <= 10.0
+    breaker.record_success()   # close: the state gauge is process-global
+
+
+def test_breaker_half_open_admits_single_probe_then_closes():
+    clock = _Clock()
+    breaker = CircuitBreaker("w1", failure_threshold=1,
+                             reset_timeout_s=10.0, clock=clock)
+    breaker.record_failure()
+    with pytest.raises(CircuitOpenError):
+        breaker.allow()
+    clock.now += 11.0
+    breaker.allow()                  # the probe slot
+    with pytest.raises(CircuitOpenError):
+        breaker.allow()              # concurrent caller: no probe stampede
+    breaker.record_success()
+    assert breaker.state == CircuitBreaker.CLOSED
+    breaker.allow()
+
+
+def test_breaker_failed_probe_reopens():
+    clock = _Clock()
+    breaker = CircuitBreaker("w1", failure_threshold=1,
+                             reset_timeout_s=10.0, clock=clock)
+    breaker.record_failure()
+    clock.now += 11.0
+    breaker.allow()
+    breaker.record_failure()         # probe failed
+    assert breaker.state == CircuitBreaker.OPEN
+    with pytest.raises(CircuitOpenError):
+        breaker.allow()
+    breaker.record_success()   # close: the state gauge is process-global
+
+
+def test_breaker_exports_state_gauge():
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    clock = _Clock()
+    breaker = CircuitBreaker("gauge-target", failure_threshold=1,
+                             reset_timeout_s=10.0, clock=clock)
+    assert REGISTRY.circuit_state.value(target="gauge-target") == 0
+    breaker.record_failure()
+    assert REGISTRY.circuit_state.value(target="gauge-target") == 2
+    clock.now += 11.0
+    breaker.allow()
+    assert REGISTRY.circuit_state.value(target="gauge-target") == 1
+    breaker.record_success()
+    assert REGISTRY.circuit_state.value(target="gauge-target") == 0
+
+
+def test_breaker_thread_safety_single_probe_under_contention():
+    clock = _Clock()
+    breaker = CircuitBreaker("w1", failure_threshold=1,
+                             reset_timeout_s=1.0, clock=clock)
+    breaker.record_failure()
+    clock.now += 2.0
+    admitted = []
+    barrier = threading.Barrier(8)
+
+    def contender():
+        barrier.wait()
+        try:
+            breaker.allow()
+            admitted.append(1)
+        except CircuitOpenError:
+            pass
+    threads = [threading.Thread(target=contender) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(admitted) == 1        # exactly one probe
+    breaker.record_success()   # close: the state gauge is process-global
+
+
+# -- transport-cause classification (satellite: status-0 disambiguation) ------
+
+def test_transport_causes_distinguish_timeout_from_refusal():
+    import socket
+
+    from gpumounter_tpu.k8s.client import _transport_cause
+    assert _transport_cause(TimeoutError("timed out")) == "timeout"
+    assert _transport_cause(ConnectionRefusedError()) == "refused"
+    assert _transport_cause(ConnectionResetError()) == "reset"
+    assert _transport_cause(socket.gaierror()) == "dns"
+    assert _transport_cause("generic failure") == "unreachable"
+
+
+def test_rest_client_classifies_connection_refused(tmp_path):
+    """A real closed port: the one-shot layer must report status 0 with
+    cause "refused" (not a bare status-0) and the retry layer must have
+    re-attempted before giving up."""
+    from gpumounter_tpu.k8s.client import KubeconfigKubeClient
+    from gpumounter_tpu.testing.http_apiserver import write_kubeconfig
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    # grab a port nothing listens on
+    import socket as socket_mod
+    s = socket_mod.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    cfg = write_kubeconfig(str(tmp_path / "kubeconfig"),
+                           f"http://127.0.0.1:{port}")
+    client = KubeconfigKubeClient(cfg)
+    client.retry_policy = RetryPolicy(max_attempts=2, base_delay_s=0.01,
+                                      max_delay_s=0.01, deadline_s=2.0,
+                                      jitter=0.0)
+    before = REGISTRY.retry_attempts.value(target="apiserver")
+    with pytest.raises(K8sApiError) as exc:
+        client.get_pod("default", "nope")
+    assert exc.value.status == 0
+    assert exc.value.cause == "refused"
+    assert REGISTRY.retry_attempts.value(target="apiserver") == before + 1
+
+
+def test_fake_watch_resumes_after_midstream_death():
+    """A watch stream killed mid-flight resumes from the last seen
+    resourceVersion: the consumer sees every event exactly once."""
+    from gpumounter_tpu.k8s.client import FakeKubeClient
+    from gpumounter_tpu.testing.chaos import Fault, FaultInjector
+    from gpumounter_tpu.testing.sim import make_target_pod
+    kube = FakeKubeClient()
+    for i in range(3):
+        kube.put_pod(make_target_pod(name=f"p{i}"))
+    # first watch poll round passes, next two die mid-stream
+    kube.faults = FaultInjector([
+        Fault(op="WATCH", resource="pods", status=0, cause="reset",
+              times=2, after=1)])
+    events = list(kube.watch_pods("default", timeout_s=1.0))
+    names = [pod["metadata"]["name"] for _, pod in events]
+    assert names == ["p0", "p1", "p2"]       # no loss, no duplicates

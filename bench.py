@@ -16,7 +16,16 @@ kubelet. Two configurations are measured:
 - **e2e-with-pool**: the same injected delay, but a warm slave-pod pool
   (worker/pool.py) absorbs it off the request path — each timed attach
   adopts a pre-scheduled warm pod, so the pool-hit p50 should land next
-  to the bare overhead, not next to the cold e2e number.
+  to the bare overhead, not next to the cold e2e number. This config also
+  counts **apiserver round-trips per attach** (by verb, from the
+  ``k8s_request_seconds`` family the in-process worker shares): with the
+  shared informer wired the warm path performs ZERO LISTs.
+- **multi-chip**: an 8-chip entire-node attach (overhead mode) — the
+  fused-actuation configuration, where all mknods for a container ride
+  ONE namespace crossing (``multi_chip_attach_p50_s``).
+
+Every rig runs with the shared pod informer enabled — the production
+default wiring (worker/main.py).
 
 The headline metric is the **e2e p50** (honest, delay included); p99 and
 the bare overhead are reported alongside. The reference publishes no
@@ -28,7 +37,11 @@ selftest (:mod:`gpumounter_tpu.jaxcheck.tpu_selftest`) runs in a subprocess
 and its hardware evidence — train-step ms on the chip, pallas-vs-oracle
 parity error, backend re-init time — is embedded under ``"tpu"``.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Output contract: the FULL result (with the complete TPU report) goes to
+stderr and ``BENCH_DETAIL.json``; stdout's final line is a COMPACT
+single-line JSON summary — the harness parses the last stdout line, and a
+multi-KB line gets truncated by its tail window (every BENCH_r0*.json
+with an embedded selftest parsed as null before this split).
 """
 
 from __future__ import annotations
@@ -50,18 +63,32 @@ CHIPS = 4
 SCHED_DELAY_S = 1.0     # injected scheduler+kubelet cost for the e2e config
 
 
+def _k8s_counts() -> dict:
+    """(verb, resource) -> cumulative round-trip count, from the shared
+    in-process registry (the LiveStack worker runs in-process, so its
+    instrumentation IS this process's)."""
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    return {(d["verb"], d["resource"]): REGISTRY.k8s_latency.count(**d)
+            for d in REGISTRY.k8s_latency.phases()}
+
+
 def measure_attach_cycle(schedule_delay_s: float, cycles: int,
                          n_chips: int = CHIPS, entire: bool = True,
-                         warm_pool: bool = False
-                         ) -> tuple[list[float], list[float]]:
+                         warm_pool: bool = False,
+                         count_round_trips: bool = False
+                         ) -> tuple[list[float], list[float], list[dict]]:
     """Drive attach+detach cycles; returns (attach_latencies,
-    detach_latencies) in seconds.
+    detach_latencies, per_attach_round_trips) in seconds / verb-counts.
 
     ``warm_pool=True`` sizes a warm slave-pod pool to exactly cover one
     attach and refills it between cycles OFF the timed path — each timed
     attach is then a pure pool hit, which is the number the pool exists to
     produce: the injected scheduler delay is paid by the refill loop, not
-    the attach."""
+    the attach.
+
+    ``count_round_trips=True`` snapshots the apiserver call counters
+    around each TIMED attach and records the per-verb deltas for pods/
+    nodes (events are async audit noise, kubelet is a different hop)."""
     from gpumounter_tpu.testing.sim import LiveStack, WorkerRig
     from gpumounter_tpu.utils.config import HostPaths
 
@@ -77,10 +104,10 @@ def measure_attach_cycle(schedule_delay_s: float, cycles: int,
     if warm_pool:
         pool_sizes = ({f"entire:{n_chips}": 1} if entire
                       else {"single:1": n_chips})
-    rig = WorkerRig(host, n_chips=CHIPS, actuator="procroot",
+    rig = WorkerRig(host, n_chips=max(CHIPS, n_chips), actuator="procroot",
                     use_kubelet_socket=True,
                     schedule_delay_s=schedule_delay_s,
-                    warm_pool=pool_sizes)
+                    warm_pool=pool_sizes, informer=True)
     stack = LiveStack(rig)
     attach = (f"{stack.base}/addtpu/namespace/default/pod/workload"
               f"/tpu/{n_chips}/isEntireMount/{str(entire).lower()}")
@@ -89,12 +116,21 @@ def measure_attach_cycle(schedule_delay_s: float, cycles: int,
     try:
         if warm_pool:
             rig.fill_warm_pool()
-        attach_lat, detach_lat = [], []
+        attach_lat, detach_lat, round_trips = [], [], []
         for _ in range(cycles):
+            before = _k8s_counts() if count_round_trips else None
             t0 = time.monotonic()
             with urllib.request.urlopen(attach) as resp:
                 body = json.loads(resp.read())
             attach_lat.append(time.monotonic() - t0)
+            if before is not None:
+                after = _k8s_counts()
+                round_trips.append({
+                    f"{verb}/{res}": after[(verb, res)]
+                    - before.get((verb, res), 0)
+                    for verb, res in after
+                    if res in ("pods", "nodes")
+                    and after[(verb, res)] != before.get((verb, res), 0)})
             assert body["result"] == "SUCCESS", body
             req = urllib.request.Request(
                 detach,
@@ -106,10 +142,25 @@ def measure_attach_cycle(schedule_delay_s: float, cycles: int,
             detach_lat.append(time.monotonic() - t0)
             if warm_pool:
                 rig.fill_warm_pool()        # refill off the timed path
-        return attach_lat, detach_lat
+        return attach_lat, detach_lat, round_trips
     finally:
         stack.close()
         shutil.rmtree(root, ignore_errors=True)
+
+
+def _round_trip_summary(per_attach: list[dict]) -> dict:
+    """Median per-verb apiserver round-trips per attach, plus the median
+    total — medians so an occasional TTL-driven discovery refresh doesn't
+    smear the steady-state figure."""
+    if not per_attach:
+        return {}
+    verbs = sorted({verb for sample in per_attach for verb in sample})
+    summary = {verb: statistics.median(
+        [sample.get(verb, 0) for sample in per_attach]) for verb in verbs}
+    summary["total"] = statistics.median(
+        [sum(sample.values()) for sample in per_attach])
+    return {verb: int(count) if float(count).is_integer() else count
+            for verb, count in summary.items()}
 
 
 def tpu_metrics() -> dict | None:
@@ -173,10 +224,26 @@ def _pct(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[max(math.ceil(q * len(sorted_vals)) - 1, 0)]
 
 
+def _compact_tpu(tpu: dict) -> dict:
+    """Slim hardware summary for the final stdout line — the full report
+    lives in BENCH_DETAIL.json / stderr."""
+    out = {"ok": tpu.get("ok"), "backend": tpu.get("backend"),
+           "device_count": tpu.get("device_count")}
+    perf = tpu.get("perf") or {}
+    if perf:
+        out["mfu"] = perf.get("mfu")
+        out["train_step_ms"] = perf.get("train_step_ms")
+    if "pallas_err_vs_oracle" in tpu:
+        out["pallas_err_vs_oracle"] = tpu["pallas_err_vs_oracle"]
+    if "error" in tpu:
+        out["error"] = str(tpu["error"])[:200]
+    return out
+
+
 def main() -> None:
     # overhead mode (no injected delay): 100 cycles so the p99 is a real
     # percentile of the framework's own cost, not the max
-    overhead, overhead_detach = measure_attach_cycle(0.0, cycles=100)
+    overhead, overhead_detach, _ = measure_attach_cycle(0.0, cycles=100)
     # Phase decomposition of the overhead cycles straight from the worker's
     # own tracing histograms (the LiveStack worker runs in-process, so the
     # registry is shared): where the framework's milliseconds go.
@@ -189,21 +256,26 @@ def main() -> None:
         f"detach_{d['phase']}": round(
             REGISTRY.detach_phase.percentile(50, **d) * 1e3, 2)
         for d in REGISTRY.detach_phase.phases()})
-    single, single_detach = measure_attach_cycle(0.0, cycles=25, n_chips=1,
-                                                 entire=False)
+    single, single_detach, _ = measure_attach_cycle(0.0, cycles=25,
+                                                    n_chips=1, entire=False)
+    # entire-NODE attach: 8 chips through one slave pod — the fused
+    # actuation configuration (all mknods per container in one crossing)
+    multi, _, _ = measure_attach_cycle(0.0, cycles=25, n_chips=8)
     # >=100 e2e cycles so the p99 is a real percentile, not the max
     # (r2 VERDICT weak #8)
-    e2e, _ = measure_attach_cycle(SCHED_DELAY_S, cycles=100)
+    e2e, _, _ = measure_attach_cycle(SCHED_DELAY_S, cycles=100)
     e2e_sorted = sorted(e2e)
     p50 = statistics.median(e2e)
     p99 = _pct(e2e_sorted, 0.99)
     # third config: SAME injected per-slave-pod scheduler delay, but a warm
     # pool sized to cover the attach — a pool hit pays only actuation, so
-    # this p50 should sit next to overhead_p50, not next to e2e p50
+    # this p50 should sit next to overhead_p50, not next to e2e p50. Also
+    # the config that counts apiserver round-trips per attach: with the
+    # informer the warm path must show ZERO LISTs.
     hits_before = REGISTRY.pool_hits.value()
     misses_before = REGISTRY.pool_misses.value()
-    pool_e2e, _ = measure_attach_cycle(SCHED_DELAY_S, cycles=50,
-                                       warm_pool=True)
+    pool_e2e, _, pool_round_trips = measure_attach_cycle(
+        SCHED_DELAY_S, cycles=50, warm_pool=True, count_round_trips=True)
     pool_hits = REGISTRY.pool_hits.value() - hits_before
     pool_misses = REGISTRY.pool_misses.value() - misses_before
     result = {
@@ -217,6 +289,7 @@ def main() -> None:
         "single_chip_attach_p50_s": round(statistics.median(single), 4),
         "single_chip_detach_p50_s": round(
             statistics.median(single_detach), 4),
+        "multi_chip_attach_p50_s": round(statistics.median(multi), 4),
         "detach_p50_s": round(statistics.median(overhead_detach), 4),
         "injected_schedule_delay_s": SCHED_DELAY_S,
         "overhead_phase_p50_ms": phase_p50_ms,
@@ -224,13 +297,32 @@ def main() -> None:
         "pool_hit_e2e_p99_s": round(_pct(sorted(pool_e2e), 0.99), 4),
         "pool_hits": int(pool_hits),
         "pool_misses": int(pool_misses),
+        "apiserver_round_trips_per_attach": _round_trip_summary(
+            pool_round_trips),
         "cycles": {"overhead": len(overhead), "single": len(single),
-                   "e2e": len(e2e), "e2e_with_pool": len(pool_e2e)},
+                   "multi_chip": len(multi), "e2e": len(e2e),
+                   "e2e_with_pool": len(pool_e2e)},
     }
     tpu = tpu_metrics()
     if tpu is not None:
         result["tpu"] = tpu
-    print(json.dumps(result))
+    # Full result: stderr + sidecar file (humans / archaeology). Final
+    # stdout line: COMPACT summary — the harness parses the LAST stdout
+    # line and its tail window truncates multi-KB lines (the "parsed":
+    # null failure mode of every selftest-bearing BENCH_r0*.json).
+    print(json.dumps(result, indent=2), file=sys.stderr)
+    try:
+        detail_path = os.path.join(os.path.dirname(os.path.abspath(
+            __file__)), "BENCH_DETAIL.json")
+        with open(detail_path, "w") as f:
+            json.dump(result, f, indent=2)
+    except OSError:
+        pass
+    compact = dict(result)
+    if "tpu" in compact:
+        compact["tpu"] = _compact_tpu(compact["tpu"])
+    sys.stdout.flush()
+    print(json.dumps(compact), flush=True)
 
 
 if __name__ == "__main__":

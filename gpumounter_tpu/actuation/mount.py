@@ -14,6 +14,7 @@ Ref ``pkg/util/util.go``: ``MountGPU`` (:17-71), ``UnmountGPU`` (:73-150),
 
 from __future__ import annotations
 
+import concurrent.futures
 import os
 
 from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
@@ -26,8 +27,19 @@ from gpumounter_tpu.utils.config import HostPaths
 from gpumounter_tpu.utils.errors import (ActuationError, CgroupError,
                                          DeviceBusyError, MountPolicyError)
 from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
 
 logger = get_logger("actuation.mount")
+
+# Bound on concurrent per-container actuation threads (mirrors the slice
+# coordinator's fan-out, master/slice.py).
+_FAN_OUT_WORKERS = 8
+
+
+def _observe_batch(op: str, size: int) -> None:
+    REGISTRY.actuation_batches.inc(op=op)
+    REGISTRY.actuation_batch_ops.inc(size, op=op)
+    REGISTRY.actuation_batch_size.set(size, op=op)
 
 
 def can_mount(current: consts.MountType, requested_entire: bool) -> bool:
@@ -146,34 +158,71 @@ class TPUMounter:
                 busy[chip.uuid] = holders
         return busy
 
+    def _fan_out_containers(self, containers: list[tuple[str, int]],
+                            fn) -> list:
+        """Run ``fn(container_id, pid)`` for every actuatable container —
+        inline for the common single-container pod (no thread overhead,
+        exact legacy semantics), bounded ThreadPoolExecutor otherwise
+        (mirrors the slice coordinator's ``_fan_out``). Every container is
+        attempted before the first error is re-raised, so a failing
+        sidecar cannot leave the main container silently untouched —
+        rollback then sees uniform state."""
+        if len(containers) == 1:
+            container_id, pid = containers[0]
+            return [fn(container_id, pid)]
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(_FAN_OUT_WORKERS, len(containers))) as ex:
+            futures = [ex.submit(fn, container_id, pid)
+                       for container_id, pid in containers]
+            results, errors = [], []
+            for future in futures:
+                try:
+                    results.append(future.result())
+                except Exception as e:          # noqa: BLE001 — re-raised
+                    errors.append(e)
+            if errors:
+                raise errors[0]
+            return results
+
     # -- attach ----------------------------------------------------------------
 
     def mount_chips(self, pod: objects.Pod, new_chips: list[TPUChip],
                     all_chips_after: list[TPUChip]) -> int:
-        """Expose ``new_chips`` inside the pod's first container.
+        """Expose ``new_chips`` inside the pod's containers.
 
         ``all_chips_after`` is the pod's complete chip set including the new
         ones — required because cgroup-v2 device programs are replaced whole
         (defaults ∪ all chips), not incremented.
 
-        Ref util.go:17-71 MountGPU, per chip: cgroup allow -> pick PID ->
-        mknod. Companion nodes (VFIO) ride along.
+        Ref util.go:17-71 MountGPU — but fused: ALL mknods for a container
+        (chips + VFIO companions) go through ONE
+        :meth:`~gpumounter_tpu.actuation.nsenter.ContainerNsActuator.apply_device_nodes`
+        batch, so an entire-node attach costs one namespace crossing per
+        container instead of one per node; containers fan out in parallel.
 
         Returns the number of device nodes newly created (0 when every node
         already existed — i.e. this call resumed an attach that a prior
         attempt had fully actuated).
         """
-        created = 0
-        for container_id, pid in self._actuatable_containers(pod):
+        creates = []
+        for chip in new_chips:
+            creates.append((chip.container_path, chip.major, chip.minor))
+            for companion in chip.companions:
+                creates.append((companion.container_path, companion.major,
+                                companion.minor))
+        # shared companions (e.g. /dev/vfio/vfio rides with every chip)
+        # need exactly one node per container
+        creates = list(dict.fromkeys(creates))
+
+        def actuate(container_id: str, pid: int) -> int:
             self.cgroups.sync_device_access(pod, container_id,
                                             all_chips_after)
-            for chip in new_chips:
-                created += bool(self.actuator.create_device_node(
-                    pid, chip.container_path, chip.major, chip.minor))
-                for companion in chip.companions:
-                    created += bool(self.actuator.create_device_node(
-                        pid, companion.container_path, companion.major,
-                        companion.minor))
+            made = self.actuator.apply_device_nodes(pid, creates, [])
+            _observe_batch("create", len(creates))
+            return made
+
+        created = sum(self._fan_out_containers(
+            self._actuatable_containers(pod), actuate))
         logger.info("mounted %d chips (%d new nodes) into %s/%s",
                     len(new_chips), created, objects.namespace(pod),
                     objects.name(pod))
@@ -184,11 +233,12 @@ class TPUMounter:
     def unmount_chips(self, pod: objects.Pod, chips: list[TPUChip],
                       remaining_chips: list[TPUChip],
                       force: bool = False) -> None:
-        """Remove ``chips`` from the pod's first container.
+        """Remove ``chips`` from the pod's containers.
 
         Ref util.go:73-150 UnmountGPU: busy re-check -> cgroup deny ->
         rm device file -> (force) kill holders. Busy without force raises
-        :class:`DeviceBusyError` with the holder PIDs.
+        :class:`DeviceBusyError` with the holder PIDs. Unlinks are fused
+        into one batch per container, same as :meth:`mount_chips`.
         """
         busy = self._busy_map(pod, chips)
         if busy and not force:
@@ -197,15 +247,21 @@ class TPUMounter:
 
         remaining_companions = {c.host_path for chip in remaining_chips
                                 for c in chip.companions}
-        for container_id, pid in self._actuatable_containers(pod):
+        removes = []
+        for chip in chips:
+            removes.append(chip.container_path)
+            for companion in chip.companions:
+                if companion.host_path not in remaining_companions:
+                    removes.append(companion.container_path)
+        removes = list(dict.fromkeys(removes))
+
+        def actuate(container_id: str, pid: int) -> None:
             self.cgroups.revoke_device_access(pod, container_id, chips,
                                               remaining_chips)
-            for chip in chips:
-                self.actuator.remove_device_node(pid, chip.container_path)
-                for companion in chip.companions:
-                    if companion.host_path not in remaining_companions:
-                        self.actuator.remove_device_node(
-                            pid, companion.container_path)
+            self.actuator.apply_device_nodes(pid, [], removes)
+            _observe_batch("remove", len(removes))
+
+        self._fan_out_containers(self._actuatable_containers(pod), actuate)
         if force and busy:
             all_pids = sorted({p for pids in busy.values() for p in pids})
             self.actuator.kill_processes(all_pids)

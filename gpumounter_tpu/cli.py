@@ -24,6 +24,7 @@ Usage (``python -m gpumounter_tpu.cli`` or the ``tpumounterctl`` entry):
     tpumounterctl health
     tpumounterctl trace <request-id>
     tpumounterctl doctor [--node my-tpu-node]
+    tpumounterctl cachez --master http://<worker>:1201
 
 The master address comes from ``--master`` or ``$TPU_MOUNTER_MASTER``
 (default ``http://127.0.0.1:8080`` — matching a
@@ -289,6 +290,59 @@ def cmd_trace(args) -> int:
     for err in payload.get("stitch_errors", []):
         lines.append(f"  (worker spans incomplete: {err})")
     return _finish(status, payload, args.json, "\n".join(lines))
+
+
+# Informer staleness above this is a WARN in doctor/cachez: with 30s watch
+# chunks a healthy stream proves liveness at least every ~35s, so minutes
+# of silence means the cache is coasting on its last LIST.
+CACHE_STALENESS_WARN_S = 120.0
+
+
+def cmd_cachez(args) -> int:
+    """Shared-informer cache introspection from a worker's health port:
+    per-scope staleness (seconds since the watch stream last proved
+    liveness), watch restart count, fence position, and hit ratio."""
+    try:
+        payload = json.loads(_fetch_text(args.master, "/cachez",
+                                         args.timeout))
+    except TransportError as e:
+        print(f"unreachable: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    except ValueError as e:
+        print(f"bad /cachez payload: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    if not payload.get("enabled"):
+        _emit(payload, args.json,
+              "informer disabled on this target (reads go straight to "
+              "the apiserver)")
+        return 0
+    ratio = payload.get("hit_ratio")
+    lines = [f"informer cache: {payload.get('hits', 0)} hits / "
+             f"{payload.get('misses', 0)} misses"
+             + (f" (ratio {ratio})" if ratio is not None else "")
+             + f", fence timeout {payload.get('fence_timeout_s')}s"]
+    rc = 0
+    for scope in payload.get("scopes", []):
+        staleness = float(scope.get("staleness_s") or 0.0)
+        flags = []
+        if not scope.get("seeded"):
+            flags.append("NOT SEEDED")
+        if not scope.get("running"):
+            flags.append("STREAM DOWN")
+        if staleness > CACHE_STALENESS_WARN_S:
+            flags.append("STALE")
+        if flags:
+            rc = EXIT_OTHER
+        lines.append(
+            f"  scope {scope.get('namespace')}/"
+            f"{scope.get('selector') or '*'}: {scope.get('pods')} pod(s) "
+            f"@ rv {scope.get('resource_version') or '?'}, "
+            f"staleness {staleness:.1f}s, "
+            f"{scope.get('watch_restarts', 0)} watch restart(s), "
+            f"{scope.get('events_seen', 0)} event(s)"
+            + (f"  [{', '.join(flags)}]" if flags else ""))
+    _emit(payload, args.json, "\n".join(lines))
+    return rc
 
 
 def cmd_health(args) -> int:
@@ -602,6 +656,47 @@ def cmd_doctor(args) -> int:
               f"attach-journal backlog: {backlog} incomplete record(s)"
               + (" — inspect /journalz" if backlog else ""))
 
+    # Shared-informer cache health: worker-local /cachez (the master
+    # answers 404 → skipped). Staleness is CURRENT state and may WARN: a
+    # stale cache means the attach path is coasting on old pod data and
+    # every fenced read is falling through to the apiserver.
+    try:
+        cachez = json.loads(_fetch_text(args.master, "/cachez",
+                                        args.timeout))
+    except (TransportError, ValueError):
+        cachez = None
+    if isinstance(cachez, dict) and "scopes" in cachez:
+        if not cachez.get("enabled"):
+            check("ok", "informer disabled (reads go straight to the "
+                        "apiserver)")
+        else:
+            worst_staleness = 0.0
+            restarts = 0
+            broken = []
+            for scope in cachez.get("scopes", []):
+                worst_staleness = max(worst_staleness,
+                                      float(scope.get("staleness_s") or 0))
+                restarts += int(scope.get("watch_restarts") or 0)
+                if not scope.get("seeded") or not scope.get("running"):
+                    broken.append(f"{scope.get('namespace')}/"
+                                  f"{scope.get('selector') or '*'}")
+            ratio = cachez.get("hit_ratio")
+            ratio_str = (f", hit ratio {ratio}" if ratio is not None
+                         else "")
+            if broken:
+                check("warn", f"informer scope(s) down: "
+                              f"{', '.join(broken)} — reads are falling "
+                              "through to the apiserver")
+            elif worst_staleness > CACHE_STALENESS_WARN_S:
+                check("warn",
+                      f"informer cache stale: {worst_staleness:.0f}s since "
+                      f"the watch stream last proved liveness (> "
+                      f"{CACHE_STALENESS_WARN_S:g}s) — inspect /cachez")
+            else:
+                check("ok",
+                      f"informer cache fresh ({worst_staleness:.1f}s), "
+                      f"{restarts} watch restart(s){ratio_str}")
+
     # Slowest stored trace: WHICH hop ate the worst request's seconds —
     # the one question the histograms can't answer. Informational (ok
     # level): the store is lifetime-scoped like the counters, and doctor's
@@ -720,6 +815,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("health", help="master liveness")
     p.set_defaults(fn=cmd_health)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser(
+        "cachez",
+        help="shared-informer cache health from a worker's health port "
+             "(staleness, watch restarts, hit ratio)")
+    p.set_defaults(fn=cmd_cachez)
     _add_common(p, suppress=True)
 
     p = sub.add_parser(

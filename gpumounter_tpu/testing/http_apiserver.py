@@ -144,7 +144,28 @@ class HttpApiserver:
                 outer.kube.delete_pod(parts[3], parts[5])
                 return self._json(200, {"status": "Success"})
 
+            def do_PATCH(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                patch = json.loads(self.rfile.read(length) or b"{}")
+                parts = urlparse(self.path).path.strip("/").split("/")
+                if self._inject("PATCH", "pods"):
+                    return
+                # the rv precondition rides inside metadata, exactly as
+                # the REST client sends it (client.py patch_pod)
+                rv = (patch.get("metadata") or {}).get("resourceVersion")
+                try:
+                    return self._json(200, outer.kube.patch_pod(
+                        parts[3], parts[5], patch, resource_version=rv))
+                except PodNotFoundError as e:
+                    return self._json(404, {"message": str(e)})
+                except K8sApiError as e:
+                    return self._json(e.status or 500, {"message": str(e)})
+
         self.server = ThreadingHTTPServer((address, 0), Handler)
+        # a booted worker's informer holds a WATCH stream open at all
+        # times; handler threads must be daemons or server_close() would
+        # block on the in-flight chunk for up to its full timeout
+        self.server.daemon_threads = True
         threading.Thread(target=self.server.serve_forever,
                          daemon=True).start()
         self.base = f"http://{address}:{self.server.server_port}"

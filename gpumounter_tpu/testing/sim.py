@@ -213,12 +213,14 @@ class WorkerRig:
     def __init__(self, fake_host, n_chips=4, pid=4242, actuator="recording",
                  use_kubelet_socket=False, node="node-a",
                  pod_name="workload", schedule_delay_s=0.0,
-                 kubelet_lag_s=0.0, warm_pool: dict[str, int] | None = None):
+                 kubelet_lag_s=0.0, warm_pool: dict[str, int] | None = None,
+                 informer: bool = False):
         from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
         from gpumounter_tpu.actuation.mount import TPUMounter
         from gpumounter_tpu.actuation.nsenter import (ProcRootActuator,
                                                       RecordingActuator)
         from gpumounter_tpu.allocator import TPUAllocator
+        from gpumounter_tpu.k8s.informer import PodCacheReads, PodInformer
         from gpumounter_tpu.worker.service import TPUMountService
 
         self.sim = ClusterSim(
@@ -254,8 +256,21 @@ class WorkerRig:
             raise ValueError(f"unknown actuator kind {actuator!r}")
         self.mounter = TPUMounter(self.cgroups, self.actuator,
                                   self.sim.enumerator, fake_host)
+        # Shared pod informer (``informer=True``): ONE list+watch over the
+        # pool namespace serves every hot-path read — the production
+        # default wiring (worker/main.py). Off by default so unit rigs
+        # keep the historical direct-LIST behavior.
+        self.informer = None
+        reads = None
+        if informer:
+            self.informer = PodInformer(self.sim.kube,
+                                        self.sim.settings.pool_namespace,
+                                        watch_chunk_s=2.0,
+                                        resync_backoff_s=0.05).start()
+            reads = PodCacheReads(self.sim.kube, [self.informer])
         self.allocator = TPUAllocator(self.sim.collector, self.sim.kube,
-                                      self.sim.settings)
+                                      self.sim.settings, reads=reads)
+        self.reads = self.allocator.reads
         # Warm pool (worker/pool.py): ``warm_pool={"entire:4": 1}`` keeps
         # one 4-chip entire-mount slave pod pre-scheduled. The loop is NOT
         # started — tests/bench drive scan_once() for determinism.
@@ -316,6 +331,8 @@ class WorkerRig:
             time.sleep(0.05)
 
     def close(self) -> None:
+        if self.informer is not None:
+            self.informer.stop()
         self.sim.close()
 
 
@@ -342,6 +359,7 @@ class LiveStack:
         # rig's journal.
         from gpumounter_tpu.worker.main import _HealthHandler
         _HealthHandler.journal = rig.service.journal
+        _HealthHandler.cache = rig.service.reads
         self.health_server = start_health_server(0)
         health_port = self.health_server.server_port
         self.master_kube = FakeKubeClient()
@@ -358,6 +376,7 @@ class LiveStack:
     def close(self) -> None:
         from gpumounter_tpu.worker.main import _HealthHandler
         _HealthHandler.journal = None
+        _HealthHandler.cache = None
         self.http_server.shutdown()
         self.health_server.shutdown()
         self.grpc_server.stop(grace=0)

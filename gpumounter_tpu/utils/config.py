@@ -91,6 +91,13 @@ class Settings:
     # immediately, so this mainly bounds how long a crashed warm pod or a
     # resize stays unreconciled.
     warm_pool_interval_s: float = 10.0
+    # Shared pod informer (k8s/informer.py): serve hot-path pod reads from
+    # ONE list+watch cache per scope instead of per-caller apiserver
+    # LISTs. The fence timeout bounds how long a covered read waits for
+    # the cache to catch up to this process's own writes before falling
+    # through to a real apiserver call.
+    informer_enabled: bool = True
+    informer_fence_timeout_s: float = 2.0
     # Crash-safe attach journal file (worker/journal.py): intent records
     # before actuation, replayed at boot. Empty = journaling disabled
     # (direct Settings() construction, e.g. unit rigs that build their
@@ -125,6 +132,9 @@ class Settings:
             s.warm_pool_interval_s = float(t)
         s.journal_path = env.get(consts.ENV_JOURNAL_PATH,
                                  consts.DEFAULT_JOURNAL_PATH)
+        s.informer_enabled = env.get(consts.ENV_INFORMER, "1") != "0"
+        if t := env.get(consts.ENV_INFORMER_FENCE_TIMEOUT_S):
+            s.informer_fence_timeout_s = float(t)
         if p := env.get("TPU_WORKER_GRPC_PORT"):
             s.worker_grpc_port = int(p)
         if p := env.get("TPU_MASTER_HTTP_PORT"):

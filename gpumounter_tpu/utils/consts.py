@@ -88,6 +88,13 @@ ENV_WARM_POOL_INTERVAL_S = "TPU_WARM_POOL_INTERVAL_S"
 # Crash-safe attach journal (worker/journal.py). Set to "" to disable;
 # the default lives on a hostPath so it survives worker-pod restarts.
 ENV_JOURNAL_PATH = "TPU_JOURNAL_PATH"
+# Shared pod informer (k8s/informer.py): ON by default — one list+watch
+# stream per scope serves every hot-path pod read. "0" reverts reads to
+# direct apiserver calls (the pre-informer behavior).
+ENV_INFORMER = "TPU_INFORMER"
+# How long a covered read waits for the cache to catch up to a write
+# fence before falling through to a real apiserver call.
+ENV_INFORMER_FENCE_TIMEOUT_S = "TPU_INFORMER_FENCE_TIMEOUT_S"
 DEFAULT_JOURNAL_PATH = "/var/lib/tpu-mounter/attach-journal.jsonl"
 
 # --- Ports (ref: master main.go:235 :8080; worker main.go:24 :1200) -----------

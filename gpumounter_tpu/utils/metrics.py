@@ -323,6 +323,48 @@ class Registry:
         self.journal_replays = Counter(
             "tpumounter_journal_replays_total",
             "Attach-journal records replayed at worker startup, by outcome")
+        # Shared pod informer (k8s/informer.py): the ONE list+watch stream
+        # per scope that replaced per-caller apiserver LISTs on the attach
+        # path. events = applied watch events by type; watch_restarts =
+        # stream deaths that forced a re-LIST resync (a climbing rate means
+        # the apiserver connection is flapping).
+        self.informer_events = Counter(
+            "tpumounter_informer_events_total",
+            "Watch events applied to the shared pod informer cache, by "
+            "event type")
+        self.informer_watch_restarts = Counter(
+            "tpumounter_informer_watch_restarts_total",
+            "Informer watch streams that died beyond the resume budget "
+            "and re-seeded from a fresh LIST")
+        self.informer_watch_restarts.inc(0.0)   # pre-seed: see above
+        # Cache effectiveness of the informer read handle: hits = reads
+        # served from the in-memory store; misses = covered reads that had
+        # to fall through to a real apiserver call (reason: cache lagging
+        # a write fence, or a stale entry under an explicit
+        # min_resource_version demand).
+        self.cache_hits = Counter(
+            "tpumounter_cache_hits_total",
+            "Pod reads served from the shared informer cache, by verb")
+        self.cache_misses = Counter(
+            "tpumounter_cache_misses_total",
+            "Covered pod reads that fell through to the apiserver, by "
+            "verb and reason")
+        # Fused actuation (actuation/mount.py): device-node mknod/unlink
+        # ops are batched into ONE namespace crossing per container.
+        # batches/ops rates give the average fusion factor; the gauge
+        # shows the most recent batch size per op for quick eyeballing.
+        self.actuation_batches = Counter(
+            "tpumounter_actuation_batches_total",
+            "Batched device-node actuation invocations (one namespace "
+            "crossing each), by op (create/remove)")
+        self.actuation_batch_ops = Counter(
+            "tpumounter_actuation_batch_ops_total",
+            "Individual device-node operations carried inside actuation "
+            "batches, by op (create/remove)")
+        self.actuation_batch_size = Gauge(
+            "tpumounter_actuation_batch_size",
+            "Size of the most recent device-node actuation batch, by op "
+            "(create/remove)")
         # Identifies the build on every /metrics surface (standard
         # <name>_info pattern: constant 1, the payload is the label).
         from gpumounter_tpu import __version__

@@ -36,6 +36,7 @@ class _HealthHandler(BaseHTTPRequestHandler):
     ready = False
     pool = None        # PoolManager, set by main() when the pool is enabled
     journal = None     # AttachJournal, set by main() when journaling is on
+    cache = None       # PodCacheReads, set by main() (informer handle)
 
     def log_message(self, *args):
         pass
@@ -69,6 +70,15 @@ class _HealthHandler(BaseHTTPRequestHandler):
             import json
             pool = type(self).pool
             body = json.dumps(pool.status() if pool is not None
+                              else {"enabled": False}).encode()
+            ctype = "application/json"
+            code = 200
+        elif self.path == "/cachez":
+            # shared-informer introspection: per-scope staleness, watch
+            # restarts, fence position, and cache hit/miss totals
+            import json
+            cache = type(self).cache
+            body = json.dumps(cache.status() if cache is not None
                               else {"enabled": False}).encode()
             ctype = "application/json"
             code = 200
@@ -120,7 +130,10 @@ def _build_journal(settings: Settings):
 
 def build_stack(settings: Settings) -> TPUMountService:
     """Wire the production object graph (ref server.go:22-33 NewGPUMounter →
-    NewGPUAllocator → NewGPUCollector; composition instead of embedding)."""
+    NewGPUAllocator → NewGPUCollector; composition instead of embedding).
+    The shared pod informer (one list+watch over the pool namespace) is
+    the default read path; ``TPU_INFORMER=0`` reverts every read to direct
+    apiserver calls."""
     enumerator = best_enumerator(settings.host,
                                  allow_fake=settings.allow_fake_devices)
     podresources = KubeletPodResourcesClient(settings.host.kubelet_socket)
@@ -128,7 +141,14 @@ def build_stack(settings: Settings) -> TPUMountService:
                              resource_name=settings.resource_name,
                              pool_namespace=settings.pool_namespace)
     kube = default_kube_client()
-    allocator = TPUAllocator(collector, kube, settings)
+    reads = None
+    if settings.informer_enabled:
+        from gpumounter_tpu.k8s.informer import PodCacheReads, PodInformer
+        informer = PodInformer(kube, settings.pool_namespace).start()
+        reads = PodCacheReads(kube, [informer],
+                              fence_timeout_s=settings.
+                              informer_fence_timeout_s)
+    allocator = TPUAllocator(collector, kube, settings, reads=reads)
     cgroups = CgroupDeviceController(settings.host,
                                      driver=settings.cgroup_driver)
     actuator = ProcRootActuator(settings.host)
@@ -151,6 +171,7 @@ def main() -> None:
     # nodes, so a broken stack here is a deploy error worth crashing on.
     service = build_stack(settings)
     _HealthHandler.journal = service.journal
+    _HealthHandler.cache = service.reads
     if service.journal is not None:
         # BEFORE serving: a crash mid-attach must be repaired before new
         # requests can race the leftover state
@@ -182,6 +203,7 @@ def main() -> None:
         if pool is not None:
             pool.stop()
         reconciler.stop()
+        service.reads.stop()
         health.shutdown()
 
 

@@ -34,6 +34,7 @@ import time
 
 from gpumounter_tpu.allocator.allocator import is_unschedulable
 from gpumounter_tpu.k8s import objects
+from gpumounter_tpu.k8s.informer import PodCacheReads
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.errors import K8sApiError, PodNotFoundError
 from gpumounter_tpu.utils.log import get_logger
@@ -66,10 +67,17 @@ class PoolManager:
     calls on the attach path."""
 
     def __init__(self, allocator, kube, settings=None,
-                 interval_s: float | None = None):
+                 interval_s: float | None = None,
+                 reads: PodCacheReads | None = None):
         from gpumounter_tpu.utils.config import Settings
         self.allocator = allocator
         self.kube = kube
+        # Read-side informer handle, shared with the allocator by default
+        # so both see the same cache + write fences; a plain passthrough
+        # when no informer is wired (exactly the historical behavior).
+        self.reads = (reads if reads is not None
+                      else getattr(allocator, "reads", None)
+                      or PodCacheReads(kube))
         self.settings = settings or Settings()
         self.interval_s = (self.settings.warm_pool_interval_s
                            if interval_s is None else interval_s)
@@ -113,7 +121,7 @@ class PoolManager:
         return pool_key(mount == consts.MountType.ENTIRE.value, chips)
 
     def _list_warm(self) -> list[objects.Pod]:
-        return [p for p in self.kube.list_pods(
+        return [p for p in self.reads.list_pods(
                     self.settings.pool_namespace,
                     label_selector=self._selector)
                 if self._is_ours(p)]
@@ -181,8 +189,12 @@ class PoolManager:
             name = objects.name(pod)
             rv = pod.get("metadata", {}).get("resourceVersion", "")
             try:
-                self.kube.patch_pod(self.settings.pool_namespace, name,
-                                    patch, resource_version=rv or None)
+                adopted = self.kube.patch_pod(
+                    self.settings.pool_namespace, name, patch,
+                    resource_version=rv or None)
+                # fence: the allocator's post-claim cache reads must see
+                # the ownership labels this patch just wrote
+                self.reads.observe_write(adopted)
             except PodNotFoundError:
                 continue            # deleted under us: not adoptable
             except K8sApiError as e:
@@ -280,7 +292,9 @@ class PoolManager:
                 spec = self.allocator.new_warm_slave_pod(
                     self.settings.node_name, chips, entire)
                 try:
-                    self.kube.create_pod(self.settings.pool_namespace, spec)
+                    resp = self.kube.create_pod(self.settings.pool_namespace,
+                                                spec)
+                    self.reads.observe_write(resp)
                 except K8sApiError as e:
                     logger.warning("warm pod create (%s) failed: %s", key, e)
                     break
@@ -296,63 +310,34 @@ class PoolManager:
 
     def _await_running(self, names: list[str],
                        create_t0: dict[str, float]) -> None:
-        """Watch until the freshly created warm pods are Running, observing
-        each one's create->Running latency (the scheduler cost the pool
-        absorbs so attaches don't). Event-driven like the allocator's
-        ``_wait_running`` — a background refill must not re-introduce the
-        apiserver LIST-polling the watches exist to avoid. Still-Pending
-        pods at the deadline are left for the next pass; Unschedulable/
-        terminal/vanished ones stop being waited on (next pass retries)."""
-        deadline = time.monotonic() + self.refill_wait_s
+        """Until the freshly created warm pods are Running, observing each
+        one's create->Running latency (the scheduler cost the pool absorbs
+        so attaches don't). Event-driven like the allocator's
+        ``_wait_running`` — informer-backed scopes ride the shared stream,
+        others run the legacy LIST-seeded watch. Still-Pending pods at the
+        deadline are left for the next pass; Unschedulable/terminal/
+        vanished (deleted or already adopted) ones stop being waited on
+        (next pass retries)."""
         pending = set(names)
 
-        def note(pod: objects.Pod) -> None:
-            name = objects.name(pod)
-            if name not in pending:
-                return
-            if objects.is_running(pod):
-                REGISTRY.pool_refill_latency.observe(
-                    time.monotonic() - create_t0[name])
-                pending.discard(name)
-            elif is_unschedulable(pod) or objects.is_terminal(pod):
-                pending.discard(name)
-
-        def sync() -> str:
-            pods, rv = self.kube.list_pods_with_version(
-                self.settings.pool_namespace, self._selector)
-            seen = set()
-            for pod in pods:
-                seen.add(objects.name(pod))
-                note(pod)
-            # absent from the warm LIST = deleted or already adopted;
-            # either way no Running event will ever come for it here
-            pending.intersection_update(seen)
-            return rv
+        def step(pods: dict[str, objects.Pod]) -> bool:
+            # absent from the warm view = deleted or already adopted;
+            # either way no Running transition will ever come for it here
+            pending.intersection_update(pods.keys())
+            for name in list(pending):
+                pod = pods[name]
+                if objects.is_running(pod):
+                    REGISTRY.pool_refill_latency.observe(
+                        time.monotonic() - create_t0[name])
+                    pending.discard(name)
+                elif is_unschedulable(pod) or objects.is_terminal(pod):
+                    pending.discard(name)
+            return not pending
 
         try:
-            rv = sync()
-            while pending:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return
-                try:
-                    for event_type, pod in self.kube.watch_pods(
-                            self.settings.pool_namespace,
-                            label_selector=self._selector,
-                            timeout_s=min(remaining, self._WATCH_CHUNK_S),
-                            resource_version=rv):
-                        rv = pod.get("metadata", {}).get(
-                            "resourceVersion", "") or rv
-                        if event_type == "DELETED":
-                            pending.discard(objects.name(pod))
-                        else:
-                            note(pod)
-                        if not pending:
-                            return
-                except K8sApiError as e:
-                    if e.status != 410:
-                        raise
-                    rv = sync()     # version expired: re-seed from a LIST
+            self.reads.wait_pods(self.settings.pool_namespace,
+                                 self._selector, step, self.refill_wait_s,
+                                 watch_chunk_s=self._WATCH_CHUNK_S)
         except K8sApiError as e:
             logger.warning("refill wait aborted: %s", e)
 

@@ -109,6 +109,11 @@ class TPUMountService:
         self.allocator = allocator
         self.mounter = mounter
         self.kube = kube
+        # Read-side informer handle shared with the allocator: pod reads
+        # on the request path are served from the shared list-watch cache
+        # when one is wired (k8s/informer.py), and fall through to the
+        # real client otherwise.
+        self.reads = allocator.reads
         self.settings = settings or Settings()
         # Optional PoolManager (worker/pool.py): when set, AddTPU adopts
         # pre-scheduled warm slave pods before falling back to the cold
@@ -196,7 +201,7 @@ class TPUMountService:
             raise MountPolicyError(f"tpu_num must be >= 1, got {tpu_num}")
         with trace.span("policy"):
             try:
-                pod = self.kube.get_pod(namespace, pod_name)
+                pod = self.reads.get_pod(namespace, pod_name)
             except PodNotFoundError:
                 return AddOutcome(
                     consts.AddResult.POD_NOT_FOUND,
@@ -350,7 +355,7 @@ class TPUMountService:
                     trace: Trace) -> RemoveOutcome:
         with trace.span("resolve"):
             try:
-                pod = self.kube.get_pod(namespace, pod_name)
+                pod = self.reads.get_pod(namespace, pod_name)
             except PodNotFoundError:
                 return RemoveOutcome(
                     consts.RemoveResult.POD_NOT_FOUND,
@@ -414,7 +419,7 @@ class TPUMountService:
                    namespace: str) -> tuple[consts.MountType,
                                             list[ChipStatus]]:
         """Raises PodNotFoundError for unknown pods (gRPC NOT_FOUND)."""
-        pod = self.kube.get_pod(namespace, pod_name)
+        pod = self.reads.get_pod(namespace, pod_name)
         mount_type = self.allocator.get_mount_type(pod_name, namespace)
         slave_names = self.allocator.slave_pod_names(pod_name, namespace)
         chips = self.allocator.collector.get_pod_tpu_resources_exact(
@@ -584,7 +589,7 @@ class TPUMountService:
         devices = set(record.get("devices") or [])
         slaves = set(record.get("slaves") or [])
         try:
-            pod = self.kube.get_pod(namespace, pod_name)
+            pod = self.reads.get_pod(namespace, pod_name)
         except PodNotFoundError:
             pod = None
         # A same-named recreated pod is NOT the pod this attach targeted.
@@ -659,7 +664,7 @@ class TPUMountService:
 
     def _slave_pod_exists(self, name: str) -> bool:
         try:
-            self.kube.get_pod(self.settings.pool_namespace, name)
+            self.reads.get_pod(self.settings.pool_namespace, name)
             return True
         except PodNotFoundError:
             return False

@@ -575,3 +575,118 @@ def test_fault_free_path_adds_no_retries_or_extra_round_trips(fake_host):
         assert chaos.rig.sim.podresources.list_calls - kubelet_lists <= 3
     finally:
         chaos.close()
+
+
+# -- informer in the loop: the shared cache weakens no invariant ---------------
+# (ISSUE 4: the warm/cold attach paths now read pods from the shared
+# list-watch cache; the same fault matrix contracts must hold when the
+# informer's stream dies, hangs, or serves stale data mid-attach.)
+
+
+def test_informer_attach_converges_when_watch_dies_mid_attach(fake_host):
+    """The informer's ONE stream is now the allocation wait's event
+    source: kill it repeatedly mid-attach (beyond the client's resume
+    budget, forcing re-LIST resyncs) and the attach must still converge
+    with every invariant intact."""
+    plan = FaultPlan("informer_watch_death", [
+        Fault(op="WATCH", resource="pods", drop=True, times=6)])
+    chaos = ChaosRig(fake_host, n_chips=4, plan=plan, informer=True,
+                     schedule_delay_s=0.15)
+    try:
+        outcome = _attach(chaos)
+        assert outcome.result == consts.AddResult.SUCCESS
+        assert sorted(c.uuid for c in outcome.chips) == sorted(ALL_CHIPS)
+        assert_invariants(chaos.rig, ALL_CHIPS)
+        assert chaos.injector.fired, "plan never bit — proves nothing"
+    finally:
+        chaos.close()
+
+
+def test_informer_attach_converges_when_watch_hangs(fake_host):
+    plan = FaultPlan("informer_watch_hang", [
+        Fault(op="WATCH", resource="pods", latency_s=0.3, times=2)])
+    chaos = ChaosRig(fake_host, n_chips=4, plan=plan, informer=True,
+                     schedule_delay_s=0.15)
+    try:
+        assert _attach(chaos).result == consts.AddResult.SUCCESS
+        assert_invariants(chaos.rig, ALL_CHIPS)
+        assert chaos.injector.fired
+    finally:
+        chaos.close()
+
+
+def test_warm_attach_survives_total_list_outage(fake_host):
+    """The point of the cache, stated as chaos: with the informer + warm
+    pool wired, an apiserver that 503s EVERY LIST cannot touch the warm
+    attach path — zero LISTs are issued, so the outage plan never even
+    fires."""
+    chaos = ChaosRig(fake_host, n_chips=4, informer=True,
+                     warm_pool={"entire:4": 1})
+    try:
+        chaos.rig.fill_warm_pool()
+        chaos.install(FaultPlan("lists_down", [
+            Fault(op="LIST", resource="pods", status=503, times=50)]))
+        outcome = _attach(chaos)
+        assert outcome.result == consts.AddResult.SUCCESS
+        assert outcome.pool_hits == 1
+        lists_fired = [f for f in chaos.injector.fired if f[0] == "LIST"]
+        assert lists_fired == [], \
+            f"warm attach issued apiserver LISTs: {lists_fired}"
+        # outage over: the invariant check itself LISTs the fake directly
+        chaos.rig.sim.kube.faults = None
+        assert_invariants(chaos.rig, ALL_CHIPS)
+    finally:
+        chaos.close()
+
+
+def test_stale_cache_view_cannot_double_adopt(fake_host, monkeypatch):
+    """No stale-read double-attach: even when the pool's warm view is
+    arbitrarily stale (exactly what an informer cache lagging an adoption
+    event would serve), the resourceVersion-guarded adoption patch loses
+    cleanly (409) and the second attach falls back cold — two owners can
+    never share a slave pod."""
+    chaos = ChaosRig(fake_host, n_chips=8, informer=True,
+                     warm_pool={"entire:4": 1})
+    rig = chaos.rig
+    try:
+        rig.fill_warm_pool()
+        stale_view = [dict(p, metadata=dict(p["metadata"]))
+                      for p in rig.pool._list_warm()]
+        assert _attach(chaos, rid="owner-a").result \
+            == consts.AddResult.SUCCESS
+        # second owner; its claim sees the pre-adoption (stale) view
+        from gpumounter_tpu.testing.sim import make_target_pod
+        pod_b = make_target_pod(name="workload-b", uid="uid-b",
+                                node=rig.sim.node)
+        rig.sim.kube.put_pod(pod_b)
+        rig.provision_container(pod_b)
+        monkeypatch.setattr(rig.pool, "_list_warm", lambda: stale_view)
+        out_b = rig.service.add_tpu("workload-b", "default", 4, True,
+                                    request_id="owner-b")
+        assert out_b.result == consts.AddResult.SUCCESS
+        assert out_b.pool_hits == 0          # the stale claim lost its 409
+        # disjoint slave sets: no chip serves two owners
+        from gpumounter_tpu.k8s import objects
+        owners = {}
+        for slave in rig.sim.slave_pods():
+            owner = objects.labels(slave).get(consts.OWNER_POD_LABEL_KEY)
+            owners.setdefault(owner, set()).add(objects.name(slave))
+        assert set(owners) == {"workload", "workload-b"}
+        assert not (owners["workload"] & owners["workload-b"])
+    finally:
+        chaos.close()
+
+
+def test_informer_crash_replay_still_converges(fake_host):
+    """Crash-restart with the informer wired: the journal replay's reads
+    go through the cache and the attach still completes exactly once."""
+    chaos = ChaosRig(fake_host, n_chips=4, informer=True)
+    try:
+        chaos.arm_crash("before_commit")
+        with pytest.raises(WorkerCrash):
+            _attach(chaos)
+        outcomes = chaos.restart_worker()
+        assert outcomes == {"completed": 1}
+        assert_invariants(chaos.rig, ALL_CHIPS, max_attached_events=1)
+    finally:
+        chaos.close()

@@ -506,3 +506,71 @@ def test_doctor_windowed_retry_activity_warns(monkeypatch):
     assert rc == 0, out
     assert "retries absorbed: 104" in out
     assert "lifetime" in out
+
+
+# -- cachez: shared-informer cache health (ISSUE 4) ----------------------------
+
+def test_cachez_against_informer_worker(fake_host):
+    stack = LiveStack(WorkerRig(fake_host, use_kubelet_socket=True,
+                                informer=True))
+    try:
+        worker = f"http://127.0.0.1:{stack.health_server.server_port}"
+        rc, out = run_cli(worker, "cachez")
+        assert rc == 0
+        assert "scope tpu-pool/*" in out
+        assert "staleness" in out and "watch restart" in out
+
+        rc, out = run_cli(worker, "--json", "cachez")
+        payload = json.loads(out)
+        assert payload["enabled"] is True
+        assert payload["scopes"][0]["namespace"] == "tpu-pool"
+    finally:
+        stack.close()
+
+
+def test_cachez_against_informerless_worker(fake_host):
+    stack = LiveStack(WorkerRig(fake_host, use_kubelet_socket=True))
+    try:
+        worker = f"http://127.0.0.1:{stack.health_server.server_port}"
+        rc, out = run_cli(worker, "cachez")
+        assert rc == 0
+        assert "disabled" in out
+    finally:
+        stack.close()
+
+
+def test_doctor_reports_informer_cache_health(fake_host):
+    """doctor pointed at a worker's health port surfaces the cache check
+    (fresh => OK; the WARN path is driven by staleness over threshold)."""
+    stack = LiveStack(WorkerRig(fake_host, use_kubelet_socket=True,
+                                informer=True))
+    try:
+        worker = f"http://127.0.0.1:{stack.health_server.server_port}"
+        rc, out = run_cli(worker, "doctor")
+        assert "informer cache fresh" in out
+        assert rc in (0, 1)
+    finally:
+        stack.close()
+
+
+def test_doctor_warns_on_stale_cache(monkeypatch):
+    """A /cachez payload whose scope staleness exceeds the threshold WARNs
+    (exit 1), naming the staleness."""
+    payloads = {
+        "/healthz": "ok",
+        "/metrics": "",
+        "/cachez": json.dumps({
+            "enabled": True, "hits": 5, "misses": 1, "hit_ratio": 0.83,
+            "fence_timeout_s": 2.0,
+            "scopes": [{"namespace": "tpu-pool", "selector": None,
+                        "pods": 3, "resource_version": "9",
+                        "seeded": True, "running": True,
+                        "staleness_s": 600.0, "watch_restarts": 7,
+                        "events_seen": 42}]}),
+    }
+    monkeypatch.setattr(
+        cli, "_fetch_text",
+        lambda master, path, timeout: payloads.get(path.split("?")[0], ""))
+    rc, out = run_cli("http://unused", "doctor")
+    assert rc == 1
+    assert "informer cache stale" in out and "600s" in out

@@ -1,0 +1,253 @@
+"""Shared pod informer (k8s/informer.py): one list+watch per scope serving
+every hot-path read, with resourceVersion fencing and graceful fall-through.
+"""
+
+import threading
+import time
+
+import pytest
+
+from gpumounter_tpu.k8s.client import FakeKubeClient
+from gpumounter_tpu.k8s.informer import (PodCacheReads, PodInformer,
+                                         _selector_clauses)
+from gpumounter_tpu.testing.chaos import Fault, FaultInjector
+from gpumounter_tpu.utils.errors import PodNotFoundError
+
+
+def _pod(name, namespace="tpu-pool", labels=None, phase="Pending"):
+    return {"metadata": {"name": name, "namespace": namespace,
+                         "labels": labels or {}},
+            "status": {"phase": phase}}
+
+
+class _CountingKube(FakeKubeClient):
+    def __init__(self):
+        super().__init__()
+        self.list_calls = 0
+        self.get_calls = 0
+
+    def list_pods_with_version(self, namespace, label_selector=None):
+        self.list_calls += 1
+        return super().list_pods_with_version(namespace, label_selector)
+
+    def get_pod(self, namespace, name):
+        self.get_calls += 1
+        return super().get_pod(namespace, name)
+
+
+@pytest.fixture
+def kube():
+    return _CountingKube()
+
+
+@pytest.fixture
+def informer(kube):
+    inf = PodInformer(kube, "tpu-pool", watch_chunk_s=1.0).start()
+    yield inf
+    inf.stop()
+
+
+def _wait(predicate, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# -- cache basics --------------------------------------------------------------
+
+def test_reads_served_from_cache_without_lists(kube, informer):
+    kube.put_pod(_pod("s1", labels={"app": "tpu-pool"}))
+    assert _wait(lambda: informer.get("s1") is not None)
+    reads = PodCacheReads(kube, [informer])
+    before = kube.list_calls
+    for _ in range(20):
+        pods = reads.list_pods("tpu-pool", "app=tpu-pool")
+        assert [p["metadata"]["name"] for p in pods] == ["s1"]
+    assert kube.list_calls == before       # every read from the cache
+
+    before_get = kube.get_calls
+    assert reads.get_pod("tpu-pool", "s1")["metadata"]["name"] == "s1"
+    assert kube.get_calls == before_get    # GET from the cache too
+
+
+def test_cache_follows_events(kube, informer):
+    reads = PodCacheReads(kube, [informer])
+    kube.put_pod(_pod("s1"))
+    assert _wait(lambda: informer.get("s1") is not None)
+    kube.set_pod_status("tpu-pool", "s1", phase="Running")
+    assert _wait(
+        lambda: (informer.get("s1") or {}).get("status", {}).get("phase")
+        == "Running")
+    kube.delete_pod("tpu-pool", "s1")
+    assert _wait(lambda: informer.get("s1") is None)
+    with pytest.raises(PodNotFoundError):
+        reads.get_pod("tpu-pool", "s1")    # authoritative absence
+
+
+def test_uncovered_scope_falls_through(kube, informer):
+    """Another namespace is not this informer's scope: the read goes to
+    the real client unchanged."""
+    kube.put_pod(_pod("w1", namespace="default"))
+    reads = PodCacheReads(kube, [informer])
+    before = kube.list_calls
+    assert reads.list_pods("default")
+    assert kube.list_calls == before + 1
+
+
+def test_selector_coverage_is_clause_subset():
+    assert _selector_clauses("a=b,c=d") == {"a=b", "c=d"}
+    kube = FakeKubeClient()
+    scoped = PodInformer(kube, "ns", label_selector="app=x")
+    wide = PodInformer(kube, "ns", label_selector=None)
+    # a namespace-wide informer covers every selector; a scoped one only
+    # covers selectors that carry at least its own clauses
+    reads = PodCacheReads(kube, [scoped])
+    scoped._seeded = True
+    assert reads._covering("ns", "app=x,owner=o") is scoped
+    assert reads._covering("ns", "owner=o") is None
+    reads_wide = PodCacheReads(kube, [wide])
+    wide._seeded = True
+    assert reads_wide._covering("ns", "anything=else") is wide
+
+
+# -- resourceVersion fencing ---------------------------------------------------
+
+def test_write_fence_forces_fallthrough_when_cache_lags(kube, informer):
+    """A write the stream hasn't delivered yet: covered reads wait for the
+    fence and fall through to a REAL apiserver call on timeout — the cache
+    can be slow, never wrong."""
+    kube.put_pod(_pod("s1"))
+    assert _wait(lambda: informer.get("s1") is not None)
+    reads = PodCacheReads(kube, [informer], fence_timeout_s=0.05)
+    # pretend we wrote something the watch never delivers
+    informer.note_write(str(int(informer.resource_version) + 100))
+    before = kube.list_calls
+    reads.list_pods("tpu-pool")
+    assert kube.list_calls == before + 1   # fell through
+    before_get = kube.get_calls
+    reads.get_pod("tpu-pool", "s1")
+    assert kube.get_calls == before_get + 1
+
+
+def test_observe_write_makes_reads_read_your_writes(kube, informer):
+    """The normal case: the event stream catches up within the fence
+    timeout, so the read is served from cache AND reflects the write."""
+    kube.put_pod(_pod("s1"))
+    assert _wait(lambda: informer.get("s1") is not None)
+    reads = PodCacheReads(kube, [informer], fence_timeout_s=5.0)
+    resp = kube.patch_pod("tpu-pool", "s1",
+                          {"metadata": {"labels": {"owner": "o1"}}})
+    reads.observe_write(resp)
+    before = kube.list_calls
+    pods = reads.list_pods("tpu-pool", "owner=o1")
+    assert [p["metadata"]["name"] for p in pods] == ["s1"]
+    assert kube.list_calls == before
+
+
+def test_get_pod_min_resource_version_demand(kube, informer):
+    kube.put_pod(_pod("s1"))
+    assert _wait(lambda: informer.get("s1") is not None)
+    reads = PodCacheReads(kube, [informer], fence_timeout_s=0.05)
+    rv = informer.get("s1")["metadata"]["resourceVersion"]
+    # satisfied demand: cache hit
+    before = kube.get_calls
+    reads.get_pod("tpu-pool", "s1", min_resource_version=rv)
+    assert kube.get_calls == before
+    # unsatisfiable demand: real GET
+    reads.get_pod("tpu-pool", "s1",
+                  min_resource_version=str(int(rv) + 50))
+    assert kube.get_calls == before + 1
+
+
+# -- resilience ----------------------------------------------------------------
+
+def test_watch_death_resyncs_and_cache_recovers(kube):
+    """Stream deaths beyond the client's resume budget (4 back-to-back
+    within one chunk) force a re-LIST resync (counted in watch_restarts);
+    the cache converges afterwards."""
+    inf = PodInformer(kube, "tpu-pool", watch_chunk_s=30.0).start()
+    try:
+        assert _wait(inf.ready)
+        kube.faults = FaultInjector(
+            [Fault(op="WATCH", resource="pods", drop=True, times=8)])
+        kube.put_pod(_pod("s-new"))
+        assert _wait(lambda: inf.get("s-new") is not None, timeout_s=10.0)
+        assert _wait(lambda: inf.watch_restarts >= 1, timeout_s=10.0)
+        assert inf.status()["seeded"]
+    finally:
+        kube.faults = None
+        inf.stop()
+
+
+def test_staleness_tracks_stream_liveness(kube, informer):
+    assert _wait(informer.ready)
+    kube.put_pod(_pod("s1"))
+    assert _wait(lambda: informer.get("s1") is not None)
+    assert informer.staleness_s() < 5.0
+    status = informer.status()
+    assert status["pods"] == 1
+    assert status["watch_restarts"] == 0
+    assert status["events_seen"] >= 1
+
+
+def test_wait_for_wakes_on_events(kube, informer):
+    assert _wait(informer.ready)
+
+    def make_running():
+        time.sleep(0.05)
+        kube.put_pod(_pod("s1", phase="Running"))
+    threading.Thread(target=make_running, daemon=True).start()
+    ok = informer.wait_for(
+        lambda: (informer._pods.get("s1") or {}).get(
+            "status", {}).get("phase") == "Running", timeout_s=5.0)
+    assert ok
+
+
+def test_wait_pods_fences_before_trusting_absence(kube, informer):
+    """A wait whose step interprets absence (the pool's refill wait) must
+    not evaluate a cache lagging this process's own creates: with the
+    fence unsatisfied, wait_pods takes the legacy LIST-seeded path, which
+    sees the freshly created pod."""
+    assert _wait(informer.ready)
+    kube.put_pod(_pod("fresh", phase="Running"))
+    # cache is actually caught up, but the fence says it is not — exactly
+    # the just-created-pod window
+    reads = PodCacheReads(kube, [informer], fence_timeout_s=0.05)
+    informer.note_write(str(int(informer.resource_version) + 100))
+
+    seen = []
+
+    def step(pods):
+        seen.append(set(pods))
+        return "fresh" in pods
+
+    before = kube.list_calls
+    assert reads.wait_pods("tpu-pool", None, step, timeout_s=2.0)
+    assert kube.list_calls > before        # legacy LIST path engaged
+    assert all("fresh" in s for s in seen)
+
+
+def test_handle_without_informers_is_passthrough(kube):
+    kube.put_pod(_pod("s1"))
+    reads = PodCacheReads(kube)
+    before = kube.list_calls
+    assert reads.list_pods("tpu-pool")
+    assert kube.list_calls == before + 1
+    status = reads.status()
+    assert status["enabled"] is False
+    assert status["scopes"] == []
+
+
+def test_cachez_status_shape(kube, informer):
+    assert _wait(informer.ready)
+    reads = PodCacheReads(kube, [informer])
+    status = reads.status()
+    assert status["enabled"] is True
+    (scope,) = status["scopes"]
+    assert scope["namespace"] == "tpu-pool"
+    assert scope["running"] is True
+    assert "staleness_s" in scope and "watch_restarts" in scope
+    assert "hit_ratio" in status

@@ -113,6 +113,104 @@ def test_pod_device_processes_intersection(rig):
     assert mounter.pod_device_processes(pod, make_chips(1)[0]) == [4242]
 
 
+# -- fused batch actuation (one namespace crossing per container) --------------
+
+def test_mount_is_one_batch_per_container(rig):
+    """Chips + companions fuse into a single apply_device_nodes call —
+    the entire-node attach pays ONE crossing, not one per node."""
+    from gpumounter_tpu.device.model import CompanionNode
+    pod, mounter, actuator, enum, cdir = rig
+    chips = make_chips(2)
+    vfio = CompanionNode(host_path="/dev/vfio/vfio", major=10, minor=196)
+    for chip in chips:
+        chip.companions = (vfio,)
+    mounter.mount_chips(pod, chips, chips)
+    assert len(actuator.batches) == 1
+    pid, created_paths, removed_paths = actuator.batches[0]
+    # shared companion deduplicated: one node per container, not per chip
+    assert created_paths == ("/dev/accel0", "/dev/vfio/vfio", "/dev/accel1")
+    assert removed_paths == ()
+
+
+def test_unmount_is_one_batch_per_container(rig):
+    pod, mounter, actuator, enum, cdir = rig
+    chips = make_chips(2)
+    mounter.mount_chips(pod, chips, chips)
+    actuator.batches.clear()
+    mounter.unmount_chips(pod, chips, [])
+    assert len(actuator.batches) == 1
+    assert actuator.batches[0][2] == ("/dev/accel0", "/dev/accel1")
+
+
+def test_batch_metrics_recorded(rig):
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    pod, mounter, actuator, enum, cdir = rig
+    batches = REGISTRY.actuation_batches.value(op="create")
+    ops = REGISTRY.actuation_batch_ops.value(op="create")
+    chips = make_chips(3)
+    mounter.mount_chips(pod, chips, chips)
+    assert REGISTRY.actuation_batches.value(op="create") == batches + 1
+    assert REGISTRY.actuation_batch_ops.value(op="create") == ops + 3
+    assert REGISTRY.actuation_batch_size.value(op="create") == 3
+
+
+class _ScriptingNsenter:
+    """Capture seam for NsenterActuator's shell scripts."""
+
+    def __init__(self, stdout=""):
+        from gpumounter_tpu.actuation.nsenter import NsenterActuator
+        self.inner = NsenterActuator()
+        self.scripts = []
+        self.stdout = stdout
+        self.inner._run_in_mount_ns = self._capture
+
+    def _capture(self, pid, script):
+        self.scripts.append((pid, script))
+        return self.stdout
+
+
+def test_nsenter_batch_is_one_shell_invocation():
+    """The fused path spawns nsenter ONCE for the whole batch; the script
+    is idempotent per node and fails fast on the first real error."""
+    cap = _ScriptingNsenter(stdout="created\ncreated\n")
+    made = cap.inner.apply_device_nodes(
+        4242,
+        creates=[("/dev/accel0", 120, 0), ("/dev/accel1", 120, 1)],
+        removes=["/dev/accel9"])
+    assert made == 2
+    assert len(cap.scripts) == 1
+    pid, script = cap.scripts[0]
+    assert pid == 4242
+    assert script.startswith("set -e")
+    assert script.count("mknod") == 2
+    assert script.count("test -e") == 2          # idempotent short-circuit
+    assert "rm -f /dev/accel9" in script
+    # empty batch: no crossing at all
+    assert cap.inner.apply_device_nodes(4242) == 0
+    assert len(cap.scripts) == 1
+
+
+def test_multi_container_batches_fan_out(fake_host):
+    """Two containers => two batches (one crossing each), regardless of
+    chip count."""
+    from tests.helpers import WorkerRig
+    from tests.test_multicontainer import make_two_container_pod
+    rig = WorkerRig(fake_host, n_chips=4)
+    try:
+        pod = make_two_container_pod()
+        rig.sim.kube.put_pod(pod)
+        rig.provision_container(pod)
+        outcome = rig.service.add_tpu(pod["metadata"]["name"], "default",
+                                      4, True)
+        assert outcome.result.name == "SUCCESS"
+        create_batches = [b for b in rig.actuator.batches if b[1]]
+        assert len(create_batches) == 2          # one per container
+        for _, created_paths, _ in create_batches:
+            assert len(created_paths) == 4
+    finally:
+        rig.close()
+
+
 # -- ProcRootActuator end-to-end on a fixture tree -----------------------------
 
 def test_proc_root_actuator_fake_nodes(fake_host):

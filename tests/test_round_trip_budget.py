@@ -1,0 +1,138 @@
+"""Per-verb apiserver round-trip budgets for the attach/detach hot path.
+
+Extends the ad-hoc pin in test_chaos.py (fault-free path adds no retries)
+into explicit budgets: with the shared informer + warm pool wired the warm
+attach path performs ZERO apiserver LISTs, cold attach LISTs nothing
+either (the informer owns the only list+watch), and every verb's count is
+pinned so a cache regression — a forgotten read routed back to the client,
+a fence that always falls through — fails loudly here instead of shipping
+as silent apiserver load.
+
+Counting is done on the ``tpumounter_k8s_request_seconds`` family: every
+FakeKubeClient verb passes through the same ``k8s_call`` instrumentation
+production uses, inside the retry layer, so the counters ARE the
+round-trips. Events (async audit POSTs) and kubelet calls are budgeted
+separately from pods/nodes.
+"""
+
+import pytest
+
+from gpumounter_tpu.testing.sim import WorkerRig
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+
+@pytest.fixture
+def rig(fake_host):
+    r = WorkerRig(fake_host, n_chips=4, informer=True,
+                  warm_pool={"entire:4": 1})
+    yield r
+    r.close()
+
+
+def _counts() -> dict[tuple[str, str], int]:
+    return {(d["verb"], d["resource"]): REGISTRY.k8s_latency.count(**d)
+            for d in REGISTRY.k8s_latency.phases()}
+
+
+def _delta(before, after, resources=("pods", "nodes")):
+    out = {}
+    for key, value in after.items():
+        if key[1] not in resources:
+            continue
+        diff = value - before.get(key, 0)
+        if diff:
+            out[key] = diff
+    return out
+
+
+def test_warm_attach_budget_zero_lists(rig):
+    """The acceptance criterion: a warm-pool attach touches the apiserver
+    exactly 3 times — GET the target pod, GET the node (first attach only;
+    cached after), PATCH the adoption — and performs ZERO LISTs."""
+    rig.fill_warm_pool()
+    before = _counts()
+    outcome = rig.service.add_tpu("workload", "default", 4, True,
+                                  request_id="budget-warm")
+    assert outcome.result == consts.AddResult.SUCCESS
+    assert outcome.pool_hits == 1
+    delta = _delta(before, _counts())
+    assert delta == {("GET", "pods"): 1,
+                     ("GET", "nodes"): 1,
+                     ("PATCH", "pods"): 1}, delta
+
+
+def test_second_warm_attach_drops_the_node_get(rig):
+    """Steady state: the node-topology cache removes the GET nodes too —
+    2 round-trips per warm attach, none of them LISTs."""
+    rig.fill_warm_pool()
+    assert rig.service.add_tpu("workload", "default", 4, True,
+                               request_id="warmup").result \
+        == consts.AddResult.SUCCESS
+    assert rig.service.remove_tpu("workload", "default", [],
+                                  False).result \
+        == consts.RemoveResult.SUCCESS
+    rig.fill_warm_pool()
+    before = _counts()
+    outcome = rig.service.add_tpu("workload", "default", 4, True,
+                                  request_id="budget-warm-2")
+    assert outcome.result == consts.AddResult.SUCCESS
+    assert outcome.pool_hits == 1
+    delta = _delta(before, _counts())
+    assert delta == {("GET", "pods"): 1, ("PATCH", "pods"): 1}, delta
+
+
+def test_cold_attach_budget_zero_lists(fake_host):
+    """Cold path (no pool): one POST per slave pod, the informer's shared
+    stream replaces the allocation wait's LIST+watch — still zero LISTs."""
+    rig = WorkerRig(fake_host, n_chips=4, informer=True)
+    try:
+        before = _counts()
+        outcome = rig.service.add_tpu("workload", "default", 4, True,
+                                      request_id="budget-cold")
+        assert outcome.result == consts.AddResult.SUCCESS
+        delta = _delta(before, _counts())
+        assert delta == {("GET", "pods"): 1,
+                         ("GET", "nodes"): 1,
+                         ("POST", "pods"): 1}, delta
+    finally:
+        rig.close()
+
+
+def test_detach_budget_zero_lists(rig):
+    rig.fill_warm_pool()
+    assert rig.service.add_tpu("workload", "default", 4, True,
+                               request_id="budget-pre").result \
+        == consts.AddResult.SUCCESS
+    before = _counts()
+    outcome = rig.service.remove_tpu("workload", "default", [], False)
+    assert outcome.result == consts.RemoveResult.SUCCESS
+    delta = _delta(before, _counts())
+    assert delta == {("GET", "pods"): 1,
+                     ("DELETE", "pods"): 1}, delta
+
+
+def test_kubelet_budget_unchanged(rig):
+    """The informer must not change the kubelet side: O(1) PodResources
+    LISTs per attach (the round-2 pin)."""
+    rig.fill_warm_pool()
+    before = rig.sim.podresources.list_calls
+    assert rig.service.add_tpu("workload", "default", 4, True).result \
+        == consts.AddResult.SUCCESS
+    assert rig.sim.podresources.list_calls - before <= 3
+
+
+def test_legacy_path_unchanged_without_informer(fake_host):
+    """Without an informer the handle is a passthrough: the historical
+    LIST pattern (adoption read, mount-type read, wait seed, resolve) is
+    still exactly what the fake sees — this pin is the contrast that
+    proves the informer is what removes the LISTs."""
+    rig = WorkerRig(fake_host, n_chips=4)
+    try:
+        before = _counts()
+        assert rig.service.add_tpu("workload", "default", 4, True).result \
+            == consts.AddResult.SUCCESS
+        delta = _delta(before, _counts())
+        assert delta.get(("LIST", "pods"), 0) >= 3   # the pre-informer cost
+    finally:
+        rig.close()

@@ -23,6 +23,10 @@ kubelet. Two configurations are measured:
 - **multi-chip**: an 8-chip entire-node attach (overhead mode) — the
   fused-actuation configuration, where all mknods for a container ride
   ONE namespace crossing (``multi_chip_attach_p50_s``).
+- **contention**: two tenants firing more concurrent attaches than the
+  node holds through the master's attach broker (quota admission +
+  priority queue), plus a preemption scenario — emits
+  ``queued_attach_wait_p50_s`` and ``preemption_e2e_p50_s``.
 
 Every rig runs with the shared pod informer enabled — the production
 default wiring (worker/main.py).
@@ -143,6 +147,126 @@ def measure_attach_cycle(schedule_delay_s: float, cycles: int,
             if warm_pool:
                 rig.fill_warm_pool()        # refill off the timed path
         return attach_lat, detach_lat, round_trips
+    finally:
+        stack.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def measure_contention(cycles: int = 3) -> dict:
+    """Broker contention benchmark: two tenants firing more concurrent
+    attaches than the node has chips, through the master's admission
+    queue, plus a preemption scenario (an over-quota tenant's borrowed
+    chips reclaimed for a high-priority request).
+
+    Emits ``queued_attach_wait_p50_s`` (time a contended attach sat in
+    the broker queue before completing — from the broker's own
+    ``queue_wait_seconds`` histogram, shared in-process) and
+    ``preemption_e2e_p50_s`` (high-priority attach arrival → success,
+    including the victim's traced/journaled detach)."""
+    import threading
+
+    from gpumounter_tpu.master.admission import BrokerConfig
+    from gpumounter_tpu.testing.sim import LiveStack, WorkerRig
+    from gpumounter_tpu.utils.config import HostPaths
+    from gpumounter_tpu.utils.metrics import REGISTRY
+
+    root = tempfile.mkdtemp(prefix="tpumounter-bench-broker-")
+    host = HostPaths(dev_root=f"{root}/dev", proc_root=f"{root}/proc",
+                     sys_root=f"{root}/sys",
+                     cgroup_root=f"{root}/sys/fs/cgroup",
+                     kubelet_socket=f"{root}/pr/kubelet.sock")
+    for d in (host.dev_root, host.proc_root, host.cgroup_root):
+        os.makedirs(d)
+    rig = WorkerRig(host, n_chips=CHIPS, actuator="procroot",
+                    use_kubelet_socket=True, informer=True)
+    # hog's quota is half the node but burst 2 lets it borrow the rest —
+    # the borrowed half is exactly what the high-priority vip preempts.
+    config = BrokerConfig(
+        quotas={"teamA": CHIPS, "teamB": CHIPS, "hog": CHIPS // 2},
+        quota_burst=2.0, queue_timeout_s=60.0)
+    stack = LiveStack(rig, broker_config=config, shared_kube=True)
+
+    def add_pod(name: str) -> None:
+        pod = rig.sim.add_target_pod(name=name)
+        rig.provision_container(pod)
+
+    def attach(pod: str, n: int, tenant: str,
+               priority: str = "normal") -> tuple[float, dict]:
+        url = (f"{stack.base}/addtpu/namespace/default/pod/{pod}"
+               f"/tpu/{n}/isEntireMount/true"
+               f"?tenant={tenant}&priority={priority}")
+        t0 = time.monotonic()
+        try:
+            with urllib.request.urlopen(url) as resp:
+                body = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            body = json.loads(e.read())
+        return time.monotonic() - t0, body
+
+    def detach(pod: str) -> None:
+        req = urllib.request.Request(
+            f"{stack.base}/removetpu/namespace/default/pod/{pod}"
+            "/force/false", data=b"", method="POST")
+        with urllib.request.urlopen(req) as resp:
+            json.loads(resp.read())
+
+    for name in ("w-a1", "w-a2", "w-b1", "w-b2", "hog", "vip"):
+        add_pod(name)
+    half = CHIPS // 2
+    try:
+        # -- queued contention: 4 x half-node over one node, two tenants
+        for _ in range(cycles):
+            results: dict[str, dict] = {}
+
+            def run(pod: str, tenant: str) -> None:
+                results[pod] = attach(pod, half, tenant)[1]
+
+            threads = [threading.Thread(target=run, args=pair)
+                       for pair in (("w-a1", "teamA"), ("w-b1", "teamB"),
+                                    ("w-a2", "teamA"), ("w-b2", "teamB"))]
+            for th in threads:
+                th.start()
+            # wait until BOTH winners have stored their results (a thread
+            # can still be between HTTP response and the dict write when
+            # queue depth first hits 2 — a missed winner would never be
+            # detached and the queued pair would sit out the full
+            # timeout) AND the over-capacity pair is parked
+            deadline = time.monotonic() + 30.0
+            winners: list[str] = []
+            while time.monotonic() < deadline:
+                with urllib.request.urlopen(f"{stack.base}/brokerz") as r:
+                    brokerz = json.loads(r.read())
+                winners = [p for p, b in list(results.items())
+                           if b.get("result") == "SUCCESS"]
+                if sum(brokerz["queue"]["depth"].values()) >= 2 \
+                        and len(winners) >= 2:
+                    break
+                time.sleep(0.02)
+            for pod in winners:
+                detach(pod)
+            for th in threads:
+                th.join(timeout=90)
+            for pod, body in results.items():
+                if body.get("result") == "SUCCESS" and pod not in winners:
+                    detach(pod)
+        queued_wait_p50 = REGISTRY.queue_wait.percentile(50)
+
+        # -- preemption: hog borrows the whole node, vip (high) reclaims
+        preempt_lat = []
+        for _ in range(cycles):
+            _, body = attach("hog", CHIPS, "hog")
+            assert body["result"] == "SUCCESS", body
+            elapsed, body = attach("vip", CHIPS, "teamA", priority="high")
+            assert body["result"] == "SUCCESS", body
+            preempt_lat.append(elapsed)
+            detach("vip")
+        return {
+            "queued_attach_wait_p50_s": round(queued_wait_p50, 4),
+            "preemption_e2e_p50_s": round(
+                statistics.median(preempt_lat), 4),
+            "preemptions": int(REGISTRY.preemptions.value()),
+            "contention_cycles": cycles,
+        }
     finally:
         stack.close()
         shutil.rmtree(root, ignore_errors=True)
@@ -303,6 +427,9 @@ def main() -> None:
                    "multi_chip": len(multi), "e2e": len(e2e),
                    "e2e_with_pool": len(pool_e2e)},
     }
+    # Broker contention config: queued-attach wait + preemption e2e
+    # (tenant quotas, priority queue — master/admission.py).
+    result.update(measure_contention())
     tpu = tpu_metrics()
     if tpu is not None:
         result["tpu"] = tpu

@@ -21,6 +21,7 @@ Usage (``python -m gpumounter_tpu.cli`` or the ``tpumounterctl`` entry):
     tpumounterctl node my-tpu-node
     tpumounterctl slice add    -p ns/pod-a -p ns/pod-b --tpus-per-host 4
     tpumounterctl slice remove -p ns/pod-a -p ns/pod-b --force
+    tpumounterctl renew my-pod -n default [--ttl 3600]
     tpumounterctl health
     tpumounterctl trace <request-id>
     tpumounterctl doctor [--node my-tpu-node]
@@ -62,6 +63,12 @@ EXIT_CODES = {
     "TopologyMismatch": 7,
     "SliceAttachFailed": 8,
     "SliceDetachIncomplete": 9,
+    # attach-broker results (master/admission.py): both are client-
+    # retryable 429s, distinct codes so scripts can back off differently
+    # (over-quota = wait for a lease to free; full queue = retry shortly)
+    "QuotaExceeded": 13,
+    "LeaseNotFound": 14,
+    "QueueFull": 15,
 }
 EXIT_TRANSPORT = 10     # couldn't reach / bad response (2 is argparse's)
 EXIT_OTHER = 1
@@ -169,6 +176,28 @@ def cmd_remove(args) -> int:
     human = f"{payload.get('result')}: {args.namespace}/{args.pod}"
     if payload.get("busy_pids"):
         human += f"\n  busy PIDs: {payload['busy_pids']} (use --force)"
+    if payload.get("message"):
+        human += f"\n  {payload['message']}"
+    return _finish(status, payload, args.json, human)
+
+
+def cmd_renew(args) -> int:
+    """Extend a pod's attachment lease (the broker auto-detaches expired
+    leases with TPU_LEASE_TTL_S set — long-running experiments heartbeat
+    this to keep their chips)."""
+    path = (f"/renew/namespace/{urllib.parse.quote(args.namespace)}"
+            f"/pod/{urllib.parse.quote(args.pod)}")
+    if args.ttl is not None:
+        path += "?" + urllib.parse.urlencode({"ttl": args.ttl})
+    status, payload = _request(args.master, "POST", path,
+                               timeout=args.timeout)
+    lease = payload.get("lease") or {}
+    expires = lease.get("expires_in_s")
+    human = (f"{payload.get('result')}: {args.namespace}/{args.pod}"
+             + (f" lease extended, expires in {expires}s"
+                if expires is not None else
+                (" lease extended (never expires)"
+                 if payload.get("result") == "SUCCESS" else "")))
     if payload.get("message"):
         human += f"\n  {payload['message']}"
     return _finish(status, payload, args.json, human)
@@ -641,6 +670,78 @@ def cmd_doctor(args) -> int:
             check("ok", f"journal replays (crash recoveries): "
                         f"{int(replays)}, all resolved — {scope}")
 
+    # Attach broker: queue pressure and quota pressure are CURRENT state.
+    # The live /brokerz snapshot is authoritative for the target master
+    # (the gauge families are process-global, so an in-process test stack
+    # can hold several brokers' stale exports); targets without /brokerz
+    # fall back to the queue_depth / tenant_*_chips gauge families. Lease
+    # expirations / preemptions are counters judged like the others —
+    # windowed deltas describe current reclaim activity.
+    try:
+        brokerz = json.loads(_fetch_text(args.master, "/brokerz",
+                                         args.timeout))
+    except (TransportError, ValueError):
+        brokerz = None
+    if isinstance(brokerz, dict) and "queue" in brokerz:
+        depth = {p: int(n)
+                 for p, n in (brokerz["queue"].get("depth") or {}).items()}
+        total_depth = sum(depth.values())
+        oldest = float(brokerz["queue"].get("oldest_age_s") or 0.0)
+        hot = [f"{tenant} ({int(t['in_use'])}/{int(t['quota'])} chips)"
+               for tenant, t in (brokerz.get("tenants") or {}).items()
+               if t.get("quota") and (t.get("pct_of_quota") or 0) >= 90]
+        quota_count = sum(1 for t in (brokerz.get("tenants")
+                                      or {}).values() if t.get("quota"))
+    elif metrics:
+        depth_series = metrics.get("tpumounter_queue_depth", {})
+        depth = {dict(labels).get("priority", "?"): int(value)
+                 for labels, value in depth_series.items()}
+        total_depth = sum(depth.values()) if depth_series else None
+        oldest = max(metrics.get("tpumounter_queue_oldest_age",
+                                 {}).values(), default=0.0)
+        quota_series = metrics.get("tpumounter_tenant_quota_chips", {})
+        usage_series = metrics.get("tpumounter_tenant_chips_in_use", {})
+        hot = []
+        for labels, quota in quota_series.items():
+            if quota <= 0:
+                continue
+            used = usage_series.get(labels, 0.0)
+            if used / quota >= 0.9:
+                tenant = dict(labels).get("tenant", "?")
+                hot.append(f"{tenant} ({int(used)}/{int(quota)} chips)")
+        quota_count = len(quota_series)
+    else:
+        total_depth = None
+        hot, quota_count, oldest = [], 0, 0.0
+    if total_depth is not None:
+        if total_depth:
+            by_prio = ", ".join(f"{priority}:{n}"
+                                for priority, n in sorted(depth.items())
+                                if n)
+            check("warn",
+                  f"attach queue: {total_depth} request(s) waiting "
+                  f"({by_prio}), oldest {oldest:.1f}s — chips are "
+                  "contended")
+        else:
+            check("ok", "attach queue empty")
+    if hot:
+        check("warn", f"tenant(s) at >90% quota: {', '.join(sorted(hot))}"
+                      " — next attach may 429 or preempt")
+    elif quota_count:
+        check("ok", f"all {quota_count} quota'd tenant(s) under 90%")
+    if metrics:
+        src = metrics_delta if metrics_delta is not None else metrics
+        scope = (f"in the last {window:g}s" if metrics_delta is not None
+                 else "lifetime")
+        expirations = _counter_total(
+            src, "tpumounter_lease_expirations_total")
+        preemptions = _counter_total(src, "tpumounter_preemptions_total")
+        if expirations or preemptions:
+            check("ok",
+                  f"broker reclaims: {int(expirations)} expired "
+                  f"lease(s) auto-detached, {int(preemptions)} "
+                  f"preemption(s) — {scope}")
+
     # Attach-journal backlog: worker-local /journalz (present when doctor
     # is pointed at a worker's :1201; the master answers 404 → skipped).
     # Backlog on a LIVE worker means a replay was deferred (e.g. devices
@@ -789,6 +890,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--force", action="store_true",
                    help="kill holder processes if busy")
     p.set_defaults(fn=cmd_remove)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser(
+        "renew",
+        help="extend a pod's attachment lease (broker auto-detaches "
+             "expired leases)")
+    p.add_argument("pod")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--ttl", type=float, default=None, metavar="SECONDS",
+                   help="new time-to-expiry (default: the master's "
+                        "configured TPU_LEASE_TTL_S)")
+    p.set_defaults(fn=cmd_renew)
     _add_common(p, suppress=True)
 
     p = sub.add_parser("status", help="chips + busy PIDs of a pod")

@@ -14,6 +14,13 @@ cached), dial its gRPC (:82-96), translate result enums to HTTP (:103-116,
 Status mapping: Success→200; PodNotFound/TPUNotFound→404;
 InsufficientTPU→503; TPUBusy→409 (busy_pids in the body); mount-policy
 violations (gRPC FAILED_PRECONDITION)→412; worker unreachable/internal→502.
+
+Attach requests additionally pass through the attach broker
+(master/admission.py): tenant quota admission (over-quota → 429
+QuotaExceeded + Retry-After), optional contention queueing with
+priority/preemption, and attachment leases (``POST /renew``,
+``GET /brokerz``) — all default-off, see docs/guide/Multitenancy.md. A
+known route hit with the wrong HTTP method answers 405 + Allow.
 """
 
 from __future__ import annotations
@@ -29,13 +36,16 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import grpc
 
+import gpumounter_tpu
 from gpumounter_tpu.k8s import objects
 from gpumounter_tpu.k8s.client import KubeClient
+from gpumounter_tpu.master.admission import AttachBroker
 from gpumounter_tpu.master.discovery import (WorkerDirectory,
                                              WorkerNotFoundError)
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.errors import (CircuitOpenError, K8sApiError,
-                                         PodNotFoundError, TopologyError)
+                                         PodNotFoundError, QueueFullError,
+                                         QuotaExceededError, TopologyError)
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 from gpumounter_tpu.utils.retry import CircuitBreaker, RetryPolicy
@@ -53,6 +63,8 @@ _REMOVE_RE = re.compile(
 _STATUS_RE = re.compile(
     r"^/tpustatus/namespace/(?P<ns>[^/]+)/pod/(?P<pod>[^/]+)$")
 _NODE_STATUS_RE = re.compile(r"^/nodestatus/node/(?P<node>[^/]+)$")
+_RENEW_RE = re.compile(
+    r"^/renew/namespace/(?P<ns>[^/]+)/pod/(?P<pod>[^/]+)$")
 # Drop-in aliases for the reference's exact route shapes
 # (cmd/GPUMounter-master/main.go:233-234: /addgpu/.../gpu/:n/..., /removegpu)
 # so GPUMounter users' scripts work unchanged against this master. Booleans
@@ -112,13 +124,17 @@ _ROUTE_LABELS = (
     ("removetpu", lambda p: _REMOVE_RE.match(p) or _REMOVE_GPU_RE.match(p)),
     ("tpustatus", lambda p: _STATUS_RE.match(p)),
     ("nodestatus", lambda p: _NODE_STATUS_RE.match(p)),
+    ("renew", lambda p: _RENEW_RE.match(p)),
 )
 _PLAIN_ROUTES = {"/healthz": "healthz", "/version": "version",
-                 "/tracez": "tracez", "/addtpuslice": "addtpuslice",
+                 "/tracez": "tracez", "/brokerz": "brokerz",
+                 "/addtpuslice": "addtpuslice",
                  "/removetpuslice": "removetpuslice"}
-# Pure introspection requests would drown the mount traces in the ring
-# buffer; they are measured (histogram) but not stored.
-_UNTRACED_ROUTES = {"healthz", "version", "tracez", "unknown"}
+# Pure introspection requests (and renew heartbeats) would drown the
+# mount traces in the ring buffer; they are measured (histogram) but not
+# stored.
+_UNTRACED_ROUTES = {"healthz", "version", "tracez", "brokerz", "renew",
+                    "unknown"}
 
 
 def _route_label(path: str) -> str:
@@ -135,10 +151,18 @@ class MasterGateway:
 
     def __init__(self, kube: KubeClient, directory: WorkerDirectory,
                  worker_client_factory=WorkerClient,
-                 worker_tracez_base=None):
+                 worker_tracez_base=None, broker: AttachBroker | None = None):
         self.kube = kube
         self.directory = directory
         self._worker_client_factory = worker_client_factory
+        # Attach broker (master/admission.py): tenant-quota admission,
+        # contention queue + preemption, attachment leases. The default
+        # BrokerConfig is a no-op policy (no quotas, no queue, eternal
+        # leases) — exactly the pre-broker behavior. Preemption / lease
+        # expiry detaches come back through _broker_detach so they ride
+        # the normal traced, breaker-guarded worker path.
+        self.broker = broker or AttachBroker(kube)
+        self.broker.bind(self._broker_detach)
         # gRPC target "ip:port" -> base URL of that worker's health/tracez
         # HTTP endpoint. The default follows the worker's fixed convention
         # (health on grpc_port + 1, worker/main.py HEALTH_PORT_OFFSET);
@@ -219,10 +243,15 @@ class MasterGateway:
         The reference's REST surface had no such contract
         (cmd/GPUMounter-master/main.go:233-234)."""
         rid = None
+        ctx: dict = {}
         if headers is not None:
             get = getattr(headers, "get", None)
             if callable(get):
                 rid = get("X-Request-Id") or get("x-request-id")
+                ctx["tenant"] = (get(consts.TENANT_HEADER)
+                                 or get(consts.TENANT_HEADER.lower()))
+                ctx["priority"] = (get(consts.PRIORITY_HEADER)
+                                   or get(consts.PRIORITY_HEADER.lower()))
         if rid:
             if not _RID_RE.match(rid):
                 return 400, {
@@ -242,9 +271,23 @@ class MasterGateway:
         try:
             if trace is not None:
                 with trace.activate():
-                    status, payload = self._route(method, path, body, rid)
+                    status, payload = self._route(method, path, body, rid,
+                                                  ctx)
             else:
-                status, payload = self._route(method, path, body, rid)
+                status, payload = self._route(method, path, body, rid, ctx)
+        except QuotaExceededError as e:
+            # admission denial: the tenant is at its cap — a client-side
+            # retryable condition, so 429 + Retry-After, not a 5xx
+            status, payload = 429, {
+                "result": "QuotaExceeded",
+                "message": str(e),
+                "tenant": e.tenant,
+                "retry_after_s": round(max(0.1, e.retry_after_s), 1)}
+        except QueueFullError as e:
+            status, payload = 429, {
+                "result": "QueueFull",
+                "message": str(e),
+                "retry_after_s": round(max(0.1, e.retry_after_s), 1)}
         except PodNotFoundError as e:
             status, payload = 404, {"result": "PodNotFound",
                                     "message": str(e)}
@@ -282,27 +325,45 @@ class MasterGateway:
         payload.setdefault("request_id", rid)
         return status, payload
 
+    @staticmethod
+    def _method_not_allowed(allow: str, method: str,
+                            path: str) -> tuple[int, dict]:
+        """A KNOWN route hit with the wrong HTTP method is a 405 with an
+        Allow header (serve() lifts ``allow`` into the header), not the
+        404 NoSuchRoute it used to fall through to — the difference
+        between "you typo'd the path" and "use POST"."""
+        return 405, {"result": "MethodNotAllowed",
+                     "message": f"{method} not allowed on {path}",
+                     "allow": allow}
+
     def _route(self, method: str, path: str, body: bytes,
-               rid: str = "-") -> tuple[int, dict]:
+               rid: str = "-", ctx: dict | None = None) -> tuple[int, dict]:
         parsed = urllib.parse.urlparse(path)
-        if parsed.path == "/healthz":
+        p = parsed.path
+        query = urllib.parse.parse_qs(parsed.query)
+        if p == "/healthz":
+            if method != "GET":
+                return self._method_not_allowed("GET", method, p)
             return 200, {"status": "ok"}
-        if parsed.path == "/version":
-            import gpumounter_tpu
-            return 200, {"version": gpumounter_tpu.__version__}
-        match = _ADD_RE.match(parsed.path) or \
-            _ADD_GPU_RE.match(parsed.path)
-        if match and method == "GET":
+        if p == "/version":
+            return (200, {"version": gpumounter_tpu.__version__})  \
+                if method == "GET" \
+                else self._method_not_allowed("GET", method, p)
+        match = _ADD_RE.match(p) or _ADD_GPU_RE.match(p)
+        if match:
+            if method != "GET":
+                return self._method_not_allowed("GET", method, p)
             entire = _parse_bool(match["entire"])
             if entire is None:
                 return 400, {"result": "BadRequest",
                              "message": f"bad isEntireMount value "
                                         f"{match['entire']!r}"}
             return self._add(match["ns"], match["pod"], int(match["num"]),
-                             entire, rid)
-        match = _REMOVE_RE.match(parsed.path) or \
-            _REMOVE_GPU_RE.match(parsed.path)
-        if match and method == "POST":
+                             entire, rid, query, ctx)
+        match = _REMOVE_RE.match(p) or _REMOVE_GPU_RE.match(p)
+        if match:
+            if method != "POST":
+                return self._method_not_allowed("POST", method, p)
             force = _parse_bool(match["force"])
             if force is None:
                 return 400, {"result": "BadRequest",
@@ -311,18 +372,37 @@ class MasterGateway:
             uuids = _parse_uuids(body, parsed.query)
             return self._remove(match["ns"], match["pod"], uuids,
                                 force, rid)
-        match = _STATUS_RE.match(parsed.path)
-        if match and method == "GET":
+        match = _STATUS_RE.match(p)
+        if match:
+            if method != "GET":
+                return self._method_not_allowed("GET", method, p)
             return self._status(match["ns"], match["pod"], rid)
-        match = _NODE_STATUS_RE.match(parsed.path)
-        if match and method == "GET":
+        match = _NODE_STATUS_RE.match(p)
+        if match:
+            if method != "GET":
+                return self._method_not_allowed("GET", method, p)
             return self._node_status(match["node"], rid)
-        if parsed.path == "/addtpuslice" and method == "POST":
-            return self._slice_attach(body, rid)
-        if parsed.path == "/removetpuslice" and method == "POST":
+        match = _RENEW_RE.match(p)
+        if match:
+            if method != "POST":
+                return self._method_not_allowed("POST", method, p)
+            return self._renew(match["ns"], match["pod"], query)
+        if p == "/addtpuslice":
+            if method != "POST":
+                return self._method_not_allowed("POST", method, p)
+            return self._slice_attach(body, rid, ctx)
+        if p == "/removetpuslice":
+            if method != "POST":
+                return self._method_not_allowed("POST", method, p)
             return self._slice_detach(body, rid)
-        if parsed.path == "/tracez" and method == "GET":
-            return self._tracez(urllib.parse.parse_qs(parsed.query))
+        if p == "/tracez":
+            if method != "GET":
+                return self._method_not_allowed("GET", method, p)
+            return self._tracez(query)
+        if p == "/brokerz":
+            if method != "GET":
+                return self._method_not_allowed("GET", method, p)
+            return 200, self.broker.snapshot()
         return 404, {"result": "NoSuchRoute", "message": path}
 
     # -- /tracez: trace introspection + master↔worker stitching ----------------
@@ -446,7 +526,8 @@ class MasterGateway:
                 '...], ...}')
         return pods, obj
 
-    def _slice_attach(self, body: bytes, rid: str = "-") -> tuple[int, dict]:
+    def _slice_attach(self, body: bytes, rid: str = "-",
+                      ctx: dict | None = None) -> tuple[int, dict]:
         try:
             pods, obj = self._parse_slice_body(body)
             tpus = obj.get("tpusPerHost", 4)
@@ -456,15 +537,46 @@ class MasterGateway:
                     f"tpusPerHost must be a positive integer, got {tpus!r}")
         except ValueError as e:
             return 400, {"result": "BadRequest", "message": str(e)}
-        try:
-            ok, results, rollback_clean = self._slice_coordinator().attach(
-                pods, tpus, request_id=rid)
-        except TopologyError as e:
-            # pre-fan-out rejection: no host was touched
-            return 412, {"result": "TopologyMismatch", "message": str(e)}
+        # Tenant admission for the WHOLE slice (body "tenant"/"priority",
+        # falling back to header then the first pod's namespace): one
+        # aggregate quota check before any host is touched — over-quota
+        # raises QuotaExceededError → 429 + Retry-After, no fan-out.
+        # Slices never queue: a half-arrived slice holds nothing.
+        tenant = str(obj.get("tenant") or (ctx or {}).get("tenant")
+                     or pods[0][0])
+        priority = str(obj.get("priority") or (ctx or {}).get("priority")
+                       or consts.DEFAULT_PRIORITY)
+        if not _RID_RE.match(tenant):
+            return 400, {"result": "BadRequest",
+                         "message": f"bad tenant {tenant!r}"}
+        if priority not in consts.PRIORITIES:
+            return 400, {"result": "BadRequest",
+                         "message": f"bad priority {priority!r}: want "
+                                    f"{'|'.join(consts.PRIORITIES)}"}
+        # reservation-scoped admission: the whole-slice chip count stays
+        # counted as in-flight usage until the leases are recorded, so a
+        # concurrent same-tenant attach cannot stampede past the cap
+        # between this check and the fan-out finishing
+        with self.broker.admission(tenant, tpus * len(pods), rid):
+            try:
+                ok, results, rollback_clean = \
+                    self._slice_coordinator().attach(pods, tpus,
+                                                     request_id=rid)
+            except TopologyError as e:
+                # pre-fan-out rejection: no host was touched
+                return 412, {"result": "TopologyMismatch",
+                             "message": str(e)}
+            if ok:
+                for r in results:
+                    self.broker.leases.record(
+                        r.namespace, r.pod, tenant, priority,
+                        list(r.device_ids), chips=len(r.device_ids),
+                        rid=rid, ttl_s=self.broker.config.lease_ttl_s)
+                self.broker.signal_capacity()
         return (200 if ok else 503), {
             "result": "SUCCESS" if ok else "SliceAttachFailed",
             "rolled_back": (not ok) and rollback_clean,
+            "tenant": tenant,
             "pods": [r.to_json() for r in results]}
 
     def _slice_detach(self, body: bytes, rid: str = "-") -> tuple[int, dict]:
@@ -475,6 +587,9 @@ class MasterGateway:
         force = bool(obj.get("force", False))
         ok, results = self._slice_coordinator().detach(pods, force,
                                                        request_id=rid)
+        for r in results:
+            if r.result in ("SUCCESS", "TPU_NOT_FOUND"):
+                self.broker.release(r.namespace, r.pod)
         return (200 if ok else 409), {
             "result": "SUCCESS" if ok else "SliceDetachIncomplete",
             "pods": [r.to_json() for r in results]}
@@ -560,18 +675,51 @@ class MasterGateway:
             return result
 
     def _add(self, namespace: str, pod_name: str, tpu_num: int,
-             entire: bool, rid: str = "-") -> tuple[int, dict]:
-        resp = self._call_worker(
-            namespace, pod_name,
-            lambda w: w.add_tpu(pod_name, namespace, tpu_num, entire,
-                                request_id=rid))
-        result = consts.AddResult(resp.result)
-        REGISTRY.attach_results.inc(result=f"master_{result.name}")
-        return _ADD_HTTP[result], {
-            "result": result.name,
-            "device_ids": list(resp.device_ids),
-            "device_paths": list(resp.device_paths),
-        }
+             entire: bool, rid: str = "-", query: dict | None = None,
+             ctx: dict | None = None) -> tuple[int, dict]:
+        """Attach, admission-gated: tenant/priority resolve (query param >
+        header > defaults), pod→node resolve, then the broker orchestrates
+        quota check / queueing / preemption around the worker RPC."""
+        query = query or {}
+        tenant = ((query.get("tenant") or [None])[0]
+                  or (ctx or {}).get("tenant") or namespace)
+        priority = ((query.get("priority") or [None])[0]
+                    or (ctx or {}).get("priority")
+                    or consts.DEFAULT_PRIORITY)
+        if not _RID_RE.match(tenant):
+            return 400, {"result": "BadRequest",
+                         "message": f"bad tenant {tenant!r}: must be a "
+                                    "k8s-label-safe token"}
+        if priority not in consts.PRIORITIES:
+            return 400, {"result": "BadRequest",
+                         "message": f"bad priority {priority!r}: want "
+                                    f"{'|'.join(consts.PRIORITIES)}"}
+        # Resolve before admission so the lease knows its node (the
+        # preemption victim filter is node-scoped); same single GET the
+        # old _call_worker path performed — budgets unchanged.
+        with span("resolve", pod=f"{namespace}/{pod_name}"):
+            pod = self.kube.get_pod(namespace, pod_name)  # ref main.go:52-66
+            node = objects.node_name(pod)
+            if not node:
+                raise PodNotFoundError(namespace, pod_name)
+            annotate(node=node, tenant=tenant)
+
+        def attempt() -> tuple[int, dict]:
+            resp = self._call_node_worker(
+                node, lambda w: w.add_tpu(pod_name, namespace, tpu_num,
+                                          entire, request_id=rid))
+            result = consts.AddResult(resp.result)
+            REGISTRY.attach_results.inc(result=f"master_{result.name}")
+            return _ADD_HTTP[result], {
+                "result": result.name,
+                "device_ids": list(resp.device_ids),
+                "device_paths": list(resp.device_paths),
+            }
+
+        return self.broker.attach(
+            tenant=tenant, priority=priority, namespace=namespace,
+            pod=pod_name, chips=tpu_num, node=node, rid=rid,
+            attempt_fn=attempt)
 
     def _remove(self, namespace: str, pod_name: str, uuids: list[str],
                 force: bool, rid: str = "-") -> tuple[int, dict]:
@@ -581,10 +729,66 @@ class MasterGateway:
                                    request_id=rid))
         result = consts.RemoveResult(resp.result)
         REGISTRY.detach_results.inc(result=f"master_{result.name}")
+        if result == consts.RemoveResult.SUCCESS:
+            # lease bookkeeping + wake the contention queue: freed chips
+            # are what queued attaches are waiting for
+            self.broker.release(namespace, pod_name, uuids or None)
         payload: dict = {"result": result.name}
         if resp.busy_pids:
             payload["busy_pids"] = list(resp.busy_pids)
         return _REMOVE_HTTP[result], payload
+
+    def _renew(self, namespace: str, pod_name: str,
+               query: dict | None = None) -> tuple[int, dict]:
+        """``POST /renew/namespace/:ns/pod/:pod[?ttl=S]`` — push the
+        lease's expiry out (default: the configured TPU_LEASE_TTL_S)."""
+        ttl = None
+        raw = ((query or {}).get("ttl") or [None])[0]
+        if raw is not None:
+            try:
+                ttl = float(raw)
+            except ValueError:
+                ttl = -1.0
+            if ttl < 0:
+                return 400, {"result": "BadRequest",
+                             "message": f"bad ttl {raw!r}: want seconds "
+                                        ">= 0 (0 = never expire)"}
+        try:
+            lease = self.broker.renew(namespace, pod_name, ttl)
+        except KeyError:
+            return 404, {
+                "result": "LeaseNotFound",
+                "message": f"no attachment lease for "
+                           f"{namespace}/{pod_name} (expired leases are "
+                           "reaped and cannot be renewed)"}
+        return 200, {"result": "SUCCESS", "lease": lease.to_json()}
+
+    def _broker_detach(self, lease, cause: str, force: bool) -> str:
+        """Detach on the broker's behalf (preemption / lease expiry)
+        through the NORMAL worker path — traced, retried, breaker-guarded,
+        journaled worker-side — with the cause stamped into gRPC metadata
+        so the worker's audit event and journal say WHY. Returns the
+        result name; transport failures return "ERROR" (the broker
+        retries next tick)."""
+        rid = f"broker-{uuid.uuid4().hex[:8]}"
+        try:
+            resp = self._call_worker(
+                lease.namespace, lease.pod,
+                lambda w: w.remove_tpu(lease.pod, lease.namespace, [],
+                                       force, request_id=rid,
+                                       cause=cause))
+            result = consts.RemoveResult(resp.result).name
+        except PodNotFoundError:
+            result = "POD_NOT_FOUND"
+        except (WorkerNotFoundError, K8sApiError, CircuitOpenError,
+                grpc.RpcError, ValueError) as e:
+            logger.warning("broker detach of %s/%s (%s) failed: %s",
+                           lease.namespace, lease.pod, cause, e)
+            result = "ERROR"
+        REGISTRY.detach_results.inc(result=f"broker_{result}")
+        logger.info("[rid=%s] broker detach %s/%s cause=%s -> %s", rid,
+                    lease.namespace, lease.pod, cause, result)
+        return result
 
     def _status(self, namespace: str, pod_name: str,
                 rid: str = "-") -> tuple[int, dict]:
@@ -664,6 +868,9 @@ class MasterGateway:
                     # the client never comes back before the hint
                     self.send_header("Retry-After",
                                      str(max(1, int(-(-retry_after // 1)))))
+                allow = obj.get("allow")
+                if status == 405 and allow:
+                    self.send_header("Allow", allow)
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
@@ -672,6 +879,9 @@ class MasterGateway:
 
         server = ThreadingHTTPServer((address, port), Handler)
         threading.Thread(target=server.serve_forever, daemon=True).start()
+        # A serving master runs the broker's maintenance loop (lease
+        # expiry, gauge refresh); unit tests drive broker.tick() directly.
+        self.broker.start()
         logger.info("master gateway serving on %s:%d", address,
                     server.server_port)
         return server

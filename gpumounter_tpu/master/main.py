@@ -8,6 +8,7 @@ from __future__ import annotations
 import time
 
 from gpumounter_tpu.k8s.client import default_kube_client
+from gpumounter_tpu.master.admission import AttachBroker, BrokerConfig
 from gpumounter_tpu.master.discovery import WorkerDirectory
 from gpumounter_tpu.master.gateway import MasterGateway
 from gpumounter_tpu.utils.config import Settings
@@ -27,11 +28,18 @@ def main() -> None:
                                 label_selector=settings.worker_label_selector,
                                 grpc_port=settings.worker_grpc_port)
     tls = load_tls_config()
+    # Attach broker: quotas/leases/queueing from TPU_QUOTAS,
+    # TPU_LEASE_TTL_S, TPU_QUEUE_TIMEOUT_S (... all default-off). serve()
+    # starts its lease-expiry loop.
+    broker = AttachBroker(kube, BrokerConfig.from_settings(settings))
     gateway = MasterGateway(
         kube, directory,
-        worker_client_factory=lambda target: WorkerClient(target, tls=tls))
+        worker_client_factory=lambda target: WorkerClient(target, tls=tls),
+        broker=broker)
     server = gateway.serve(settings.master_http_port)
-    logger.info("master ready on :%d", settings.master_http_port)
+    logger.info("master ready on :%d (quotas=%s lease_ttl=%gs queue=%gs)",
+                settings.master_http_port, settings.tenant_quotas or "off",
+                settings.lease_ttl_s, settings.queue_timeout_s)
     try:
         while True:
             time.sleep(3600)

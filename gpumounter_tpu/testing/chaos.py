@@ -263,6 +263,50 @@ def wait_events_drained(service, timeout_s: float = 5.0) -> None:
         time.sleep(0.03)
 
 
+def assert_broker_invariants(broker, sim) -> None:
+    """The broker-layer contract after any contention / lease-race /
+    preemption / master-restart plan (rides on top of
+    :func:`assert_invariants`, which owns the node-local guarantees):
+
+    1. **Lease table mirrors cluster ground truth**: the chips the broker
+       accounts per owner pod are exactly the chips that owner's
+       (non-warm) slave pods hold in the kubelet's assignment table — no
+       leaked reservation the broker forgot, no phantom lease for chips
+       already freed (the "no double-detach" witness: a double detach
+       would have desynced one side).
+    2. **No queue residue**: every waiter has returned (completed, timed
+       out, or errored) — a crash/restart plan must not strand a thread.
+    """
+    from gpumounter_tpu.k8s import objects
+    from gpumounter_tpu.utils import consts
+    held: dict[tuple[str, str], int] = {}
+    for pod in sim.slave_pods():
+        labels = objects.labels(pod)
+        if labels.get(consts.WARM_POD_LABEL_KEY) == \
+                consts.WARM_POD_LABEL_VALUE:
+            continue
+        owner_ns = labels.get(consts.OWNER_NAMESPACE_LABEL_KEY)
+        owner = labels.get(consts.OWNER_POD_LABEL_KEY)
+        if not owner or not owner_ns:
+            continue
+        pkey = (objects.namespace(pod), objects.name(pod))
+        chips = sum(
+            len(ids)
+            for containers in (sim.podresources.assignments.get(pkey)
+                               or {}).values()
+            for ids in containers.values())
+        if chips:
+            held[(owner_ns, owner)] = held.get((owner_ns, owner), 0) + chips
+    leased = {lease.key: lease.chips for lease in broker.leases.leases()}
+    assert leased == held, \
+        f"broker lease table {leased} != cluster ground truth {held} " \
+        "(leaked reservation or double-release)"
+    with broker._lock:
+        residue = list(broker._waiters)
+    assert not residue, \
+        f"{len(residue)} waiter(s) still parked in the broker queue"
+
+
 def assert_invariants(rig, expected_uuids: set[str],
                       owner: str = "workload",
                       namespace: str = "default",

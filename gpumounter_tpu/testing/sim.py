@@ -340,7 +340,9 @@ class LiveStack:
     """Real gRPC worker + real HTTP master over a WorkerRig, on localhost.
     ``base`` is the master's URL; close() tears everything down."""
 
-    def __init__(self, rig: WorkerRig):
+    def __init__(self, rig: WorkerRig, broker_config=None,
+                 shared_kube: bool = False):
+        from gpumounter_tpu.master.admission import AttachBroker
         from gpumounter_tpu.master.discovery import WorkerDirectory
         from gpumounter_tpu.master.gateway import MasterGateway
         from gpumounter_tpu.worker.grpc_server import build_server
@@ -362,14 +364,24 @@ class LiveStack:
         _HealthHandler.cache = rig.service.reads
         self.health_server = start_health_server(0)
         health_port = self.health_server.server_port
-        self.master_kube = FakeKubeClient()
+        # ``shared_kube=True``: the master reads the SAME fake cluster the
+        # worker mutates (slave pods visible), which is what broker
+        # restart re-derivation and the bench contention config need; the
+        # default keeps the historical split-view topology.
+        if shared_kube:
+            self.master_kube = rig.sim.kube
+        else:
+            self.master_kube = FakeKubeClient()
+            self.master_kube.put_pod(rig.pod)
         self.master_kube.put_pod(worker_pod(rig.sim.node, "127.0.0.1"))
-        self.master_kube.put_pod(rig.pod)
+        broker = (AttachBroker(self.master_kube, broker_config)
+                  if broker_config is not None else None)
         self.gateway = MasterGateway(
             self.master_kube,
             WorkerDirectory(self.master_kube, grpc_port=grpc_port),
             worker_tracez_base=lambda target:
-                f"http://127.0.0.1:{health_port}")
+                f"http://127.0.0.1:{health_port}",
+            broker=broker)
         self.http_server = self.gateway.serve(port=0, address="127.0.0.1")
         self.base = f"http://127.0.0.1:{self.http_server.server_port}"
 
@@ -377,6 +389,7 @@ class LiveStack:
         from gpumounter_tpu.worker.main import _HealthHandler
         _HealthHandler.journal = None
         _HealthHandler.cache = None
+        self.gateway.broker.stop()
         self.http_server.shutdown()
         self.health_server.shutdown()
         self.grpc_server.stop(grace=0)
@@ -413,6 +426,7 @@ class MultiNodeStack:
         self.base = f"http://127.0.0.1:{self.http_server.server_port}"
 
     def close(self) -> None:
+        self.gateway.broker.stop()
         self.http_server.shutdown()
         for server in self.grpc_servers:
             server.stop(grace=0)

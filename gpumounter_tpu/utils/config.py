@@ -54,6 +54,24 @@ def parse_warm_pool_sizes(spec: str) -> dict[str, int]:
     return {k: v for k, v in sizes.items() if v > 0}
 
 
+def parse_tenant_quotas(spec: str) -> dict[str, int]:
+    """``"teamA:16,teamB:8,*:4"`` -> {"teamA": 16, "teamB": 8, "*": 4}.
+    ``*`` is the default quota for tenants not named; without it unlisted
+    tenants are unlimited. Raises ValueError on malformed entries — a
+    typo'd quota spec must fail the boot, not silently run open."""
+    quotas: dict[str, int] = {}
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        tenant, sep, chips = entry.rpartition(":")
+        if not sep or not tenant or not chips.isdigit():
+            raise ValueError(
+                f"bad quota entry {entry!r}: want '<tenant>:<chips>' "
+                "(chips a non-negative integer; '*' names the default)")
+        if tenant in quotas:
+            raise ValueError(f"duplicate quota for tenant {tenant!r}")
+        quotas[tenant] = int(chips)
+    return quotas
+
+
 @dataclasses.dataclass
 class Settings:
     pool_namespace: str = consts.DEFAULT_POOL_NAMESPACE
@@ -105,6 +123,16 @@ class Settings:
     # production worker always journals unless explicitly opted out with
     # TPU_JOURNAL_PATH="".
     journal_path: str = ""
+    # Attach broker (master/admission.py + master/lease.py): per-tenant
+    # chip quotas, work-conserving burst headroom, attachment-lease TTL
+    # and the contention-queue bounds. All defaults preserve the
+    # historical behavior exactly: no quotas, leases never expire, no
+    # queueing (InsufficientTPU answers 503 immediately).
+    tenant_quotas: dict[str, int] = dataclasses.field(default_factory=dict)
+    quota_burst: float = 1.0
+    lease_ttl_s: float = 0.0
+    queue_timeout_s: float = 0.0
+    queue_depth: int = 64
     host: HostPaths = dataclasses.field(default_factory=HostPaths)
 
     @classmethod
@@ -132,6 +160,19 @@ class Settings:
             s.warm_pool_interval_s = float(t)
         s.journal_path = env.get(consts.ENV_JOURNAL_PATH,
                                  consts.DEFAULT_JOURNAL_PATH)
+        s.tenant_quotas = parse_tenant_quotas(env.get(consts.ENV_QUOTAS, ""))
+        if t := env.get(consts.ENV_QUOTA_BURST):
+            s.quota_burst = float(t)
+            if s.quota_burst < 1.0:
+                raise ValueError(
+                    f"{consts.ENV_QUOTA_BURST} must be >= 1.0 (1.0 = hard "
+                    f"cap), got {s.quota_burst}")
+        if t := env.get(consts.ENV_LEASE_TTL_S):
+            s.lease_ttl_s = float(t)
+        if t := env.get(consts.ENV_QUEUE_TIMEOUT_S):
+            s.queue_timeout_s = float(t)
+        if t := env.get(consts.ENV_QUEUE_DEPTH):
+            s.queue_depth = int(t)
         s.informer_enabled = env.get(consts.ENV_INFORMER, "1") != "0"
         if t := env.get(consts.ENV_INFORMER_FENCE_TIMEOUT_S):
             s.informer_fence_timeout_s = float(t)

@@ -97,6 +97,40 @@ ENV_INFORMER = "TPU_INFORMER"
 ENV_INFORMER_FENCE_TIMEOUT_S = "TPU_INFORMER_FENCE_TIMEOUT_S"
 DEFAULT_JOURNAL_PATH = "/var/lib/tpu-mounter/attach-journal.jsonl"
 
+# --- Attach broker (master/admission.py, master/lease.py) ---------------------
+# Per-tenant chip quotas, e.g. "teamA:16,teamB:8,*:4" — '*' is the default
+# for tenants not listed; no '*' entry means unlisted tenants are
+# unlimited. A tenant defaults to the target pod's NAMESPACE unless the
+# request names one explicitly (X-Tpu-Tenant header / ?tenant= param).
+ENV_QUOTAS = "TPU_QUOTAS"
+# Work-conserving headroom: admission allows a tenant up to
+# quota * burst while chips are idle; usage above the bare quota is the
+# "over-quota" band high-priority requests may preempt. 1.0 = hard cap,
+# nothing is ever preemptible.
+ENV_QUOTA_BURST = "TPU_QUOTA_BURST"
+# Lease TTL for successful attaches, seconds. 0 (the default) = leases
+# never expire — exactly the historical hold-forever behavior.
+ENV_LEASE_TTL_S = "TPU_LEASE_TTL_S"
+# How long a contended attach may wait in the broker queue before the
+# InsufficientTPU answer is returned. 0 (the default) = no queueing —
+# the historical immediate 503.
+ENV_QUEUE_TIMEOUT_S = "TPU_QUEUE_TIMEOUT_S"
+# Bound of each per-priority FIFO; a full queue answers 429 + Retry-After.
+ENV_QUEUE_DEPTH = "TPU_QUEUE_DEPTH"
+
+# Request headers naming the tenant/priority (query params ?tenant= /
+# ?priority= take precedence; both fall back to namespace / "normal").
+TENANT_HEADER = "X-Tpu-Tenant"
+PRIORITY_HEADER = "X-Tpu-Priority"
+# Priority vocabulary, weakest first — index is the comparison rank.
+PRIORITIES = ("low", "normal", "high")
+DEFAULT_PRIORITY = "normal"
+
+# Detach-cause gRPC metadata key (master -> worker): the broker's
+# preemption / lease-expiry detaches say WHY, and the worker propagates
+# the cause into the TPUDetached audit event and the journal record.
+DETACH_CAUSE_METADATA_KEY = "x-detach-cause"
+
 # --- Ports (ref: master main.go:235 :8080; worker main.go:24 :1200) -----------
 MASTER_HTTP_PORT = 8080
 WORKER_GRPC_PORT = 1200

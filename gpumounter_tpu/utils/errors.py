@@ -101,6 +101,36 @@ class K8sApiError(TPUMounterError):
         self.retry_after_s = retry_after_s
 
 
+class QuotaExceededError(TPUMounterError):
+    """Admission denial: the tenant's live chip usage plus this request
+    would exceed its admission cap (quota * burst). ``retry_after_s`` is
+    the broker's hint for when capacity may free (soonest lease expiry of
+    the tenant, else a default) — surfaced as an HTTP Retry-After."""
+
+    def __init__(self, tenant: str, usage: int, requested: int, cap: int,
+                 retry_after_s: float):
+        super().__init__(
+            f"tenant {tenant!r} over quota: {usage} chip(s) in use + "
+            f"{requested} requested > cap {cap}")
+        self.tenant = tenant
+        self.usage = usage
+        self.requested = requested
+        self.cap = cap
+        self.retry_after_s = retry_after_s
+
+
+class QueueFullError(TPUMounterError):
+    """The broker's per-priority FIFO is at its bound: the request is
+    shed instead of queued (429 + Retry-After upstream)."""
+
+    def __init__(self, priority: str, depth: int, retry_after_s: float):
+        super().__init__(
+            f"attach queue full at priority {priority!r} ({depth} waiting)")
+        self.priority = priority
+        self.depth = depth
+        self.retry_after_s = retry_after_s
+
+
 class CircuitOpenError(TPUMounterError):
     """A circuit breaker is open: the target has failed enough consecutive
     calls that further attempts are refused without dialing, until the

@@ -365,6 +365,49 @@ class Registry:
             "tpumounter_actuation_batch_size",
             "Size of the most recent device-node actuation batch, by op "
             "(create/remove)")
+        # Attach broker (master/admission.py): every admission verdict by
+        # tenant and outcome (granted / over_quota / queue_full /
+        # queue_timeout) — the per-tenant denial rate is the first thing a
+        # "why are my attaches 429ing" page looks at.
+        self.admission_decisions = Counter(
+            "tpumounter_admission_decisions_total",
+            "Attach-broker admission decisions by tenant and outcome")
+        # Requests currently parked in the broker's contention queue, by
+        # priority; the companion gauge is the age of the OLDEST waiter in
+        # seconds (0 when the queue is empty) — a growing oldest-age with
+        # flat depth means the fair-dequeue is starving someone.
+        self.queue_depth = Gauge(
+            "tpumounter_queue_depth",
+            "Attach requests waiting in the broker queue, by priority")
+        self.queue_oldest_age = Gauge(
+            "tpumounter_queue_oldest_age",
+            "Age in seconds of the oldest queued attach request "
+            "(0 = queue empty)")
+        self.queue_wait = Histogram(
+            "tpumounter_queue_wait_seconds",
+            "Time a contended attach spent queued in the broker before "
+            "completing or timing out")
+        self.preemptions = Counter(
+            "tpumounter_preemptions_total",
+            "Live attachments detached by the broker to make room for a "
+            "high-priority request (victims are over-quota tenants)")
+        self.preemptions.inc(0.0)        # pre-seed: see orphans_reclaimed
+        self.lease_expirations = Counter(
+            "tpumounter_lease_expirations_total",
+            "Expired attachment leases auto-detached by the broker "
+            "(chips drained back to the pool instead of leaking)")
+        self.lease_expirations.inc(0.0)  # pre-seed: see orphans_reclaimed
+        self.active_leases = Gauge(
+            "tpumounter_active_leases",
+            "Live attachment leases tracked by the broker, by tenant")
+        # Usage/quota pair so dashboards (and doctor's >90% check) can
+        # compute quota pressure per tenant without knowing the config.
+        self.tenant_chips_in_use = Gauge(
+            "tpumounter_tenant_chips_in_use",
+            "Chips currently held under broker leases, by tenant")
+        self.tenant_quota_chips = Gauge(
+            "tpumounter_tenant_quota_chips",
+            "Configured chip quota by tenant (absent = unlimited)")
         # Identifies the build on every /metrics surface (standard
         # <name>_info pattern: constant 1, the payload is the label).
         from gpumounter_tpu import __version__

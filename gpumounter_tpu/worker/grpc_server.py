@@ -26,13 +26,18 @@ logger = get_logger("worker.grpc")
 SERVICE_NAME = "tpu_mount.TPUMountService"
 
 
+def _metadata_value(context: grpc.ServicerContext, wanted: str,
+                    default: str = "") -> str:
+    for key, value in context.invocation_metadata() or ():
+        if key == wanted:
+            return value
+    return default
+
+
 def _request_id(context: grpc.ServicerContext) -> str:
     """x-request-id from the caller's metadata (master stamps one per HTTP
     request) so one mount flow is grep-able across master+worker logs."""
-    for key, value in context.invocation_metadata() or ():
-        if key == "x-request-id":
-            return value
-    return "-"
+    return _metadata_value(context, "x-request-id", "-")
 
 
 def _add_handler(service: TPUMountService):
@@ -65,14 +70,20 @@ def _remove_handler(service: TPUMountService):
     def handle(request: pb.RemoveTPURequest,
                context: grpc.ServicerContext) -> pb.RemoveTPUResponse:
         rid = _request_id(context)
-        logger.info("[rid=%s] RemoveTPU %s/%s uuids=%s force=%s", rid,
+        # Detach cause rides metadata (no proto change): the broker's
+        # preemption / lease-expiry detaches say why, and the service
+        # propagates it into the audit event + journal record.
+        cause = _metadata_value(context, consts.DETACH_CAUSE_METADATA_KEY)
+        logger.info("[rid=%s] RemoveTPU %s/%s uuids=%s force=%s%s", rid,
                     request.namespace, request.pod_name,
-                    list(request.uuids), request.force)
+                    list(request.uuids), request.force,
+                    f" cause={cause}" if cause else "")
         try:
             outcome = service.remove_tpu(request.pod_name, request.namespace,
                                          list(request.uuids), request.force,
                                          txn_id=request.txn_id,
-                                         request_id=rid if rid != "-" else "")
+                                         request_id=rid if rid != "-" else "",
+                                         cause=cause)
         except TPUMounterError as e:
             logger.exception("[rid=%s] RemoveTPU internal failure", rid)
             context.abort(grpc.StatusCode.INTERNAL, str(e))
@@ -275,8 +286,13 @@ class WorkerClient:
             response_deserializer=pb.TPUNodeStatusResponse.FromString)
 
     @staticmethod
-    def _metadata(request_id: str | None):
-        return (("x-request-id", request_id),) if request_id else None
+    def _metadata(request_id: str | None, cause: str = ""):
+        meta = []
+        if request_id:
+            meta.append(("x-request-id", request_id))
+        if cause:
+            meta.append((consts.DETACH_CAUSE_METADATA_KEY, cause))
+        return tuple(meta) or None
 
     def add_tpu(self, pod_name: str, namespace: str, tpu_num: int,
                 is_entire_mount: bool,
@@ -292,11 +308,13 @@ class WorkerClient:
     def remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
                    force: bool,
                    request_id: str | None = None,
-                   txn_id: str = "") -> pb.RemoveTPUResponse:
+                   txn_id: str = "",
+                   cause: str = "") -> pb.RemoveTPUResponse:
         return self._remove(
             pb.RemoveTPURequest(pod_name=pod_name, namespace=namespace,
                                 uuids=uuids, force=force, txn_id=txn_id),
-            timeout=self.timeout_s, metadata=self._metadata(request_id))
+            timeout=self.timeout_s,
+            metadata=self._metadata(request_id, cause))
 
     def tpu_status(self, pod_name: str, namespace: str,
                    request_id: str | None = None) -> pb.TPUStatusResponse:

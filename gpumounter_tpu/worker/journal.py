@@ -107,6 +107,14 @@ class AttachJournal:
             record.pop("event", None)
             record["state"] = "intent"
             self._records[jid] = record
+        elif kind == "detach":
+            # Terminal audit record (never replayed): who released these
+            # devices and why — preemptions / lease expiries are
+            # explainable from the node alone.
+            record = dict(event)
+            record.pop("event", None)
+            record["state"] = "detached"
+            self._records[jid] = record
         elif jid in self._records and kind in ("commit", "revert",
                                                "revert_pending"):
             self._records[jid]["state"] = {
@@ -148,6 +156,23 @@ class AttachJournal:
                      "ts": round(time.time(), 3)}
             self._append(event)
             self._apply(event)
+
+    def record_detach(self, rid: str, namespace: str, pod: str,
+                      devices: list[str], cause: str = "",
+                      force: bool = False) -> str:
+        """Append a terminal detach record AFTER a successful detach —
+        pure audit (nothing to replay: the cluster is already consistent),
+        carrying the caller's cause (``preempted:...``,
+        ``lease-expired:...``, empty for owner-initiated)."""
+        jid = f"detach-{rid or 'manual'}-{secrets.token_hex(4)}"
+        event = {"jid": jid, "event": "detach", "rid": rid,
+                 "namespace": namespace, "pod": pod,
+                 "devices": sorted(devices), "cause": cause,
+                 "force": force, "ts": round(time.time(), 3)}
+        with self._lock:
+            self._append(event)
+            self._apply(event)
+        return jid
 
     def commit(self, jid: str) -> None:
         self._mark(jid, "commit")

@@ -334,16 +334,24 @@ class TPUMountService:
 
     def remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
                    force: bool, txn_id: str = "",
-                   request_id: str = "") -> RemoveOutcome:
+                   request_id: str = "", cause: str = "") -> RemoveOutcome:
+        """``cause`` (broker-initiated detaches: ``preempted:...``,
+        ``lease-expired:...``) is propagated into the trace, the
+        TPUDetached audit event and the journal's detach record, so "who
+        took my chips away and why" is answerable from every surface."""
         trace = Trace("detach", request_id or txn_id)
         trace.root.attrs.update(pod=f"{namespace}/{pod_name}",
                                 uuids=len(uuids), force=force)
+        if cause:
+            trace.root.attrs["cause"] = cause
         result_name = "EXCEPTION"
         try:
             with REGISTRY.detach_latency.time():
                 with self._pod_lock(namespace, pod_name):
                     outcome = self._remove_tpu(pod_name, namespace, uuids,
-                                               force, txn_id, trace=trace)
+                                               force, txn_id, trace=trace,
+                                               request_id=request_id,
+                                               cause=cause)
             result_name = outcome.result.name
         finally:
             trace.finish(result_name, REGISTRY.detach_phase)
@@ -352,7 +360,8 @@ class TPUMountService:
 
     def _remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
                     force: bool, txn_id: str = "", *,
-                    trace: Trace) -> RemoveOutcome:
+                    trace: Trace, request_id: str = "",
+                    cause: str = "") -> RemoveOutcome:
         with trace.span("resolve"):
             try:
                 pod = self.reads.get_pod(namespace, pod_name)
@@ -404,11 +413,21 @@ class TPUMountService:
                                  busy_pids=e.pids, message=str(e))
         with trace.span("cleanup"):
             self.allocator.delete_slave_pods(holders)
-        logger.info("RemoveTPU ok: %d chips off %s/%s (force=%s)",
-                    len(chips), namespace, pod_name, force)
+        logger.info("RemoveTPU ok: %d chips off %s/%s (force=%s%s)",
+                    len(chips), namespace, pod_name, force,
+                    f", cause={cause}" if cause else "")
+        # Journal the detach (terminal record, replay ignores it): the
+        # node-local audit of WHO released these devices and why — a
+        # preempted/expired attachment must be explainable from the node
+        # alone, same as a crash-replayed one.
+        if self.journal is not None:
+            self.journal.record_detach(
+                request_id or txn_id, namespace, pod_name,
+                [c.uuid for c in chips], cause=cause, force=force)
         self._record_event(
             pod, "TPUDetached",
-            f"detached {len(chips)} TPU chip(s) (force={force}): "
+            f"detached {len(chips)} TPU chip(s) (force={force}"
+            + (f", cause={cause}" if cause else "") + "): "
             f"{[c.uuid for c in chips]}")
         return RemoveOutcome(consts.RemoveResult.SUCCESS)
 

@@ -1,0 +1,558 @@
+"""Attach-broker suite (master/admission.py + master/lease.py): quota
+admission (429 + Retry-After), the contention queue's priority-then-fair
+completion order, high-priority preemption of over-quota tenants, lease
+expiry/renewal, and master-restart re-derivation from cluster ground
+truth with zero double-actuation."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.k8s.client import FakeKubeClient
+from gpumounter_tpu.master.admission import AttachBroker, BrokerConfig
+from gpumounter_tpu.master.discovery import WorkerDirectory
+from gpumounter_tpu.master.gateway import MasterGateway
+from gpumounter_tpu.testing.chaos import (assert_broker_invariants,
+                                          wait_events_drained)
+from gpumounter_tpu.utils.metrics import REGISTRY
+from gpumounter_tpu.worker.grpc_server import build_server
+
+from tests.helpers import WorkerRig, worker_pod
+
+
+class BrokerStack:
+    """WorkerRig + live gRPC worker + gateway over the rig's OWN fake
+    cluster (shared view: the broker's re-derivation and the preemption
+    victim scan see the worker's slave pods)."""
+
+    def __init__(self, fake_host, config=None, n_chips=4, extra_pods=(),
+                 **rig_kwargs):
+        self.rig = WorkerRig(fake_host, n_chips=n_chips, **rig_kwargs)
+        self.server, self.port = build_server(self.rig.service, port=0,
+                                              address="127.0.0.1")
+        self.server.start()
+        self.kube = self.rig.sim.kube
+        self.kube.put_pod(worker_pod("node-a", "127.0.0.1"))
+        for name in extra_pods:
+            pod = self.rig.sim.add_target_pod(name=name)
+            self.rig.provision_container(pod)
+        self.gateway = self.new_gateway(config)
+
+    def new_gateway(self, config=None) -> MasterGateway:
+        """A fresh master over the same cluster — the "restart"."""
+        broker = AttachBroker(self.kube, config or BrokerConfig())
+        return MasterGateway(self.kube,
+                             WorkerDirectory(self.kube,
+                                             grpc_port=self.port),
+                             broker=broker)
+
+    def close(self):
+        self.server.stop(grace=0)
+        self.rig.close()
+
+
+@pytest.fixture
+def stack_factory(fake_host):
+    stacks = []
+
+    def make(**kwargs) -> BrokerStack:
+        stack = BrokerStack(fake_host, **kwargs)
+        stacks.append(stack)
+        return stack
+
+    yield make
+    for stack in stacks:
+        stack.close()
+
+
+def add(gw, pod, n=2, entire=False, tenant=None, priority=None, rid=None,
+        ns="default"):
+    params = []
+    if tenant:
+        params.append(f"tenant={tenant}")
+    if priority:
+        params.append(f"priority={priority}")
+    path = (f"/addtpu/namespace/{ns}/pod/{pod}/tpu/{n}"
+            f"/isEntireMount/{'true' if entire else 'false'}")
+    if params:
+        path += "?" + "&".join(params)
+    headers = {"X-Request-Id": rid} if rid else None
+    return gw.handle("GET", path, headers=headers)
+
+
+def remove(gw, pod, uuids=None, force=False, ns="default"):
+    body = json.dumps({"uuids": uuids or []}).encode()
+    return gw.handle(
+        "POST", f"/removetpu/namespace/{ns}/pod/{pod}"
+                f"/force/{'true' if force else 'false'}", body)
+
+
+# -- admission: quotas ---------------------------------------------------------
+
+def test_over_quota_attach_429_with_retry_hint(stack_factory):
+    stack = stack_factory(config=BrokerConfig(quotas={"*": 2}),
+                          extra_pods=("w2",))
+    gw = stack.gateway
+    status, body = add(gw, "workload", 2)
+    assert status == 200 and body["result"] == "SUCCESS"
+    assert body["tenant"] == "default"          # namespace is the tenant
+    # same tenant (namespace default), third chip: over the cap
+    status, body = add(gw, "w2", 1)
+    assert status == 429 and body["result"] == "QuotaExceeded"
+    assert body["tenant"] == "default"
+    assert body["retry_after_s"] >= 0.1
+    assert REGISTRY.admission_decisions.value(
+        tenant="default", outcome="over_quota") >= 1
+    # an EXPLICIT different tenant has its own (also *:2) budget
+    status, body = add(gw, "w2", 1, tenant="teamB")
+    assert status == 200, body
+    assert body["tenant"] == "teamB"
+
+
+def test_concurrent_same_tenant_attaches_cannot_stampede_quota():
+    """Two same-tenant requests racing through admission must not BOTH
+    slip under the cap: the admitted chips are reserved in-flight until
+    the attempt resolves, so exactly one wins."""
+    from gpumounter_tpu.utils.errors import QuotaExceededError
+    broker = AttachBroker(FakeKubeClient(), BrokerConfig(quotas={"T": 2}))
+    broker.ensure_rederived()
+    results = []
+    guard = threading.Lock()
+
+    def slow_attempt():
+        time.sleep(0.2)           # hold the in-flight window open
+        return 200, {"result": "SUCCESS", "device_ids": ["a", "b"]}
+
+    def run(pod):
+        try:
+            status, _ = broker.attach(
+                tenant="T", priority="normal", namespace="d", pod=pod,
+                chips=2, node="n", rid=pod, attempt_fn=slow_attempt)
+        except QuotaExceededError:
+            status = 429
+        with guard:
+            results.append(status)
+
+    threads = [threading.Thread(target=run, args=(f"p{i}",))
+               for i in range(2)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join(timeout=10)
+    assert sorted(results) == [200, 429], results
+
+
+def test_detach_refunds_the_tenant(stack_factory):
+    stack = stack_factory(config=BrokerConfig(quotas={"*": 2}))
+    gw = stack.gateway
+    assert add(gw, "workload", 2)[0] == 200
+    assert add(gw, "workload", 1)[0] == 429
+    assert remove(gw, "workload")[0] == 200
+    assert gw.broker.leases.tenant_usage("default") == 0
+    assert add(gw, "workload", 2)[0] == 200
+
+
+def test_quota_burst_allows_borrowing_up_to_cap(stack_factory):
+    stack = stack_factory(
+        config=BrokerConfig(quotas={"hog": 2}, quota_burst=2.0))
+    gw = stack.gateway
+    # quota 2, burst 2 => cap 4: the whole node is borrowable while idle
+    status, body = add(gw, "workload", 4, entire=True, tenant="hog")
+    assert status == 200, body
+    # ...but the cap is hard: one more chip is denied
+    assert add(gw, "workload", 1, tenant="hog")[0] == 429
+
+
+def test_http_surface_retry_after_header_and_allow(stack_factory):
+    """Through a real HTTP server: 429 carries Retry-After, 405 carries
+    Allow (the serve() header lift for both broker and method hygiene)."""
+    stack = stack_factory(config=BrokerConfig(quotas={"*": 0}))
+    server = stack.gateway.serve(port=0, address="127.0.0.1")
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"{base}/addtpu/namespace/default/pod/workload"
+                "/tpu/1/isEntireMount/false")
+        assert err.value.code == 429
+        assert int(err.value.headers["Retry-After"]) >= 1
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/addtpu/namespace/default/pod/workload"
+                "/tpu/1/isEntireMount/false", data=b"", method="POST"))
+        assert err.value.code == 405
+        assert err.value.headers["Allow"] == "GET"
+    finally:
+        stack.gateway.broker.stop()
+        server.shutdown()
+
+
+def test_tenant_resolution_precedence_and_validation(stack_factory):
+    stack = stack_factory(config=BrokerConfig(quotas={"teamQ": 0}))
+    gw = stack.gateway
+    # header names the tenant
+    status, body = gw.handle(
+        "GET", "/addtpu/namespace/default/pod/workload/tpu/1"
+               "/isEntireMount/false",
+        headers={"X-Tpu-Tenant": "teamQ"})
+    assert status == 429 and body["tenant"] == "teamQ"
+    # query param beats the header
+    status, body = gw.handle(
+        "GET", "/addtpu/namespace/default/pod/workload/tpu/1"
+               "/isEntireMount/false?tenant=teamFree",
+        headers={"X-Tpu-Tenant": "teamQ"})
+    assert status == 200, body
+    assert body["tenant"] == "teamFree"
+    remove(gw, "workload")
+    # garbage tenant / priority are 400s, not silent defaults
+    status, body = add(gw, "workload", 1, tenant="bad/slash")
+    assert status == 400 and body["result"] == "BadRequest"
+    status, body = add(gw, "workload", 1, priority="urgent")
+    assert status == 400 and body["result"] == "BadRequest"
+
+
+# -- scheduling: queue + fairness + preemption ---------------------------------
+
+def _wait_until(predicate, timeout_s=10.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_contended_attach_queues_then_completes(stack_factory):
+    stack = stack_factory(
+        config=BrokerConfig(queue_timeout_s=20.0), extra_pods=("w2",))
+    gw = stack.gateway
+    assert add(gw, "workload", 4, entire=True)[0] == 200
+    done = {}
+
+    def queued_attach():
+        done["res"] = add(gw, "w2", 2)
+
+    thread = threading.Thread(target=queued_attach)
+    thread.start()
+    _wait_until(lambda: len(gw.broker._waiters) == 1, what="enqueue")
+    assert REGISTRY.queue_depth.value(priority="normal") == 1
+    assert remove(gw, "workload")[0] == 200       # frees all 4 chips
+    thread.join(timeout=20)
+    assert not thread.is_alive()
+    status, body = done["res"]
+    assert status == 200 and body["result"] == "SUCCESS"
+    assert body["queued_s"] >= 0.0
+    assert REGISTRY.queue_wait.count >= 1
+    assert REGISTRY.admission_decisions.value(
+        tenant="default", outcome="granted_queued") >= 1
+    assert_broker_invariants(gw.broker, stack.rig.sim)
+
+
+def test_queue_timeout_returns_insufficient_with_wait(stack_factory):
+    stack = stack_factory(
+        config=BrokerConfig(queue_timeout_s=0.2), extra_pods=("w2",))
+    gw = stack.gateway
+    assert add(gw, "workload", 4, entire=True)[0] == 200
+    t0 = time.monotonic()
+    status, body = add(gw, "w2", 2)
+    assert time.monotonic() - t0 >= 0.2
+    assert status == 503 and body["result"] == "INSUFFICIENT_TPU"
+    assert body["queue_timeout"] is True and body["queued_s"] >= 0.19
+    assert REGISTRY.admission_decisions.value(
+        tenant="default", outcome="queue_timeout") >= 1
+    assert gw.broker._waiters == []
+
+
+def test_queue_full_sheds_with_429(stack_factory):
+    stack = stack_factory(
+        config=BrokerConfig(queue_timeout_s=20.0, queue_depth=1),
+        extra_pods=("w2", "w3"))
+    gw = stack.gateway
+    assert add(gw, "workload", 4, entire=True)[0] == 200
+    done = {}
+    thread = threading.Thread(
+        target=lambda: done.update(res=add(gw, "w2", 2)))
+    thread.start()
+    _wait_until(lambda: len(gw.broker._waiters) == 1, what="enqueue")
+    status, body = add(gw, "w3", 2)               # the FIFO is at bound
+    assert status == 429 and body["result"] == "QueueFull"
+    assert body["retry_after_s"] > 0
+    assert remove(gw, "workload")[0] == 200
+    thread.join(timeout=20)
+    assert done["res"][0] == 200
+
+
+def test_dequeue_order_priority_then_weighted_fair():
+    """Pure-broker determinism: released capacity is granted high-first,
+    then across tenants by smallest quota-share in use, then FIFO."""
+    broker = AttachBroker(FakeKubeClient(),
+                          BrokerConfig(quotas={"A": 4, "B": 4},
+                                       queue_timeout_s=30.0))
+    broker.ensure_rederived()          # empty cluster: nothing derived
+    # tenant A already holds 2 chips => B is fairness-first among normals
+    broker.leases.record("default", "pre", "A", "normal", ["p0", "p1"])
+    capacity = {"free": 0}
+    guard = threading.Lock()
+    order: list[str] = []
+
+    def make_attempt(name: str):
+        def attempt():
+            with guard:
+                if capacity["free"] >= 1:
+                    capacity["free"] -= 1
+                    order.append(name)
+                    return 200, {"result": "SUCCESS",
+                                 "device_ids": [f"{name}-0"]}
+            return 503, {"result": "INSUFFICIENT_TPU"}
+        return attempt
+
+    waiters = (("low-a", "A", "low"), ("norm-a", "A", "normal"),
+               ("norm-b", "B", "normal"), ("high-b", "B", "high"))
+    threads = []
+    for name, tenant, priority in waiters:
+        threads.append(threading.Thread(
+            target=lambda n=name, t=tenant, p=priority: broker.attach(
+                tenant=t, priority=p, namespace="default", pod=n,
+                chips=1, node="node-a", rid=n,
+                attempt_fn=make_attempt(n))))
+    for thread in threads:
+        thread.start()
+    _wait_until(lambda: len(broker._waiters) == 4, what="4 waiters parked")
+
+    def settled():
+        # the previous generation's baton chain has fully died down:
+        # nobody is armed and everybody has retried the current gen —
+        # without this, a freed chip can race a mid-chain retry and the
+        # order reflects the race, not the dequeue policy
+        with broker._lock:
+            return all(w.tried_gen >= broker._gen
+                       and not w.event.is_set()
+                       for w in broker._waiters)
+
+    for expected_len in range(1, 5):
+        _wait_until(settled, what="baton chain settled")
+        with guard:
+            capacity["free"] += 1
+        broker.signal_capacity()
+        _wait_until(lambda: len(order) >= expected_len,
+                    what=f"grant #{expected_len}")
+    for thread in threads:
+        thread.join(timeout=10)
+    assert order == ["high-b", "norm-b", "norm-a", "low-a"], order
+
+
+def test_high_priority_preempts_over_quota_victim(stack_factory):
+    """The acceptance scenario: hog borrows the whole node via burst, a
+    high-priority request of another tenant arrives, the broker preempts
+    the hog's (lowest-priority, over-quota) attachment through the
+    normal worker path — victim cleanly detached, cause visible in the
+    audit event AND the node-local journal, chips re-granted."""
+    stack = stack_factory(
+        config=BrokerConfig(quotas={"hog": 2, "*": 4}, quota_burst=2.0,
+                            queue_timeout_s=20.0),
+        extra_pods=("hog-pod", "vip-pod"))
+    gw = stack.gateway
+    preempts_before = REGISTRY.preemptions.value()
+    status, body = add(gw, "hog-pod", 4, entire=True, tenant="hog")
+    assert status == 200, body
+    status, body = add(gw, "vip-pod", 4, entire=True, tenant="vip",
+                       priority="high", rid="vip-rid")
+    assert status == 200, body
+    assert body["result"] == "SUCCESS" and len(body["device_ids"]) == 4
+    assert REGISTRY.preemptions.value() - preempts_before == 1
+    # victim is fully gone: lease dropped, only vip's slave pods remain
+    assert gw.broker.leases.get("default", "hog-pod") is None
+    lease = gw.broker.leases.get("default", "vip-pod")
+    assert lease is not None and lease.chips == 4
+    wait_events_drained(stack.rig.service)
+    causes = [e["message"] for e in stack.kube.events
+              if e.get("reason") == "TPUDetached"]
+    assert any("cause=preempted:vip:vip-rid" in m for m in causes), causes
+    # journaled on the node: the detach record says who took the chips
+    detach_records = [r for r in stack.rig.journal.snapshot()["records"]
+                      if r["state"] == "detached"]
+    assert any(r.get("cause", "").startswith("preempted:vip")
+               for r in detach_records), detach_records
+    assert_broker_invariants(gw.broker, stack.rig.sim)
+
+
+def test_no_preemption_without_over_quota_victims(stack_factory):
+    """Hard caps (burst 1.0) leave nothing preemptible: a high request
+    waits out the queue like anyone else."""
+    stack = stack_factory(
+        config=BrokerConfig(quotas={"*": 4}, queue_timeout_s=0.2),
+        extra_pods=("w2",))
+    gw = stack.gateway
+    assert add(gw, "workload", 4, entire=True)[0] == 200
+    preempts_before = REGISTRY.preemptions.value()
+    status, body = add(gw, "w2", 2, tenant="other", priority="high")
+    assert status == 503 and body.get("queue_timeout")
+    assert REGISTRY.preemptions.value() == preempts_before
+    assert gw.broker.leases.get("default", "workload").chips == 4
+
+
+# -- leases: expiry, renewal ---------------------------------------------------
+
+def test_expired_lease_auto_detaches_and_frees_chips(stack_factory):
+    stack = stack_factory(config=BrokerConfig(lease_ttl_s=0.3))
+    gw = stack.gateway
+    expirations_before = REGISTRY.lease_expirations.value()
+    status, body = add(gw, "workload", 4, entire=True, rid="short-lease")
+    assert status == 200
+    assert 0 < body["lease_expires_in_s"] <= 0.4
+    assert gw.broker.tick() == 0          # not expired yet
+    time.sleep(0.35)
+    assert gw.broker.tick() == 1          # reaped exactly one
+    assert gw.broker.leases.leases() == []
+    assert stack.rig.sim.slave_pods() == []   # chips drained back
+    assert REGISTRY.lease_expirations.value() - expirations_before == 1
+    wait_events_drained(stack.rig.service)
+    causes = [e["message"] for e in stack.kube.events
+              if e.get("reason") == "TPUDetached"]
+    assert any("cause=lease-expired:short-lease" in m for m in causes)
+    # the node is reusable immediately
+    assert add(gw, "workload", 4, entire=True)[0] == 200
+    assert_broker_invariants(gw.broker, stack.rig.sim)
+
+
+def test_renew_extends_the_lease(stack_factory):
+    stack = stack_factory(config=BrokerConfig(lease_ttl_s=0.3))
+    gw = stack.gateway
+    assert add(gw, "workload", 2)[0] == 200
+    status, body = gw.handle(
+        "POST", "/renew/namespace/default/pod/workload?ttl=60")
+    assert status == 200 and body["result"] == "SUCCESS"
+    assert body["lease"]["expires_in_s"] > 50
+    assert body["lease"]["renewals"] == 1
+    time.sleep(0.35)
+    assert gw.broker.tick() == 0          # renewed: outlives the old TTL
+    assert len(stack.rig.sim.slave_pods()) == 2
+    # an unknown lease cannot be renewed (expired-and-reaped contract)
+    status, body = gw.handle("POST", "/renew/namespace/default/pod/ghost")
+    assert status == 404 and body["result"] == "LeaseNotFound"
+    # wrong method on a known route: 405 + Allow, not 404
+    status, body = gw.handle("GET",
+                             "/renew/namespace/default/pod/workload")
+    assert status == 405 and body["allow"] == "POST"
+
+
+def test_expiry_reap_defers_on_busy_devices(stack_factory):
+    """A lease whose devices are held open is NOT force-killed: the reap
+    defers with backoff and the lease stays visible as stuck."""
+    stack = stack_factory(config=BrokerConfig(lease_ttl_s=0.3))
+    gw = stack.gateway
+    status, body = add(gw, "workload", 1)
+    assert status == 200
+    path = body["device_paths"][0]
+    stack.rig.sim.enumerator.busy_pids = {path: [stack.rig.pid]}
+    time.sleep(0.35)
+    assert gw.broker.tick() == 0                    # deferred, not reaped
+    lease = gw.broker.leases.get("default", "workload")
+    assert lease is not None and lease.reap_failures == 1
+    assert len(stack.rig.sim.slave_pods()) == 1     # chips still granted
+    stack.rig.sim.enumerator.busy_pids = {}
+    time.sleep(2.1)                                 # past the backoff
+    assert gw.broker.tick() == 1
+    assert stack.rig.sim.slave_pods() == []
+
+
+# -- restart re-derivation -----------------------------------------------------
+
+def test_master_restart_rederives_quotas_from_ground_truth(stack_factory):
+    stack = stack_factory(config=BrokerConfig(quotas={"*": 4}),
+                          extra_pods=("w2",))
+    assert add(stack.gateway, "workload", 4, entire=True,
+               rid="original")[0] == 200
+    # "restart": a brand-new gateway + broker over the same cluster
+    gw2 = stack.new_gateway(BrokerConfig(quotas={"*": 4}))
+    status, body = gw2.handle("GET", "/brokerz")
+    assert status == 200
+    assert body["leases"]["count"] == 1
+    (lease,) = body["leases"]["leases"]
+    assert lease["pod"] == "workload" and lease["chips"] == 4
+    assert lease["tenant"] == "default"        # collapses to namespace
+    assert lease["rederived"] is True
+    assert lease["rid"] == "original"          # from the request-id label
+    # quota enforcement continues seamlessly across the restart
+    status, body = add(gw2, "w2", 1)
+    assert status == 429 and body["result"] == "QuotaExceeded"
+    # zero double-actuation: a tick on the fresh broker detaches nothing
+    detaches_before = REGISTRY.detach_results.value(result="SUCCESS")
+    assert gw2.broker.tick() == 0
+    assert REGISTRY.detach_results.value(
+        result="SUCCESS") == detaches_before
+    assert len(stack.rig.sim.slave_pods()) == 1
+    # the re-derived lease is live: detach through the NEW master works
+    assert remove(gw2, "workload")[0] == 200
+    assert gw2.broker.leases.tenant_usage("default") == 0
+    assert add(gw2, "w2", 1)[0] == 200
+    assert_broker_invariants(gw2.broker, stack.rig.sim)
+
+
+def test_rederived_lease_gets_fresh_ttl_then_expires_once(stack_factory):
+    stack = stack_factory(config=BrokerConfig(lease_ttl_s=30.0))
+    assert add(stack.gateway, "workload", 2)[0] == 200
+    gw2 = stack.new_gateway(BrokerConfig(lease_ttl_s=0.3))
+    assert gw2.broker.tick() == 0            # fresh TTL: no insta-reap
+    assert len(stack.rig.sim.slave_pods()) == 2
+    time.sleep(0.35)
+    assert gw2.broker.tick() == 1            # then exactly one expiry
+    assert stack.rig.sim.slave_pods() == []
+    wait_events_drained(stack.rig.service)
+    detached = [e for e in stack.kube.events
+                if e.get("reason") == "TPUDetached"]
+    assert len(detached) == 1                # no double-detach
+
+
+# -- gateway method hygiene (satellite) ----------------------------------------
+
+def test_known_routes_wrong_method_405_with_allow(stack_factory):
+    gw = stack_factory().gateway
+    for method, path, allow in (
+            ("POST", "/healthz", "GET"),
+            ("POST", "/version", "GET"),
+            ("POST", "/addtpu/namespace/d/pod/p/tpu/1"
+                     "/isEntireMount/true", "GET"),
+            ("GET", "/removetpu/namespace/d/pod/p/force/false", "POST"),
+            ("POST", "/tpustatus/namespace/d/pod/p", "GET"),
+            ("POST", "/nodestatus/node/n", "GET"),
+            ("GET", "/addtpuslice", "POST"),
+            ("GET", "/removetpuslice", "POST"),
+            ("POST", "/tracez", "GET"),
+            ("POST", "/brokerz", "GET")):
+        status, body = gw.handle(method, path)
+        assert status == 405, (method, path, status)
+        assert body["result"] == "MethodNotAllowed"
+        assert body["allow"] == allow
+    # unknown paths still 404
+    status, body = gw.handle("GET", "/nope")
+    assert status == 404 and body["result"] == "NoSuchRoute"
+
+
+def test_version_route_unchanged(stack_factory):
+    import gpumounter_tpu
+    gw = stack_factory().gateway
+    status, body = gw.handle("GET", "/version")
+    assert status == 200 and body["version"] == gpumounter_tpu.__version__
+
+
+# -- slice admission -----------------------------------------------------------
+
+def test_slice_attach_is_quota_gated(stack_factory):
+    stack = stack_factory(config=BrokerConfig(quotas={"*": 2}))
+    body = json.dumps({"pods": [{"namespace": "default",
+                                 "pod": "workload"}],
+                       "tpusPerHost": 4}).encode()
+    status, payload = stack.gateway.handle("POST", "/addtpuslice", body)
+    assert status == 429 and payload["result"] == "QuotaExceeded"
+    # under quota, the slice attaches and records a lease
+    body = json.dumps({"pods": [{"namespace": "default",
+                                 "pod": "workload"}],
+                       "tpusPerHost": 2, "tenant": "sliceTeam"}).encode()
+    status, payload = stack.gateway.handle("POST", "/addtpuslice", body)
+    assert status == 200, payload
+    assert stack.gateway.broker.leases.tenant_usage("sliceTeam") == 2

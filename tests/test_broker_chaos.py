@@ -1,0 +1,169 @@
+"""Broker race/chaos plans (ISSUE 5 satellite): a lease expiring while
+its owner is mid-detach, a preemption firing during the victim's
+actuation, and a master crash-restart with a non-empty contention queue —
+each must uphold the node-local chaos invariants PLUS the broker-layer
+ones (lease table == cluster ground truth, no stranded waiters, no
+double-detach)."""
+
+import threading
+import time
+
+import pytest
+
+from gpumounter_tpu.master.admission import BrokerConfig
+from gpumounter_tpu.testing.chaos import (Fault, FaultInjector,
+                                          assert_broker_invariants,
+                                          assert_invariants,
+                                          wait_events_drained)
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+from tests.test_broker import BrokerStack, add, remove
+
+
+@pytest.fixture
+def stack_factory(fake_host):
+    stacks = []
+
+    def make(**kwargs) -> BrokerStack:
+        stack = BrokerStack(fake_host, **kwargs)
+        stacks.append(stack)
+        return stack
+
+    yield make
+    for stack in stacks:
+        stack.close()
+
+
+def _detached_events(sim):
+    return [e for e in sim.kube.events if e.get("reason") == "TPUDetached"]
+
+
+def test_lease_expires_while_owner_mid_detach(stack_factory):
+    """The expiry reaper races an owner-initiated detach that is slowed
+    mid-cleanup (injected DELETE latency). The worker's per-pod lock
+    serialises them; whoever loses finds nothing to detach — exactly one
+    actuated detach, no double-release, no leaked reservation."""
+    stack = stack_factory(config=BrokerConfig(lease_ttl_s=0.2))
+    gw = stack.gateway
+    status, _ = add(gw, "workload", 2, rid="race-lease")
+    assert status == 200
+    # owner detach will stall 0.5s inside its slave-pod DELETE
+    injector = FaultInjector([Fault(op="DELETE", resource="pods",
+                                    latency_s=0.5, times=1)])
+    stack.kube.faults = injector
+    time.sleep(0.25)                      # lease is now expired
+    done = {}
+    thread = threading.Thread(
+        target=lambda: done.update(res=remove(gw, "workload")))
+    thread.start()
+    time.sleep(0.1)                       # owner detach is in flight
+    reaped = gw.broker.tick()             # expiry reaper fires into the race
+    thread.join(timeout=20)
+    assert not thread.is_alive()
+    assert done["res"][0] == 200          # the owner's detach won
+    assert injector.fired, "the DELETE latency fault never bit"
+    # reaper either found the lease already released (reaped 0) or its
+    # detach answered TPU_NOT_FOUND/POD_NOT_FOUND (reaped 1, no actuation)
+    assert reaped in (0, 1)
+    assert gw.broker.leases.leases() == []
+    assert stack.rig.sim.slave_pods() == []
+    wait_events_drained(stack.rig.service)
+    # ONE actuated detach: the loser of the race must not have re-detached
+    assert len(_detached_events(stack.rig.sim)) == 1
+    assert_invariants(stack.rig, set(), max_attached_events=1)
+    assert_broker_invariants(gw.broker, stack.rig.sim)
+
+
+def test_preemption_fires_during_victim_actuation(stack_factory):
+    """A high-priority request arrives while the victim's attach is still
+    actuating (slow scripted scheduler). The preemption detach serialises
+    behind the victim's attach on the worker's pod lock; the victim is
+    then cleanly detached and the high request completes — no partial
+    grant survives on either pod."""
+    stack = stack_factory(
+        config=BrokerConfig(quotas={"hog": 2, "*": 4}, quota_burst=2.0,
+                            queue_timeout_s=30.0),
+        extra_pods=("hog-pod", "vip-pod"),
+        schedule_delay_s=0.3)
+    gw = stack.gateway
+    hog_done, vip_done = {}, {}
+    hog_thread = threading.Thread(target=lambda: hog_done.update(
+        res=add(gw, "hog-pod", 4, entire=True, tenant="hog",
+                rid="hog-rid")))
+    hog_thread.start()
+    time.sleep(0.1)                       # hog's actuation is in flight
+    vip_thread = threading.Thread(target=lambda: vip_done.update(
+        res=add(gw, "vip-pod", 4, entire=True, tenant="vip",
+                priority="high", rid="vip-rid")))
+    vip_thread.start()
+    hog_thread.join(timeout=30)
+    vip_thread.join(timeout=30)
+    assert not hog_thread.is_alive() and not vip_thread.is_alive()
+    assert hog_done["res"][0] == 200      # the victim DID attach first
+    status, body = vip_done["res"]
+    assert status == 200 and len(body["device_ids"]) == 4
+    # victim fully preempted: no hog lease, no hog slave pods, cause on
+    # the audit trail
+    assert gw.broker.leases.get("default", "hog-pod") is None
+    lease = gw.broker.leases.get("default", "vip-pod")
+    assert lease is not None and lease.chips == 4
+    wait_events_drained(stack.rig.service)
+    causes = [e["message"] for e in _detached_events(stack.rig.sim)]
+    assert any("cause=preempted:vip:vip-rid" in m for m in causes), causes
+    assert_broker_invariants(gw.broker, stack.rig.sim)
+    # node-local invariants: vip's 4 chips are the only surviving grant
+    expected = set(body["device_ids"])
+    held = {
+        device_id
+        for containers in stack.rig.sim.podresources.assignments.values()
+        for resources in containers.values()
+        for ids in resources.values()
+        for device_id in ids}
+    assert held == expected
+
+
+def test_master_crash_restart_with_non_empty_queue(stack_factory):
+    """A queued attach is parked when the master 'crashes'. The new
+    master re-derives lease state from cluster ground truth, serves
+    detaches/attaches immediately, and neither master double-actuates;
+    the stranded waiter times out cleanly in the old process."""
+    stack = stack_factory(
+        config=BrokerConfig(quotas={"*": 4}, queue_timeout_s=1.0),
+        extra_pods=("w2",))
+    gw1 = stack.gateway
+    assert add(gw1, "workload", 4, entire=True)[0] == 200
+    queued = {}
+    # a DIFFERENT tenant (under its own *:4 budget) so admission passes
+    # and the request parks on capacity, not on quota
+    thread = threading.Thread(
+        target=lambda: queued.update(res=add(gw1, "w2", 2,
+                                             tenant="other")))
+    thread.start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not gw1.broker._waiters:
+        time.sleep(0.01)
+    assert gw1.broker._waiters, "attach never queued"
+    # "crash": a fresh master over the same cluster while the queue is
+    # non-empty. The old broker's loop was never started; its waiter is
+    # stranded until its own deadline.
+    gw2 = stack.new_gateway(BrokerConfig(quotas={"*": 4},
+                                         queue_timeout_s=1.0))
+    detaches_before = REGISTRY.detach_results.value(result="SUCCESS")
+    assert gw2.broker.tick() == 0         # re-derivation reaps nothing
+    assert REGISTRY.detach_results.value(
+        result="SUCCESS") == detaches_before
+    assert gw2.broker.leases.tenant_usage("default") == 4
+    # quota continuity: the re-derived usage still gates admission
+    assert add(gw2, "w2", 1)[0] == 429
+    # the stranded waiter drains out with a queue timeout, not a hang
+    thread.join(timeout=20)
+    assert not thread.is_alive()
+    status, body = queued["res"]
+    assert status == 503 and body.get("queue_timeout") is True
+    assert gw1.broker._waiters == []
+    # life goes on through the new master: free the node, queue works
+    assert remove(gw2, "workload")[0] == 200
+    assert add(gw2, "w2", 2)[0] == 200
+    wait_events_drained(stack.rig.service)
+    assert len(_detached_events(stack.rig.sim)) == 1   # no double-detach
+    assert_broker_invariants(gw2.broker, stack.rig.sim)

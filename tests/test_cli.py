@@ -539,6 +539,43 @@ def test_cachez_against_informerless_worker(fake_host):
         stack.close()
 
 
+def test_renew_cli_and_doctor_broker_checks(fake_host):
+    """Broker satellites end-to-end over HTTP: `tpumounterctl renew`
+    extends a live lease (404 + typed exit code for unknown ones), and
+    doctor reports queue depth / quota pressure from the new metric
+    families — a tenant at 100% of quota WARNs."""
+    from gpumounter_tpu.master.admission import BrokerConfig
+    stack = LiveStack(WorkerRig(fake_host, use_kubelet_socket=True),
+                      broker_config=BrokerConfig(quotas={"*": 2},
+                                                 lease_ttl_s=600.0))
+    try:
+        base = stack.base
+        rc, out = run_cli(base, "add", "workload", "--tpus", "2")
+        assert rc == 0 and "SUCCESS" in out
+        rc, out = run_cli(base, "renew", "workload", "--ttl", "1200")
+        assert rc == 0 and "lease extended" in out
+        rc, out = run_cli(base, "--json", "renew", "workload")
+        payload = json.loads(out)
+        assert payload["result"] == "SUCCESS"
+        assert payload["lease"]["renewals"] == 2
+        rc, out = run_cli(base, "renew", "ghost")
+        assert rc == cli.EXIT_CODES["LeaseNotFound"]
+        # over-quota attach surfaces the typed 429 exit code
+        rc, out = run_cli(base, "--json", "add", "workload", "--tpus", "1")
+        assert rc == cli.EXIT_CODES["QuotaExceeded"]
+        assert json.loads(out)["result"] == "QuotaExceeded"
+        # doctor: tenant 'default' sits at 2/2 chips => >90% quota WARN,
+        # queue is empty => reported, not warned
+        stack.gateway.broker.tick()      # refresh the broker gauges now
+        rc, out = run_cli(base, "doctor")
+        assert rc == 1
+        assert ">90% quota" in out
+        assert "default (2/2 chips)" in out
+        assert "attach queue empty" in out
+    finally:
+        stack.close()
+
+
 def test_doctor_reports_informer_cache_health(fake_host):
     """doctor pointed at a worker's health port surfaces the cache check
     (fresh => OK; the WARN path is driven by staleness over threshold)."""

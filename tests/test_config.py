@@ -25,6 +25,51 @@ def test_settings_env_overrides():
     assert s.allocation_timeout_s == 7.5
 
 
+def test_parse_tenant_quotas():
+    from gpumounter_tpu.utils.config import parse_tenant_quotas
+    assert parse_tenant_quotas("teamA:16,teamB:8,*:4") == \
+        {"teamA": 16, "teamB": 8, "*": 4}
+    assert parse_tenant_quotas("") == {}
+    assert parse_tenant_quotas(" teamA:1 , ") == {"teamA": 1}
+    for bad in ("teamA", "teamA:x", ":4", "a:1,a:2", "a:-1"):
+        with pytest.raises(ValueError):
+            parse_tenant_quotas(bad)
+
+
+def test_broker_settings_from_env():
+    s = Settings.from_env({
+        consts.ENV_QUOTAS: "teamA:16,*:4",
+        consts.ENV_QUOTA_BURST: "1.5",
+        consts.ENV_LEASE_TTL_S: "3600",
+        consts.ENV_QUEUE_TIMEOUT_S: "30",
+        consts.ENV_QUEUE_DEPTH: "8",
+    })
+    assert s.tenant_quotas == {"teamA": 16, "*": 4}
+    assert s.quota_burst == 1.5
+    assert s.lease_ttl_s == 3600.0
+    assert s.queue_timeout_s == 30.0
+    assert s.queue_depth == 8
+    # defaults preserve the historical behavior exactly
+    s = Settings.from_env({})
+    assert s.tenant_quotas == {} and s.quota_burst == 1.0
+    assert s.lease_ttl_s == 0.0 and s.queue_timeout_s == 0.0
+    # a burst below 1.0 would make quotas deny what it claims to grant
+    with pytest.raises(ValueError):
+        Settings.from_env({consts.ENV_QUOTA_BURST: "0.5"})
+
+
+def test_broker_config_maps_settings():
+    from gpumounter_tpu.master.admission import BrokerConfig
+    s = Settings.from_env({consts.ENV_QUOTAS: "t:2",
+                           consts.ENV_LEASE_TTL_S: "60",
+                           consts.ENV_POOL_NAMESPACE: "my-pool"})
+    config = BrokerConfig.from_settings(s)
+    assert config.quotas == {"t": 2}
+    assert config.lease_ttl_s == 60.0
+    assert config.pool_namespace == "my-pool"
+    assert config.resource_name == s.resource_name
+
+
 def test_settings_rejects_unknown_cgroup_driver():
     # ref cgroup.go:78-84: only systemd|cgroupfs are valid
     with pytest.raises(ValueError):

@@ -170,9 +170,8 @@ class CgroupDeviceController:
         else:
             # v1 has no read-back of current rules; write allows for all
             # desired (idempotent — duplicate allows are no-ops).
-            for major, minor in _chip_majmins(desired_chips):
-                self._v1_write(pod, container_id, "devices.allow",
-                               major, minor)
+            self._v1_write_batch(pod, container_id, "devices.allow",
+                                 _chip_majmins(desired_chips))
 
     def revoke_device_access(self, pod: objects.Pod, container_id: str,
                              chips_to_remove: list[TPUChip],
@@ -192,17 +191,29 @@ class CgroupDeviceController:
             # don't deny nodes (e.g. the shared /dev/vfio/vfio companion)
             # still needed by remaining chips
             keep = set(_chip_majmins(remaining_chips))
-            for major, minor in _chip_majmins(chips_to_remove):
-                if (major, minor) not in keep:
-                    self._v1_write(pod, container_id, "devices.deny",
-                                   major, minor)
+            self._v1_write_batch(
+                pod, container_id, "devices.deny",
+                [mm for mm in _chip_majmins(chips_to_remove)
+                 if mm not in keep])
 
     def _v1_write(self, pod: objects.Pod, container_id: str, filename: str,
                   major: int, minor: int) -> None:
         """Ref cgroup.go:143-169 Add/RemoveGPUDevicePermission — direct write
         of ``c <major>:<minor> rw`` instead of shelling echo."""
+        self._v1_write_batch(pod, container_id, filename, [(major, minor)])
+
+    def _v1_write_batch(self, pod: objects.Pod, container_id: str,
+                        filename: str,
+                        majmins: list[tuple[int, int]]) -> None:
+        """All of a batch's rules through ONE open of the devices file —
+        the v1 side of the fused-actuation discipline. Each rule stays its
+        own write(2): the kernel parses one op per write, so fusing the
+        file open must not fuse the ops themselves."""
+        if not majmins:
+            return
         path = os.path.join(self._v1_devices_dir(pod, container_id), filename)
-        entry = f"c {major}:{minor} {consts.DEVICE_CGROUP_PERMISSIONS}"
+        entries = [f"c {major}:{minor} {consts.DEVICE_CGROUP_PERMISSIONS}"
+                   for major, minor in majmins]
         try:
             # O_APPEND, kernel-equivalent to "w" (the devices files are
             # write-only ops, not stores). Append is load-bearing for
@@ -210,10 +221,17 @@ class CgroupDeviceController:
             # inspecting a fixture/host tree can only observe grants through
             # this file, and truncate-mode would erase all but the last op.
             with open(path, "a") as f:
-                f.write(entry + "\n")
+                for entry in entries:
+                    f.write(entry + "\n")
+                    # flush per rule: the kernel parses devices.allow/deny
+                    # one rule per write(2), and the buffered writer would
+                    # otherwise coalesce the batch into a single write
+                    # that the kernel truncates at the first newline
+                    f.flush()
         except OSError as e:
-            raise CgroupError(f"write {entry!r} to {path} failed: {e}") from e
-        logger.debug("v1 %s <- %s", path, entry)
+            raise CgroupError(
+                f"write {entries!r} to {path} failed: {e}") from e
+        logger.debug("v1 %s <- %d rule(s)", path, len(entries))
 
     def _v2_sync(self, pod: objects.Pod, container_id: str,
                  chips: list[TPUChip],

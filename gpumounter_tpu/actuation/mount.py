@@ -21,6 +21,8 @@ from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
 from gpumounter_tpu.actuation.nsenter import ContainerNsActuator
 from gpumounter_tpu.device.enumerator import Enumerator
 from gpumounter_tpu.device.model import TPUChip
+from gpumounter_tpu.device.plan import (NodePlanCache, batch_creates,
+                                        batch_removes)
 from gpumounter_tpu.k8s import objects
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.config import HostPaths
@@ -60,11 +62,16 @@ class TPUMounter:
 
     def __init__(self, cgroups: CgroupDeviceController,
                  actuator: ContainerNsActuator, enumerator: Enumerator,
-                 host: HostPaths | None = None):
+                 host: HostPaths | None = None,
+                 plans: NodePlanCache | None = None):
         self.cgroups = cgroups
         self.actuator = actuator
         self.enumerator = enumerator
         self.host = host or HostPaths()
+        # Precomputed per-chip actuation plans (device/plan.py), rebuilt
+        # by the collector on every enumeration. A fresh cache with no
+        # builds behaves identically: plan_for computes from the chip.
+        self.plans = plans if plans is not None else NodePlanCache()
 
     # -- helpers ---------------------------------------------------------------
 
@@ -204,15 +211,11 @@ class TPUMounter:
         already existed — i.e. this call resumed an attach that a prior
         attempt had fully actuated).
         """
-        creates = []
-        for chip in new_chips:
-            creates.append((chip.container_path, chip.major, chip.minor))
-            for companion in chip.companions:
-                creates.append((companion.container_path, companion.major,
-                                companion.minor))
-        # shared companions (e.g. /dev/vfio/vfio rides with every chip)
-        # need exactly one node per container
-        creates = list(dict.fromkeys(creates))
+        # Creates come from the precomputed plan cache (device/plan.py):
+        # chip + companion ops with shared companions (e.g. /dev/vfio/vfio
+        # rides with every chip) deduped to one node per container.
+        creates = batch_creates([self.plans.plan_for(c)
+                                 for c in new_chips])
 
         def actuate(container_id: str, pid: int) -> int:
             self.cgroups.sync_device_access(pod, container_id,
@@ -223,7 +226,7 @@ class TPUMounter:
 
         created = sum(self._fan_out_containers(
             self._actuatable_containers(pod), actuate))
-        logger.info("mounted %d chips (%d new nodes) into %s/%s",
+        logger.debug("mounted %d chips (%d new nodes) into %s/%s",
                     len(new_chips), created, objects.namespace(pod),
                     objects.name(pod))
         return created
@@ -245,15 +248,11 @@ class TPUMounter:
             uuid, pids = next(iter(busy.items()))
             raise DeviceBusyError(uuid, pids)
 
-        remaining_companions = {c.host_path for chip in remaining_chips
-                                for c in chip.companions}
-        removes = []
-        for chip in chips:
-            removes.append(chip.container_path)
-            for companion in chip.companions:
-                if companion.host_path not in remaining_companions:
-                    removes.append(companion.container_path)
-        removes = list(dict.fromkeys(removes))
+        # Unlinks from the plan cache: the detached chips' nodes minus any
+        # node (shared companion) a remaining chip still needs.
+        removes = batch_removes(
+            [self.plans.plan_for(c) for c in chips],
+            [self.plans.plan_for(c) for c in remaining_chips])
 
         def actuate(container_id: str, pid: int) -> None:
             self.cgroups.revoke_device_access(pod, container_id, chips,
@@ -266,5 +265,5 @@ class TPUMounter:
             all_pids = sorted({p for pids in busy.values() for p in pids})
             self.actuator.kill_processes(all_pids)
             logger.warning("force-killed device holders: %s", all_pids)
-        logger.info("unmounted %d chips from %s/%s",
+        logger.debug("unmounted %d chips from %s/%s",
                     len(chips), objects.namespace(pod), objects.name(pod))

@@ -320,7 +320,7 @@ class TPUAllocator:
             for chip in chips:
                 chip.accelerator = topo.accelerator
                 chip.topology = topo.topology
-        logger.info("allocated %d chips via %d slave pods: %s",
+        logger.debug("allocated %d chips via %d slave pods: %s",
                     len(chips), len(created),
                     [c.uuid for c in chips])
         annotate(chips=len(chips), slave_pods=len(created),

@@ -374,6 +374,53 @@ def cmd_cachez(args) -> int:
     return rc
 
 
+def cmd_agentz(args) -> int:
+    """Resident actuation agent introspection from a worker's health
+    port: cached namespace handles per container, revalidation outcomes,
+    and the fallback count (non-zero = the fork-free warm path is
+    degrading to the fallback actuator — doctor WARNs on a windowed
+    rate)."""
+    try:
+        payload = json.loads(_fetch_text(args.master, "/agentz",
+                                         args.timeout))
+    except TransportError as e:
+        print(f"unreachable: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    except ValueError as e:
+        print(f"bad /agentz payload: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    if not payload.get("enabled"):
+        _emit(payload, args.json,
+              "actuation agent disabled on this target (per-call "
+              "actuation, no cached ns fds)")
+        return 0
+    counters = payload.get("counters", {})
+    fallbacks = int(counters.get("fallbacks", 0))
+    stale = int(counters.get("revalidations_stale", 0))
+    lines = [
+        f"actuation agent: mode={payload.get('mode')} "
+        f"executor={'alive' if payload.get('executor_alive') else 'DOWN'}, "
+        f"{counters.get('batches', 0)} batch(es), "
+        f"{counters.get('revalidations_ok', 0)} revalidation(s) ok / "
+        f"{stale} stale, {fallbacks} fallback(s)"]
+    for handle in payload.get("ns_fds", []):
+        lines.append(f"  ns fd pid {handle.get('pid')}: "
+                     f"age {handle.get('age_s')}s, "
+                     f"{handle.get('uses')} use(s) "
+                     f"({handle.get('anchor')})")
+    if not payload.get("ns_fds"):
+        lines.append("  (no cached ns handles — no container actuated "
+                     "since boot)")
+    rc = 0
+    if fallbacks:
+        lines.append(f"  WARNING: {fallbacks} fallback(s) — the resident "
+                     "path is degrading; check worker logs for the "
+                     "fault reason")
+        rc = EXIT_OTHER
+    _emit(payload, args.json, "\n".join(lines))
+    return rc
+
+
 def cmd_health(args) -> int:
     try:
         status, payload = _request(args.master, "GET", "/healthz",
@@ -742,6 +789,37 @@ def cmd_doctor(args) -> int:
                   f"lease(s) auto-detached, {int(preemptions)} "
                   f"preemption(s) — {scope}")
 
+    # Resident actuation agent: fallback RATE is the health signal — a
+    # windowed non-zero delta means attaches are degrading to the
+    # fallback actuator RIGHT NOW (stale ns fds beyond repair, executor
+    # faults) and pages WARN; lifetime totals only inform, like every
+    # other counter. Stale revalidations alone are normal operation
+    # (container restarts), reported at ok level.
+    if metrics:
+        src = metrics_delta if metrics_delta is not None else metrics
+        scope = (f"in the last {window:g}s" if metrics_delta is not None
+                 else "lifetime")
+        agent_batches = _counter_total(
+            metrics, "tpumounter_actuation_agent_batches_total")
+        if agent_batches:
+            fallbacks = _counter_total(
+                src, "tpumounter_actuation_agent_fallbacks_total")
+            stale = _counter_total(
+                src, "tpumounter_actuation_agent_revalidations_total",
+                outcome="stale")
+            if fallbacks > 0:
+                check("warn",
+                      f"actuation agent fallbacks: {int(fallbacks)} — "
+                      f"{scope} — the fork-free warm path is degrading; "
+                      "inspect /agentz")
+            else:
+                check("ok",
+                      f"actuation agent healthy: "
+                      f"{int(agent_batches)} batch(es) lifetime, "
+                      f"0 fallbacks {scope}"
+                      + (f", {int(stale)} stale-fd revalidation(s)"
+                         if stale else ""))
+
     # Attach-journal backlog: worker-local /journalz (present when doctor
     # is pointed at a worker's :1201; the master answers 404 → skipped).
     # Backlog on a LIVE worker means a replay was deferred (e.g. devices
@@ -935,6 +1013,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="shared-informer cache health from a worker's health port "
              "(staleness, watch restarts, hit ratio)")
     p.set_defaults(fn=cmd_cachez)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser(
+        "agentz",
+        help="resident actuation agent health from a worker's health "
+             "port (cached ns fds, revalidations, fallbacks)")
+    p.set_defaults(fn=cmd_agentz)
     _add_common(p, suppress=True)
 
     p = sub.add_parser(

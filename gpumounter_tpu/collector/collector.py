@@ -28,6 +28,7 @@ import threading
 from gpumounter_tpu.collector.podresources import PodResourcesClient
 from gpumounter_tpu.device.enumerator import Enumerator
 from gpumounter_tpu.device.model import DeviceState, TPUChip
+from gpumounter_tpu.device.plan import NodePlanCache
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
@@ -46,6 +47,12 @@ class TPUCollector:
         self.pool_namespace = pool_namespace
         self._lock = threading.RLock()
         self._chips: dict[str, TPUChip] = {}       # uuid -> chip
+        # Precomputed actuation plans (device/plan.py), rebuilt whenever
+        # the enumerated inventory actually changes (hot-plug) — the
+        # mounter holds this object, so attach/detach actuation reads
+        # frozen per-chip plans instead of re-deriving node lists.
+        self.plans = NodePlanCache()
+        self._plan_sig: tuple = ()
         self.update_status()
         logger.info("collector initialised with %d chips", len(self._chips))
 
@@ -78,6 +85,17 @@ class TPUCollector:
             # re-derived from the kubelet listing every refresh
             prev = self._chips
             self._chips = {c.uuid: c for c in self.enumerator.enumerate()}
+            # full identity incl. each companion's path+majmin: a re-plug
+            # that renumbers a companion with an unchanged count must
+            # still invalidate the plans
+            sig = tuple(sorted(
+                (c.uuid, c.major, c.minor,
+                 tuple((x.host_path, x.major, x.minor)
+                       for x in c.companions))
+                for c in self._chips.values()))
+            if sig != self._plan_sig:
+                self.plans.rebuild(list(self._chips.values()))
+                self._plan_sig = sig
             # topology stamps (set by the allocator from node labels) are
             # static per node — carry them across refreshes so they aren't
             # lost when the inventory is rebuilt

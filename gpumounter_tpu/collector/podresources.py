@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import abc
 import os
+import threading
 import time
 
 import grpc
@@ -94,63 +95,103 @@ class KubeletPodResourcesClient(PodResourcesClient):
         self.timeout_s = timeout_s
         self.api_version: str | None = None     # probed on first List
         self._alloc_cache: dict[str, tuple[float, set[str] | None]] = {}
+        # ONE long-lived channel to the node-local socket: the kubelet
+        # LIST runs on the attach hot path (kubelet.resolve span), and a
+        # fresh dial + HTTP/2 handshake per snapshot was the largest
+        # single cost in it (ISSUE 6: the per-op crossing tax again, this
+        # time on the kubelet hop). Dropped + re-dialed on any transport
+        # failure, so a restarted kubelet costs one extra round trip, not
+        # a stale-channel hang.
+        # (channel, {method: multicallable}) as ONE unit under the lock:
+        # stubs are bound to the channel they were created from, so a
+        # concurrent _drop_channel (the attach path races the pool
+        # thread's warm_hook refresh) can never leave a stub pointing at
+        # a closed channel in the cache.
+        self._cached: tuple[grpc.Channel, dict] | None = None
+        self._channel_lock = threading.Lock()
 
-    def _call(self, channel: grpc.Channel, method: str, request,
-              response_type):
-        call = channel.unary_unary(
-            method,
-            request_serializer=request.SerializeToString,
-            response_deserializer=response_type.FromString,
-        )
+    def _call(self, channel_stubs: tuple[grpc.Channel, dict], method: str,
+              request, response_type):
+        channel, stubs = channel_stubs
+        call = stubs.get(method)
+        if call is None:
+            call = stubs[method] = channel.unary_unary(
+                method,
+                request_serializer=request.SerializeToString,
+                response_deserializer=response_type.FromString,
+            )
         return call(request, timeout=self.timeout_s)
 
-    def _channel(self) -> grpc.Channel:
+    def _channel(self) -> tuple[grpc.Channel, dict]:
+        """The cached (channel, stubs) pair — ONE long-lived dial to the
+        node-local socket (a fresh dial + HTTP/2 handshake per snapshot
+        was the largest single cost in ``kubelet.resolve``)."""
         # ref collector.go:92: stat before dialing for a crisp error
         if not os.path.exists(self.socket_path):
             raise KubeletUnavailableError(
                 f"kubelet PodResources socket missing: {self.socket_path}")
-        return grpc.insecure_channel(f"unix://{self.socket_path}")
+        with self._channel_lock:
+            if self._cached is None:
+                self._cached = (grpc.insecure_channel(
+                    f"unix://{self.socket_path}"), {})
+            return self._cached
+
+    def _drop_channel(self) -> None:
+        """Forget the cached channel after a transport failure: the next
+        call re-dials (the kubelet may have restarted on a new socket
+        incarnation). In-flight calls that still hold the old pair keep
+        their own consistent channel+stubs view."""
+        with self._channel_lock:
+            cached, self._cached = self._cached, None
+        if cached is not None:
+            try:
+                cached[0].close()
+            except Exception:       # noqa: BLE001 — teardown best-effort
+                pass
 
     def _list_pods_once(self) -> pb.ListPodResourcesResponse:
-        channel = self._channel()
-        try:
-            if self.api_version in (None, "v1"):
-                try:
-                    resp = self._call(channel, _LIST_METHOD_V1,
-                                      pb_v1.ListPodResourcesRequest(),
-                                      pb_v1.ListPodResourcesResponse)
-                    if self.api_version is None:
-                        logger.info("kubelet PodResources API: v1")
-                        self.api_version = "v1"
-                    return resp
-                except grpc.RpcError as e:
-                    if (self.api_version is None
-                            and e.code() in _PERMANENT_FALLBACK_CODES):
-                        logger.info(
-                            "kubelet has no v1 PodResources (%s); falling "
-                            "back to v1alpha1", e.code())
-                        self.api_version = "v1alpha1"
-                    elif (self.api_version is None
-                            and e.code() in _TRANSIENT_FALLBACK_CODES):
-                        # try v1alpha1 for this call, but leave the version
-                        # unpinned so the next List re-probes v1
-                        logger.info(
-                            "v1 PodResources List returned %s; trying "
-                            "v1alpha1 without pinning", e.code())
-                    else:
-                        raise KubeletUnavailableError(
-                            f"PodResources List failed: {e.code()}: "
-                            f"{e.details()}") from e
+        # the channel+stub pair is cached across calls; _drop_channel
+        # owns teardown
+        conn = self._channel()
+        if self.api_version in (None, "v1"):
             try:
-                return self._call(channel, _LIST_METHOD_V1ALPHA1,
-                                  pb.ListPodResourcesRequest(),
-                                  pb.ListPodResourcesResponse)
+                resp = self._call(conn, _LIST_METHOD_V1,
+                                  pb_v1.ListPodResourcesRequest(),
+                                  pb_v1.ListPodResourcesResponse)
+                if self.api_version is None:
+                    logger.info("kubelet PodResources API: v1")
+                    self.api_version = "v1"
+                return resp
             except grpc.RpcError as e:
-                raise KubeletUnavailableError(
-                    f"PodResources List failed: {e.code()}: "
-                    f"{e.details()}") from e
-        finally:
-            channel.close()
+                if (self.api_version is None
+                        and e.code() in _PERMANENT_FALLBACK_CODES):
+                    logger.info(
+                        "kubelet has no v1 PodResources (%s); falling "
+                        "back to v1alpha1", e.code())
+                    self.api_version = "v1alpha1"
+                elif (self.api_version is None
+                        and e.code() in _TRANSIENT_FALLBACK_CODES):
+                    # try v1alpha1 for this call, but leave the version
+                    # unpinned so the next List re-probes v1
+                    logger.info(
+                        "v1 PodResources List returned %s; trying "
+                        "v1alpha1 without pinning", e.code())
+                else:
+                    # transport-level failure: drop the cached channel
+                    # so the retry (and every later call) re-dials
+                    self._drop_channel()
+                    raise KubeletUnavailableError(
+                        f"PodResources List failed: {e.code()}: "
+                        f"{e.details()}") from e
+        try:
+            return self._call(conn, _LIST_METHOD_V1ALPHA1,
+                              pb.ListPodResourcesRequest(),
+                              pb.ListPodResourcesResponse)
+        except grpc.RpcError as e:
+            self._drop_channel()
+            raise KubeletUnavailableError(
+                f"PodResources List failed: {e.code()}: "
+                f"{e.details()}") from e
 
     def allocatable_tpu_ids(self, resource_name: str) -> set[str] | None:
         if self.api_version is None:
@@ -177,9 +218,9 @@ class KubeletPodResourcesClient(PodResourcesClient):
         return ids
 
     def _allocatable_once(self, resource_name: str, now: float):
-        channel = self._channel()
+        conn = self._channel()
         try:
-            return self._call(channel, _ALLOCATABLE_METHOD_V1,
+            return self._call(conn, _ALLOCATABLE_METHOD_V1,
                               pb_v1.AllocatableResourcesRequest(),
                               pb_v1.AllocatableResourcesResponse)
         except grpc.RpcError as e:
@@ -189,11 +230,10 @@ class KubeletPodResourcesClient(PodResourcesClient):
                 self._alloc_cache[resource_name] = (
                     now + self.ALLOCATABLE_TTL_S, None)
                 return None
+            self._drop_channel()
             raise KubeletUnavailableError(
                 f"GetAllocatableResources failed: {e.code()}: "
                 f"{e.details()}") from e
-        finally:
-            channel.close()
 
 
 class FakePodResourcesClient(PodResourcesClient):

@@ -29,11 +29,20 @@ chip's device nodes (SURVEY.md §7 "Busy detection without NVML").
 from __future__ import annotations
 
 import abc
+import dataclasses
 import os
 import re
 import stat as stat_mod
 
 from gpumounter_tpu.device.model import CompanionNode, TPUChip
+
+
+def _pristine_copy(chip: TPUChip) -> TPUChip:
+    """A fresh-scan-equivalent copy of a cached chip (allocation state and
+    topology stamps reset — they are per-snapshot, not per-device)."""
+    from gpumounter_tpu.device.model import DeviceState
+    return dataclasses.replace(chip, state=DeviceState.FREE, pod_name="",
+                               namespace="", accelerator="", topology="")
 from gpumounter_tpu.utils.config import HostPaths
 from gpumounter_tpu.utils.log import get_logger
 
@@ -141,16 +150,53 @@ class PyEnumerator(Enumerator):
     (``"<major>:<minor>"``) or defaulting to 0:index.
     """
 
-    def __init__(self, host: HostPaths | None = None, allow_fake: bool = False):
+    def __init__(self, host: HostPaths | None = None, allow_fake: bool = False,
+                 cache_ttl_s: float = 0.0):
         self.host = host or HostPaths()
         self.allow_fake = allow_fake
+        # Inventory-scan cache (the resident-agent plan-cache companion,
+        # ISSUE 6): chips change only on hot-plug, which bumps the /dev
+        # directory mtime, so within the TTL an unchanged mtime serves the
+        # cached scan — 2 stats instead of O(nodes) stats+opens per
+        # update_status. 0 (the default) rescans every call, preserving
+        # the historical behavior for fixture-mutating tests.
+        self.cache_ttl_s = cache_ttl_s
+        self._cache: list[TPUChip] | None = None
+        self._cache_at = 0.0
+        self._cache_sig: tuple = ()
 
     # -- enumeration -----------------------------------------------------------
 
+    def _dir_signature(self) -> tuple:
+        """mtime identity of the scan roots; any node add/remove bumps
+        the owning directory's mtime."""
+        sig = []
+        for path in (self.host.dev_root,
+                     os.path.join(self.host.dev_root, "vfio")):
+            try:
+                st = os.stat(path)
+                sig.append((st.st_mtime_ns, st.st_ino))
+            except OSError:
+                sig.append(None)
+        return tuple(sig)
+
     def enumerate(self) -> list[TPUChip]:
+        import time
+        if self.cache_ttl_s > 0 and self._cache is not None:
+            if (time.monotonic() - self._cache_at < self.cache_ttl_s
+                    and self._dir_signature() == self._cache_sig):
+                return [_pristine_copy(c) for c in self._cache]
         chips = self._scan_accel()
         if not chips:
             chips = self._scan_vfio()
+        if self.cache_ttl_s > 0:
+            self._cache = chips
+            self._cache_at = time.monotonic()
+            self._cache_sig = self._dir_signature()
+            # callers (the collector) MUTATE returned chips (allocation
+            # state, topology stamps): hand out copies, keep the cache
+            # pristine
+            return [_pristine_copy(c) for c in chips]
         return chips
 
     def _make_chip(self, path: str, index: int,

@@ -134,10 +134,13 @@ class NativeEnumerator(Enumerator):
 
 
 def best_enumerator(host: HostPaths | None = None,
-                    allow_fake: bool = False) -> Enumerator:
-    """Native if built, Python otherwise — identical observable behavior."""
+                    allow_fake: bool = False,
+                    cache_ttl_s: float = 0.0) -> Enumerator:
+    """Native if built, Python otherwise — identical observable behavior.
+    ``cache_ttl_s`` enables the Python scanner's inventory cache (the
+    native scan is already one syscall-cheap library call)."""
     try:
         return NativeEnumerator(host, allow_fake)
     except OSError:
         logger.info("native tpuprobe unavailable; using PyEnumerator")
-        return PyEnumerator(host, allow_fake)
+        return PyEnumerator(host, allow_fake, cache_ttl_s=cache_ttl_s)

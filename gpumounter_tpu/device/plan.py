@@ -1,0 +1,139 @@
+"""Precomputed per-node actuation plans.
+
+Every attach used to recompute the same facts about the same chips: which
+container paths to mknod, which (major, minor) pairs the cgroup rules
+need, which companion nodes are shared between chips and must be deduped.
+The chips on a node change only on hot-plug — the answers are static per
+enumeration — so this module freezes them at enumeration/pool-warm time
+into an immutable per-chip plan, and ``attach_resolve``/``detach_resolve``
+become dictionary lookups instead of re-deriving the inventory per
+request (the GPUOS "precompute the crossing's arguments" half of the
+resident-agent design; see actuation/agent.py for the crossing itself).
+
+Built by the collector on every (re-)enumeration; consumers hold the
+cache object and always see the freshest build — each build is a new
+immutable mapping, so readers never observe a half-updated plan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+from gpumounter_tpu.device.model import TPUChip
+from gpumounter_tpu.utils import consts
+
+# One device-node operation: (container_path, major, minor) — the same
+# shape actuation/nsenter.py batches.
+PlanOp = tuple[str, int, int]
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipPlan:
+    """Everything actuation needs to know about one chip, precomputed:
+    node creates (chip + companions), node paths for removal, the deduped
+    (major, minor) set, and the rendered cgroup-v1 rule strings."""
+
+    uuid: str
+    creates: tuple[PlanOp, ...]
+    removes: tuple[str, ...]
+    majmins: tuple[tuple[int, int], ...]
+    v1_rules: tuple[str, ...]
+    companion_host_paths: tuple[str, ...]
+
+    @classmethod
+    def for_chip(cls, chip: TPUChip) -> "ChipPlan":
+        creates: list[PlanOp] = [(chip.container_path, chip.major,
+                                  chip.minor)]
+        majmins: list[tuple[int, int]] = [(chip.major, chip.minor)]
+        companions: list[str] = []
+        for companion in chip.companions:
+            creates.append((companion.container_path, companion.major,
+                            companion.minor))
+            if (companion.major, companion.minor) not in majmins:
+                majmins.append((companion.major, companion.minor))
+            companions.append(companion.host_path)
+        return cls(
+            uuid=chip.uuid,
+            creates=tuple(creates),
+            removes=tuple(op[0] for op in creates),
+            majmins=tuple(majmins),
+            v1_rules=tuple(
+                f"c {major}:{minor} {consts.DEVICE_CGROUP_PERMISSIONS}"
+                for major, minor in majmins),
+            companion_host_paths=tuple(companions),
+        )
+
+
+class NodePlanCache:
+    """uuid -> :class:`ChipPlan` for the node's current inventory.
+
+    ``rebuild`` swaps in a whole new immutable mapping (readers racing a
+    hot-plug rebuild see either the old or the new inventory, never a
+    mix). Lookups for unknown uuids return None — callers compute from
+    the chip object, so a cache that lags an enumeration can only cost
+    microseconds, not correctness."""
+
+    def __init__(self):
+        self._plans: dict[str, ChipPlan] = {}
+        self._lock = threading.Lock()
+        self.builds = 0
+
+    def rebuild(self, chips: list[TPUChip]) -> None:
+        plans = {chip.uuid: ChipPlan.for_chip(chip) for chip in chips}
+        with self._lock:
+            self._plans = plans
+            self.builds += 1
+
+    def plan_for(self, chip: TPUChip) -> ChipPlan:
+        plan = self._plans.get(chip.uuid)        # immutable dict: no lock
+        if plan is None or plan.creates[0][1:] != (chip.major, chip.minor):
+            # cache lagging an enumeration (or majmin changed on
+            # re-plug): compute directly, correctness over cache purity
+            return ChipPlan.for_chip(chip)
+        return plan
+
+    def __len__(self) -> int:
+        return len(self._plans)
+
+
+def batch_creates(plans: list[ChipPlan]) -> list[PlanOp]:
+    """Fused create list for one container: every chip's nodes, shared
+    companions (e.g. /dev/vfio/vfio) deduped to exactly one op."""
+    seen: set[PlanOp] = set()
+    out: list[PlanOp] = []
+    for plan in plans:
+        for op in plan.creates:
+            if op not in seen:
+                seen.add(op)
+                out.append(op)
+    return out
+
+
+def batch_removes(plans: list[ChipPlan],
+                  remaining: list[ChipPlan]) -> list[str]:
+    """Fused unlink list: the detached chips' nodes minus any node a
+    remaining chip still needs (shared companions ride with the last
+    chip out, not the first)."""
+    keep = {op[0] for plan in remaining for op in plan.creates}
+    seen: set[str] = set()
+    out: list[str] = []
+    for plan in plans:
+        for path in plan.removes:
+            if path not in keep and path not in seen:
+                seen.add(path)
+                out.append(path)
+    return out
+
+
+def batch_majmins(plans: list[ChipPlan]) -> list[tuple[int, int]]:
+    """Deduped (major, minor) pairs across the batch, order-preserving —
+    the cgroup-permissioning argument list."""
+    seen: set[tuple[int, int]] = set()
+    out: list[tuple[int, int]] = []
+    for plan in plans:
+        for majmin in plan.majmins:
+            if majmin not in seen:
+                seen.add(majmin)
+                out.append(majmin)
+    return out

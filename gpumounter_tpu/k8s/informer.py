@@ -349,6 +349,13 @@ class PodCacheReads:
                 return informer
         return None
 
+    def covers(self, namespace: str,
+               label_selector: str | None = None) -> bool:
+        """Whether a ready informer currently serves this scope's reads
+        from cache (callers that are only worth short-circuiting when the
+        read is local — e.g. the detach resolution cache — check this)."""
+        return self._covering(namespace, label_selector) is not None
+
     def _hit(self, verb: str) -> None:
         from gpumounter_tpu.utils.metrics import REGISTRY
         REGISTRY.cache_hits.inc(verb=verb)

@@ -26,6 +26,7 @@ known route hit with the wrong HTTP method answers 405 + Allow.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 import time
@@ -169,10 +170,16 @@ class MasterGateway:
         # test stacks with ephemeral ports inject their own resolver.
         self.worker_tracez_base = (worker_tracez_base
                                    or self._default_tracez_base)
-        # Per-target client cache: gRPC channels are long-lived by design;
-        # re-dialing per request would put TCP+HTTP/2 setup on the
-        # latency-benchmarked hot path.
-        self._clients: dict[str, WorkerClient] = {}
+        # Per-target client POOL: gRPC channels are long-lived by design
+        # (re-dialing per request would put TCP+HTTP/2 setup on the
+        # latency-benchmarked hot path), and a single channel serialises
+        # its HTTP/2 flow control under hundreds of concurrent RPCs — a
+        # small round-robined pool per worker keeps the multiplexed front
+        # from funnelling every in-flight attach through one stream head.
+        self.channels_per_worker = max(1, int(os.environ.get(
+            consts.ENV_GATEWAY_WORKER_CHANNELS, "4")))
+        self._clients: dict[str, list[WorkerClient]] = {}
+        self._clients_rr: dict[str, int] = {}
         self._clients_lock = threading.Lock()
         # Per-worker circuit breakers: a dead node fails fast (429 +
         # Retry-After) instead of eating a gateway thread per request for
@@ -197,11 +204,15 @@ class MasterGateway:
 
     def _client(self, target: str) -> WorkerClient:
         with self._clients_lock:
-            client = self._clients.get(target)
-            if client is None:
-                client = self._worker_client_factory(target)
-                self._clients[target] = client
-            return client
+            pool = self._clients.get(target)
+            if pool is None:
+                pool = self._clients[target] = [
+                    self._worker_client_factory(target)
+                    for _ in range(self.channels_per_worker)]
+                self._clients_rr[target] = 0
+            index = self._clients_rr[target]
+            self._clients_rr[target] = (index + 1) % len(pool)
+            return pool[index]
 
     def _breaker(self, target: str) -> CircuitBreaker:
         with self._breakers_lock:
@@ -215,8 +226,9 @@ class MasterGateway:
 
     def _drop_client(self, target: str) -> None:
         with self._clients_lock:
-            client = self._clients.pop(target, None)
-        if client is not None:
+            pool = self._clients.pop(target, None) or []
+            self._clients_rr.pop(target, None)
+        for client in pool:
             try:
                 client.close()
             except (grpc.RpcError, ValueError, OSError) as e:
@@ -838,7 +850,15 @@ class MasterGateway:
     # -- HTTP server -----------------------------------------------------------
 
     def serve(self, port: int = consts.MASTER_HTTP_PORT,
-              address: str = "0.0.0.0") -> ThreadingHTTPServer:
+              address: str = "0.0.0.0", front: str | None = None,
+              workers: int | None = None, max_conns: int | None = None):
+        """Start the HTTP front. Default is the bounded multiplexed front
+        (master/httpfront.py): HTTP/1.1 keep-alive, a selector loop
+        owning idle connections, N worker threads multiplexing M >> N
+        connections, and connection admission BEFORE thread allocation —
+        the configuration the sustained-RPS bench pins at >= 500
+        concurrent in-flight attach RPCs. ``TPU_GATEWAY_FRONT=threaded``
+        reverts to the legacy thread-per-request ThreadingHTTPServer."""
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -877,13 +897,26 @@ class MasterGateway:
 
             do_GET = do_POST = _respond
 
-        server = ThreadingHTTPServer((address, port), Handler)
-        threading.Thread(target=server.serve_forever, daemon=True).start()
+        front = front or os.environ.get(consts.ENV_GATEWAY_FRONT,
+                                        "multiplexed")
+        if front == "threaded":
+            server = ThreadingHTTPServer((address, port), Handler)
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
+        else:
+            from gpumounter_tpu.master.httpfront import \
+                MultiplexedHTTPServer
+            server = MultiplexedHTTPServer(
+                address, port, Handler,
+                workers=workers or int(os.environ.get(
+                    consts.ENV_GATEWAY_WORKERS, "0")) or None,
+                max_conns=max_conns or int(os.environ.get(
+                    consts.ENV_GATEWAY_MAX_CONNS, "1024")))
         # A serving master runs the broker's maintenance loop (lease
         # expiry, gauge refresh); unit tests drive broker.tick() directly.
         self.broker.start()
-        logger.info("master gateway serving on %s:%d", address,
-                    server.server_port)
+        logger.info("master gateway serving on %s:%d (%s front)", address,
+                    server.server_port, front)
         return server
 
 

@@ -263,6 +263,27 @@ def wait_events_drained(service, timeout_s: float = 5.0) -> None:
         time.sleep(0.03)
 
 
+def _fixture_device_nodes(rig) -> set[str]:
+    """Container-side device-node paths present under every provisioned
+    container root of the fixture tree (procroot/agent rigs write real
+    files there; ``.majmin`` sidecars are the fixture format's metadata,
+    not nodes)."""
+    import os
+    nodes: set[str] = set()
+    proc_root = rig.host.proc_root
+    for pid in os.listdir(proc_root):
+        root = os.path.join(proc_root, pid, "root")
+        if not os.path.isdir(root):
+            continue
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for name in filenames:
+                if name.endswith(".majmin"):
+                    continue
+                full = os.path.join(dirpath, name)
+                nodes.add("/" + os.path.relpath(full, root))
+    return nodes
+
+
 def assert_broker_invariants(broker, sim) -> None:
     """The broker-layer contract after any contention / lease-race /
     preemption / master-restart plan (rides on top of
@@ -346,11 +367,17 @@ def assert_invariants(rig, expected_uuids: set[str],
         f"slave-pod reservations {sorted(held)} != expected " \
         f"{sorted(expected_uuids)} (leak or lost grant)"
 
-    # 2. device nodes actually present in the owner's container
+    # 2. device nodes actually present in the owner's container. A
+    # recording rig is asked directly; a procroot (or agent-over-procroot)
+    # rig is audited from the fixture tree itself — the files under
+    # <proc>/<pid>/root are the ground truth the agent/fallback wrote.
     chips_by_uuid = {c.uuid: c for c in sim.enumerator.chips}
     expected_paths = {chips_by_uuid[u].container_path
                       for u in expected_uuids}
-    created_paths = {path for _, path, _, _ in rig.actuator.created}
+    if hasattr(rig.actuator, "created"):
+        created_paths = {path for _, path, _, _ in rig.actuator.created}
+    else:
+        created_paths = _fixture_device_nodes(rig)
     assert created_paths == expected_paths, \
         f"device nodes {sorted(created_paths)} != expected " \
         f"{sorted(expected_paths)} (partial grant)"

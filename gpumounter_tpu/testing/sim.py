@@ -214,7 +214,7 @@ class WorkerRig:
                  use_kubelet_socket=False, node="node-a",
                  pod_name="workload", schedule_delay_s=0.0,
                  kubelet_lag_s=0.0, warm_pool: dict[str, int] | None = None,
-                 informer: bool = False):
+                 informer: bool = False, agent: bool = False):
         from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
         from gpumounter_tpu.actuation.mount import TPUMounter
         from gpumounter_tpu.actuation.nsenter import (ProcRootActuator,
@@ -254,8 +254,21 @@ class WorkerRig:
                                      "dev"), exist_ok=True)
         else:
             raise ValueError(f"unknown actuator kind {actuator!r}")
+        # Resident actuation agent (``agent=True``): the production
+        # default wiring (worker/main.py) — cached ns handles + in-
+        # process batch execution, with the rig's base actuator as the
+        # fallback seam. Off by default so unit rigs keep patching the
+        # single-op methods directly.
+        self.agent = None
+        if agent:
+            from gpumounter_tpu.actuation.agent import (AgentActuator,
+                                                        ResidentActuationAgent)
+            self.agent = ResidentActuationAgent(
+                fake_host, fake_nodes=(actuator == "procroot"))
+            self.actuator = AgentActuator(self.agent, self.actuator)
         self.mounter = TPUMounter(self.cgroups, self.actuator,
-                                  self.sim.enumerator, fake_host)
+                                  self.sim.enumerator, fake_host,
+                                  plans=self.sim.collector.plans)
         # Shared pod informer (``informer=True``): ONE list+watch over the
         # pool namespace serves every hot-path read — the production
         # default wiring (worker/main.py). Off by default so unit rigs
@@ -331,6 +344,8 @@ class WorkerRig:
             time.sleep(0.05)
 
     def close(self) -> None:
+        if self.agent is not None:
+            self.agent.stop()
         if self.informer is not None:
             self.informer.stop()
         self.sim.close()
@@ -341,7 +356,7 @@ class LiveStack:
     ``base`` is the master's URL; close() tears everything down."""
 
     def __init__(self, rig: WorkerRig, broker_config=None,
-                 shared_kube: bool = False):
+                 shared_kube: bool = False, grpc_workers: int = 8):
         from gpumounter_tpu.master.admission import AttachBroker
         from gpumounter_tpu.master.discovery import WorkerDirectory
         from gpumounter_tpu.master.gateway import MasterGateway
@@ -350,7 +365,8 @@ class LiveStack:
 
         self.rig = rig
         self.grpc_server, grpc_port = build_server(rig.service, port=0,
-                                                   address="127.0.0.1")
+                                                   address="127.0.0.1",
+                                                   max_workers=grpc_workers)
         self.grpc_port = grpc_port
         self.grpc_server.start()
         # the worker's real health/metrics/tracez sidecar port, on an
@@ -362,6 +378,7 @@ class LiveStack:
         from gpumounter_tpu.worker.main import _HealthHandler
         _HealthHandler.journal = rig.service.journal
         _HealthHandler.cache = rig.service.reads
+        _HealthHandler.agent = rig.agent
         self.health_server = start_health_server(0)
         health_port = self.health_server.server_port
         # ``shared_kube=True``: the master reads the SAME fake cluster the
@@ -389,6 +406,7 @@ class LiveStack:
         from gpumounter_tpu.worker.main import _HealthHandler
         _HealthHandler.journal = None
         _HealthHandler.cache = None
+        _HealthHandler.agent = None
         self.gateway.broker.stop()
         self.http_server.shutdown()
         self.health_server.shutdown()

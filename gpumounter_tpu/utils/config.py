@@ -133,6 +133,18 @@ class Settings:
     lease_ttl_s: float = 0.0
     queue_timeout_s: float = 0.0
     queue_depth: int = 64
+    # Resident actuation agent (actuation/agent.py): cached namespace fds
+    # + in-process batch execution on the attach/detach hot path, with
+    # transparent fallback on any agent fault. Default ON in production;
+    # TPU_AGENT=0 reverts to direct per-call actuation.
+    agent_enabled: bool = True
+    # PyEnumerator inventory-scan cache TTL (0 = rescan every enumerate).
+    # from_env defaults it on; plain Settings() keeps the historical
+    # rescan-always behavior for fixture-mutating unit rigs.
+    enum_cache_ttl_s: float = 0.0
+    # How long a detach may be resolved from the attachment record cached
+    # at attach time (validated against the informer's slave-pod view).
+    attach_cache_ttl_s: float = consts.DEFAULT_ATTACH_CACHE_TTL_S
     host: HostPaths = dataclasses.field(default_factory=HostPaths)
 
     @classmethod
@@ -174,6 +186,13 @@ class Settings:
         if t := env.get(consts.ENV_QUEUE_DEPTH):
             s.queue_depth = int(t)
         s.informer_enabled = env.get(consts.ENV_INFORMER, "1") != "0"
+        s.agent_enabled = env.get(consts.ENV_AGENT, "1") != "0"
+        if t := env.get(consts.ENV_ENUM_CACHE_TTL_S):
+            s.enum_cache_ttl_s = float(t)
+        else:
+            s.enum_cache_ttl_s = consts.DEFAULT_ENUM_CACHE_TTL_S
+        if t := env.get(consts.ENV_ATTACH_CACHE_TTL_S):
+            s.attach_cache_ttl_s = float(t)
         if t := env.get(consts.ENV_INFORMER_FENCE_TIMEOUT_S):
             s.informer_fence_timeout_s = float(t)
         if p := env.get("TPU_WORKER_GRPC_PORT"):

@@ -118,6 +118,37 @@ ENV_QUEUE_TIMEOUT_S = "TPU_QUEUE_TIMEOUT_S"
 # Bound of each per-priority FIFO; a full queue answers 429 + Retry-After.
 ENV_QUEUE_DEPTH = "TPU_QUEUE_DEPTH"
 
+# --- Resident actuation agent (actuation/agent.py) ----------------------------
+# "1" (default): device-node actuation runs through the persistent
+# per-node agent — cached namespace fds, setns/proc-root entry in a
+# resident thread, zero fork/exec on the warm path, transparent fallback
+# to the wrapped actuator on any agent fault. "0" reverts to direct
+# per-call actuation (the pre-agent behavior).
+ENV_AGENT = "TPU_AGENT"
+# PyEnumerator inventory cache TTL, seconds: within the TTL (and with an
+# unchanged /dev directory mtime) enumeration is served from the cached
+# scan instead of re-stat'ing every node. 0 disables (every enumerate
+# re-scans — the historical behavior kept for fixture-mutating tests).
+ENV_ENUM_CACHE_TTL_S = "TPU_ENUM_CACHE_TTL_S"
+DEFAULT_ENUM_CACHE_TTL_S = 5.0
+# How long the worker serves a detach's resolution from the attachment
+# record cached at attach time (validated against the informer's view of
+# the slave pods) before falling back to a full kubelet re-resolution.
+ENV_ATTACH_CACHE_TTL_S = "TPU_ATTACH_CACHE_TTL_S"
+DEFAULT_ATTACH_CACHE_TTL_S = 600.0
+
+# --- Master gateway front (master/httpfront.py) --------------------------------
+# "multiplexed" (default): bounded selector + worker-pool front with
+# HTTP/1.1 keep-alive and connection admission before thread allocation.
+# "threaded": the legacy thread-per-request ThreadingHTTPServer.
+ENV_GATEWAY_FRONT = "TPU_GATEWAY_FRONT"
+# Worker threads of the multiplexed front (0/unset = min(32, 4*cores)).
+ENV_GATEWAY_WORKERS = "TPU_GATEWAY_WORKERS"
+# Connection admission bound; beyond it new connections get a canned 503.
+ENV_GATEWAY_MAX_CONNS = "TPU_GATEWAY_MAX_CONNS"
+# gRPC channels kept per worker target (round-robined per call).
+ENV_GATEWAY_WORKER_CHANNELS = "TPU_GATEWAY_WORKER_CHANNELS"
+
 # Request headers naming the tenant/priority (query params ?tenant= /
 # ?priority= take precedence; both fall back to namespace / "normal").
 TENANT_HEADER = "X-Tpu-Tenant"

@@ -365,6 +365,46 @@ class Registry:
             "tpumounter_actuation_batch_size",
             "Size of the most recent device-node actuation batch, by op "
             "(create/remove)")
+        # Resident actuation agent (actuation/agent.py): the per-node
+        # executor that replaced per-attach fork/exec. batches = plans
+        # executed through the resident crossing, by op; fallbacks = agent
+        # faults degraded to the wrapped actuator, by reason (a non-zero
+        # RATE means the agent is unhealthy — doctor WARNs on it);
+        # revalidations = cached ns-handle identity checks by outcome
+        # (stale = container restarted between warm and use).
+        self.agent_batches = Counter(
+            "tpumounter_actuation_agent_batches_total",
+            "Device-node plans executed by the resident actuation agent, "
+            "by op (create/remove)")
+        self.agent_batch_ops = Counter(
+            "tpumounter_actuation_agent_ops_total",
+            "Individual device-node operations executed by the resident "
+            "actuation agent")
+        self.agent_fallbacks = Counter(
+            "tpumounter_actuation_agent_fallbacks_total",
+            "Agent faults degraded to the fallback actuator, by reason")
+        self.agent_fallbacks.inc(0.0, reason="stale_ns_fd")  # pre-seed
+        self.agent_revalidations = Counter(
+            "tpumounter_actuation_agent_revalidations_total",
+            "Cached namespace-handle identity checks, by outcome "
+            "(ok/stale)")
+        self.agent_ns_fds = Gauge(
+            "tpumounter_actuation_agent_ns_fds",
+            "Namespace handles currently cached by the resident "
+            "actuation agent")
+        # Multiplexed gateway front (master/httpfront.py): requests
+        # admitted (accepted + queued or processing) right now, and the
+        # connections the admission bound turned away. inflight is the
+        # saturation signal the sustained-RPS bench pins; rejections mean
+        # the bound is doing its job instead of thread-per-request OOM.
+        self.gateway_inflight = Gauge(
+            "tpumounter_gateway_inflight",
+            "HTTP requests currently admitted by the master gateway "
+            "front (queued or being processed)")
+        self.gateway_rejected = Counter(
+            "tpumounter_gateway_rejected_total",
+            "Connections refused by the gateway front's admission bound")
+        self.gateway_rejected.inc(0.0)   # pre-seed: see orphans_reclaimed
         # Attach broker (master/admission.py): every admission verdict by
         # tenant and outcome (granted / over_quota / queue_full /
         # queue_timeout) — the per-tenant denial rate is the first thing a

@@ -44,7 +44,7 @@ def _add_handler(service: TPUMountService):
     def handle(request: pb.AddTPURequest,
                context: grpc.ServicerContext) -> pb.AddTPUResponse:
         rid = _request_id(context)
-        logger.info("[rid=%s] AddTPU %s/%s n=%d entire=%s", rid,
+        logger.debug("[rid=%s] AddTPU %s/%s n=%d entire=%s", rid,
                     request.namespace, request.pod_name, request.tpu_num,
                     request.is_entire_mount)
         try:
@@ -61,7 +61,7 @@ def _add_handler(service: TPUMountService):
         resp = pb.AddTPUResponse(result=int(outcome.result))
         resp.device_ids.extend(c.uuid for c in outcome.chips)
         resp.device_paths.extend(c.container_path for c in outcome.chips)
-        logger.info("[rid=%s] AddTPU -> %s", rid, outcome.result.name)
+        logger.debug("[rid=%s] AddTPU -> %s", rid, outcome.result.name)
         return resp
     return handle
 
@@ -74,7 +74,7 @@ def _remove_handler(service: TPUMountService):
         # preemption / lease-expiry detaches say why, and the service
         # propagates it into the audit event + journal record.
         cause = _metadata_value(context, consts.DETACH_CAUSE_METADATA_KEY)
-        logger.info("[rid=%s] RemoveTPU %s/%s uuids=%s force=%s%s", rid,
+        logger.debug("[rid=%s] RemoveTPU %s/%s uuids=%s force=%s%s", rid,
                     request.namespace, request.pod_name,
                     list(request.uuids), request.force,
                     f" cause={cause}" if cause else "")
@@ -89,7 +89,7 @@ def _remove_handler(service: TPUMountService):
             context.abort(grpc.StatusCode.INTERNAL, str(e))
         resp = pb.RemoveTPUResponse(result=int(outcome.result))
         resp.busy_pids.extend(outcome.busy_pids)
-        logger.info("[rid=%s] RemoveTPU -> %s", rid, outcome.result.name)
+        logger.debug("[rid=%s] RemoveTPU -> %s", rid, outcome.result.name)
         return resp
     return handle
 

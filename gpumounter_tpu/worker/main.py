@@ -37,6 +37,7 @@ class _HealthHandler(BaseHTTPRequestHandler):
     pool = None        # PoolManager, set by main() when the pool is enabled
     journal = None     # AttachJournal, set by main() when journaling is on
     cache = None       # PodCacheReads, set by main() (informer handle)
+    agent = None       # ResidentActuationAgent, set when the agent is on
 
     def log_message(self, *args):
         pass
@@ -79,6 +80,16 @@ class _HealthHandler(BaseHTTPRequestHandler):
             import json
             cache = type(self).cache
             body = json.dumps(cache.status() if cache is not None
+                              else {"enabled": False}).encode()
+            ctype = "application/json"
+            code = 200
+        elif self.path == "/agentz":
+            # resident actuation agent: cached ns handles per container,
+            # revalidation outcomes, fallback count (doctor WARNs on a
+            # non-zero windowed fallback rate)
+            import json
+            agent = type(self).agent
+            body = json.dumps(agent.status() if agent is not None
                               else {"enabled": False}).encode()
             ctype = "application/json"
             code = 200
@@ -133,9 +144,12 @@ def build_stack(settings: Settings) -> TPUMountService:
     NewGPUAllocator → NewGPUCollector; composition instead of embedding).
     The shared pod informer (one list+watch over the pool namespace) is
     the default read path; ``TPU_INFORMER=0`` reverts every read to direct
-    apiserver calls."""
+    apiserver calls. The resident actuation agent (cached ns fds, zero
+    fork on the warm path) is the default actuator; ``TPU_AGENT=0``
+    reverts to direct per-call actuation."""
     enumerator = best_enumerator(settings.host,
-                                 allow_fake=settings.allow_fake_devices)
+                                 allow_fake=settings.allow_fake_devices,
+                                 cache_ttl_s=settings.enum_cache_ttl_s)
     podresources = KubeletPodResourcesClient(settings.host.kubelet_socket)
     collector = TPUCollector(enumerator, podresources,
                              resource_name=settings.resource_name,
@@ -152,7 +166,18 @@ def build_stack(settings: Settings) -> TPUMountService:
     cgroups = CgroupDeviceController(settings.host,
                                      driver=settings.cgroup_driver)
     actuator = ProcRootActuator(settings.host)
-    mounter = TPUMounter(cgroups, actuator, enumerator, settings.host)
+    if settings.agent_enabled:
+        from gpumounter_tpu.actuation.agent import (AgentActuator,
+                                                    ResidentActuationAgent)
+        # fake_nodes stays False even with TPU_ALLOW_FAKE_DEVICES: that
+        # flag widens what the ENUMERATOR accepts; actuation always
+        # creates real char nodes, exactly like the ProcRootActuator
+        # fallback beneath it (boot tests run both paths as root).
+        agent = ResidentActuationAgent(settings.host, fake_nodes=False)
+        actuator = AgentActuator(agent, actuator)
+        _HealthHandler.agent = agent
+    mounter = TPUMounter(cgroups, actuator, enumerator, settings.host,
+                         plans=collector.plans)
     return TPUMountService(allocator, mounter, kube, settings,
                            journal=_build_journal(settings))
 
@@ -184,7 +209,12 @@ def main() -> None:
     if settings.warm_pool_enabled:
         from gpumounter_tpu.worker.pool import PoolManager
         pool = PoolManager(service.allocator, service.kube,
-                           settings).start()
+                           settings)
+        # pool-warm actuation hook: each reconcile pass refreshes the
+        # inventory snapshot (and with it the precomputed actuation plan
+        # cache) OFF the attach hot path
+        pool.warm_hook = service.allocator.collector.update_status
+        pool.start()
         service.pool = pool
         _HealthHandler.pool = pool
         logger.info("warm pool enabled: %s", settings.warm_pool_sizes)
@@ -202,6 +232,8 @@ def main() -> None:
     finally:
         if pool is not None:
             pool.stop()
+        if _HealthHandler.agent is not None:
+            _HealthHandler.agent.stop()
         reconciler.stop()
         service.reads.stop()
         health.shutdown()

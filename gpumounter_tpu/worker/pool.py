@@ -89,6 +89,13 @@ class PoolManager:
         self._stop = threading.Event()
         self._kick = threading.Event()
         self._thread: threading.Thread | None = None
+        # Pool-warm actuation hook (ISSUE 6): invoked once per reconcile
+        # pass so the actuation plan cache / inventory snapshot is
+        # precomputed OFF the attach hot path (worker/main.py binds it to
+        # the collector's refresh; the resident agent's plan cache rides
+        # each re-enumeration). Best-effort: a failing hook never blocks
+        # pool reconciliation.
+        self.warm_hook = None
         self._gauge_keys: set[str] = set()  # every key ever exported
         # Server-side node scoping: warm pods carry this worker's node as
         # a LABEL (the nodeSelector spec field cannot be label-selected),
@@ -214,7 +221,7 @@ class PoolManager:
         REGISTRY.pool_hits.inc(len(claimed))
         REGISTRY.pool_misses.inc(count - len(claimed))
         if claimed:
-            logger.info("adopted %d/%d warm pod(s) %s for %s/%s",
+            logger.debug("adopted %d/%d warm pod(s) %s for %s/%s",
                         len(claimed), count, claimed,
                         objects.namespace(owner), objects.name(owner))
             self.notify()           # refill asynchronously, off this path
@@ -303,6 +310,11 @@ class PoolManager:
         if created:
             self._await_running(created, create_t0)
         self._refresh_gauge()
+        if self.warm_hook is not None:
+            try:
+                self.warm_hook()
+            except Exception:       # noqa: BLE001 — warming is best-effort
+                logger.exception("pool warm hook failed")
         return {"deleted": deleted, "created": created}
 
     # watch chunking, same rationale as the allocator's state machines

@@ -41,7 +41,7 @@ from gpumounter_tpu.utils.errors import (AllocationTimeoutError,
                                          TPUMounterError)
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
-from gpumounter_tpu.utils.trace import Trace
+from gpumounter_tpu.utils.trace import Trace, annotate
 
 logger = get_logger("worker.service")
 
@@ -62,6 +62,24 @@ class RemoveOutcome:
     result: consts.RemoveResult
     busy_pids: list[int] = dataclasses.field(default_factory=list)
     message: str = ""
+
+
+@dataclasses.dataclass
+class _AttachmentRecord:
+    """What an attach resolved, remembered so the detach of the same
+    attachment doesn't re-resolve it (ISSUE 6: ``detach_resolve`` was
+    ~3 ms of pure re-resolution — one kubelet LIST + inventory re-scan —
+    on a pod this worker just attached to). Trust is bounded: the record
+    is keyed to the pod's UID, aged out after a TTL, and only used when
+    the shared informer's (cache-served) view of the owner's slave pods
+    still matches ``slaves`` exactly — any external mutation (reconciler
+    GC, operator delete) flunks that check and detach falls back to the
+    full kubelet re-resolution."""
+
+    uid: str
+    all_chips: list[TPUChip]     # the pod's complete chip set at attach
+    slaves: set[str]             # ALL owner slave-pod names at attach
+    recorded_at: float
 
 
 @dataclasses.dataclass
@@ -136,6 +154,12 @@ class TPUMountService:
         # /dev scan exclusion only protects the revoke's OWN sync, not a
         # concurrent mount's scan of the not-yet-unlinked chip node.
         self._pod_locks = KeyedLocks()
+        # (namespace, pod) -> _AttachmentRecord: detach resolution served
+        # from attach-time knowledge (validated against the informer's
+        # slave-pod view) instead of a fresh kubelet round trip. Bounded
+        # by the node's attachable pods; entries age out via the TTL.
+        self._attach_records: dict[tuple[str, str], _AttachmentRecord] = {}
+        self._attach_records_lock = threading.Lock()
         # (namespace, pod, reason) -> last emit time for event suppression
         self._event_times: dict = {}
         self._event_times_lock = threading.Lock()
@@ -259,10 +283,10 @@ class TPUMountService:
         # kubelet snapshot that already listed every allocated chip — one
         # AddTPU costs O(1) kubelet LISTs (round-2 VERDICT weak #4).
         with trace.span("resolve"):
+            all_slave_names = self.allocator.slave_pod_names(pod_name,
+                                                             namespace)
             all_after = self.allocator.collector.get_pod_tpu_resources_exact(
-                pod_name, namespace,
-                self.allocator.slave_pod_names(pod_name, namespace),
-                refresh=False)
+                pod_name, namespace, all_slave_names, refresh=False)
         # Write-ahead intent BEFORE any cgroup/mknod actuation: if the
         # worker dies anywhere past this point, startup replay re-derives
         # ground truth and completes or reverts — partial device grants
@@ -275,6 +299,10 @@ class TPUMountService:
                 is_entire_mount)
         try:
             with trace.span("actuate"):
+                # (no explicit warm call: the resident agent opens+caches
+                # the container's ns handle on its first batch — an extra
+                # per-attach warm pass would re-enumerate containers and
+                # re-validate the handle for nothing)
                 created_nodes = self.mounter.mount_chips(pod, chips,
                                                          all_after)
         except TPUMounterError as e:
@@ -302,11 +330,12 @@ class TPUMountService:
                     self.journal.revert(jid)
                 else:
                     self.journal.revert_pending(jid)
+            self._forget_attachment(namespace, pod_name)
             self._record_event(pod, "TPUAttachFailed",
                                f"actuation failed, rolled back: {e}",
                                warning=True)
             raise
-        logger.info("AddTPU ok: %d chips -> %s/%s (%s, warm=%d cold=%d)",
+        logger.debug("AddTPU ok: %d chips -> %s/%s (%s, warm=%d cold=%d)",
                     len(chips), namespace, pod_name,
                     "entire" if is_entire_mount else "single",
                     alloc_stats.warm_adopted, alloc_stats.cold_created)
@@ -320,6 +349,8 @@ class TPUMountService:
         resumed = bool(adopt) and set(slaves) <= adopt and created_nodes == 0
         if jid is not None:
             self.journal.commit(jid)
+        self._remember_attachment(namespace, pod_name, objects.uid(pod),
+                                  all_after, all_slave_names)
         self._record_event(
             pod, "TPUAttachResumed" if resumed else "TPUAttached",
             f"attached {len(chips)} TPU chip(s) "
@@ -370,23 +401,36 @@ class TPUMountService:
                     consts.RemoveResult.POD_NOT_FOUND,
                     message=f"pod {namespace}/{pod_name} not found")
 
-            try:
-                chips, holders, all_slaves = \
-                    self.allocator.get_removable_tpus(
-                        pod_name, uuids, owner_namespace=namespace,
-                        txn_id=txn_id or None)
-            except DeviceNotFoundError as e:
-                return RemoveOutcome(consts.RemoveResult.TPU_NOT_FOUND,
-                                     message=str(e))
-            if not chips:
-                return RemoveOutcome(
-                    consts.RemoveResult.TPU_NOT_FOUND,
-                    message=f"no removable chips on {namespace}/{pod_name}")
+            # Attachment-record fast path: a detach of chips THIS worker
+            # attached resolves from the record cached at attach time
+            # (validated against the informer's slave-pod view) — zero
+            # kubelet round trips, zero inventory re-scans.
+            cached = self._resolve_detach_cached(pod, pod_name, namespace,
+                                                 uuids, txn_id)
+            if cached is not None:
+                chips, holders, all_chips = cached
+                annotate(cached_resolve=True)
+            else:
+                try:
+                    chips, holders, all_slaves = \
+                        self.allocator.get_removable_tpus(
+                            pod_name, uuids, owner_namespace=namespace,
+                            txn_id=txn_id or None)
+                except DeviceNotFoundError as e:
+                    return RemoveOutcome(consts.RemoveResult.TPU_NOT_FOUND,
+                                         message=str(e))
+                if not chips:
+                    return RemoveOutcome(
+                        consts.RemoveResult.TPU_NOT_FOUND,
+                        message="no removable chips on "
+                                f"{namespace}/{pod_name}")
 
-            # refresh=False + all_slaves: get_removable_tpus above already
-            # took both the kubelet snapshot and the apiserver slave LIST.
-            all_chips = self.allocator.collector.get_pod_tpu_resources_exact(
-                pod_name, namespace, all_slaves, refresh=False)
+                # refresh=False + all_slaves: get_removable_tpus above
+                # already took both the kubelet snapshot and the
+                # apiserver slave LIST.
+                all_chips = \
+                    self.allocator.collector.get_pod_tpu_resources_exact(
+                        pod_name, namespace, all_slaves, refresh=False)
 
         # Whole-slave-pod granularity: removing part of a slave pod's chips
         # would desync scheduler accounting (see module docstring).
@@ -413,7 +457,11 @@ class TPUMountService:
                                  busy_pids=e.pids, message=str(e))
         with trace.span("cleanup"):
             self.allocator.delete_slave_pods(holders)
-        logger.info("RemoveTPU ok: %d chips off %s/%s (force=%s%s)",
+        # the record described the pre-detach attachment; whatever remains
+        # (partial detach) is re-resolved and re-recorded by the next
+        # attach, never served stale
+        self._forget_attachment(namespace, pod_name)
+        logger.debug("RemoveTPU ok: %d chips off %s/%s (force=%s%s)",
                     len(chips), namespace, pod_name, force,
                     f", cause={cause}" if cause else "")
         # Journal the detach (terminal record, replay ignores it): the
@@ -430,6 +478,71 @@ class TPUMountService:
             + (f", cause={cause}" if cause else "") + "): "
             f"{[c.uuid for c in chips]}")
         return RemoveOutcome(consts.RemoveResult.SUCCESS)
+
+    # -- attachment-record cache (detach resolution fast path) ----------------
+
+    def _remember_attachment(self, namespace: str, pod_name: str, uid: str,
+                             all_chips: list[TPUChip],
+                             slaves: set[str]) -> None:
+        with self._attach_records_lock:
+            self._attach_records[(namespace, pod_name)] = _AttachmentRecord(
+                uid=uid, all_chips=list(all_chips), slaves=set(slaves),
+                recorded_at=time.monotonic())
+
+    def _forget_attachment(self, namespace: str, pod_name: str) -> None:
+        with self._attach_records_lock:
+            self._attach_records.pop((namespace, pod_name), None)
+
+    def _resolve_detach_cached(
+            self, pod: objects.Pod, pod_name: str, namespace: str,
+            uuids: list[str], txn_id: str = ""
+    ) -> tuple[list[TPUChip], list[str], list[TPUChip]] | None:
+        """(chips, holders, all_chips) from the attach-time record, or
+        None when the full re-resolution must run. None is always safe —
+        this is strictly a latency fast path; every validation failure
+        (unknown pod, recreated pod, aged record, slave set drifted,
+        uuids outside the record, txn-scoped detach, no informer to
+        validate against) falls back."""
+        if txn_id:
+            return None
+        with self._attach_records_lock:
+            record = self._attach_records.get((namespace, pod_name))
+        if record is None:
+            return None
+        pool_ns = self.settings.pool_namespace
+        if record.uid != objects.uid(pod) \
+                or time.monotonic() - record.recorded_at \
+                > self.settings.attach_cache_ttl_s \
+                or not self.reads.covers(pool_ns):
+            self._forget_attachment(namespace, pod_name)
+            return None
+        # ground truth check, served from the informer cache (zero
+        # apiserver round trips): the owner's slave set must be exactly
+        # what the attach recorded — reconciler GC or an operator delete
+        # in between flunks this and forces the full path
+        try:
+            live = {objects.name(p) for p in self.reads.list_pods(
+                pool_ns,
+                label_selector=self.allocator._owner_selector(
+                    pod_name, namespace))}
+        except TPUMounterError:
+            return None
+        if live != record.slaves:
+            self._forget_attachment(namespace, pod_name)
+            return None
+        removable = {c.uuid: c for c in record.all_chips
+                     if c.namespace == pool_ns
+                     and c.pod_name in record.slaves}
+        if not removable:
+            return None
+        wanted = list(uuids) or list(removable)
+        if any(u not in removable for u in wanted):
+            # unknown / non-removable ids: the full path re-resolves with
+            # fresh data and raises the precise DeviceNotFoundError
+            return None
+        chips = [removable[u] for u in wanted]
+        holders = sorted({c.pod_name for c in chips})
+        return chips, holders, list(record.all_chips)
 
     # -- TPUStatus (observability; no reference analog — their check was a
     # human running nvidia-smi, docs/guide/QuickStart.md:42-97) ---------------

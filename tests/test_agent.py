@@ -1,0 +1,247 @@
+"""Resident actuation agent (actuation/agent.py): cached ns handles,
+fd-liveness revalidation, in-process batch execution, and — the part that
+keeps chaos honest — every fault path degrading to the fallback actuator
+with the journal/rollback invariants intact."""
+
+import os
+import shutil
+
+import pytest
+
+from gpumounter_tpu.actuation.agent import (AgentActuator, AgentFault,
+                                            ResidentActuationAgent)
+from gpumounter_tpu.actuation.nsenter import RecordingActuator
+from gpumounter_tpu.testing.chaos import assert_invariants
+from gpumounter_tpu.testing.sim import WorkerRig
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.errors import ActuationError, TPUMounterError
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+
+PID = 4242
+
+
+@pytest.fixture
+def agent(fake_host):
+    os.makedirs(os.path.join(fake_host.proc_root, str(PID), "root", "dev"),
+                exist_ok=True)
+    a = ResidentActuationAgent(fake_host, fake_nodes=True)
+    yield a
+    a.stop()
+
+
+def _container_nodes(fake_host, pid=PID):
+    root = os.path.join(fake_host.proc_root, str(pid), "root")
+    out = set()
+    for dirpath, _, files in os.walk(root):
+        for name in files:
+            if not name.endswith(".majmin"):
+                out.add("/" + os.path.relpath(os.path.join(dirpath, name),
+                                              root))
+    return out
+
+
+# -- batch execution ----------------------------------------------------------
+
+def test_agent_executes_batch_with_zero_forks(agent, fake_host):
+    created = agent.apply(PID, [("/dev/accel0", 120, 0),
+                                ("/dev/accel1", 120, 1)], [])
+    assert created == 2
+    assert _container_nodes(fake_host) == {"/dev/accel0", "/dev/accel1"}
+    # sidecars carry the majmin (the shared fixture format)
+    root = os.path.join(fake_host.proc_root, str(PID), "root")
+    with open(root + "/dev/accel0.majmin") as f:
+        assert f.read() == "120:0"
+
+
+def test_agent_batches_are_idempotent(agent):
+    assert agent.apply(PID, [("/dev/accel0", 120, 0)], []) == 1
+    # existing node short-circuits: the resume signal is 0 new nodes
+    assert agent.apply(PID, [("/dev/accel0", 120, 0)], []) == 0
+
+
+def test_agent_removes_nodes_and_sidecars(agent, fake_host):
+    agent.apply(PID, [("/dev/accel0", 120, 0)], [])
+    agent.apply(PID, [], ["/dev/accel0"])
+    assert _container_nodes(fake_host) == set()
+    # removing an absent node is a no-op, not an error
+    agent.apply(PID, [], ["/dev/accel0"])
+
+
+def test_agent_caches_the_ns_handle(agent):
+    assert agent.warm(PID) is True
+    before = REGISTRY.agent_revalidations.value(outcome="ok")
+    agent.apply(PID, [("/dev/accel0", 120, 0)], [])
+    agent.apply(PID, [], ["/dev/accel0"])
+    # both batches revalidated the SAME cached handle
+    assert REGISTRY.agent_revalidations.value(outcome="ok") >= before + 2
+    assert [h["pid"] for h in agent.status()["ns_fds"]] == [PID]
+
+
+# -- fault paths --------------------------------------------------------------
+
+def test_stale_handle_is_evicted_and_reopened(agent, fake_host):
+    """Container restarted between warm and attach: the pid dir is a NEW
+    inode, the cached handle flunks revalidation, and the agent reopens
+    against the new incarnation transparently."""
+    agent.warm(PID)
+    pid_dir = os.path.join(fake_host.proc_root, str(PID))
+    shutil.rmtree(pid_dir)
+    os.makedirs(os.path.join(pid_dir, "root", "dev"))
+    stale_before = REGISTRY.agent_revalidations.value(outcome="stale")
+    assert agent.apply(PID, [("/dev/accel0", 120, 0)], []) == 1
+    assert REGISTRY.agent_revalidations.value(outcome="stale") \
+        == stale_before + 1
+    assert _container_nodes(fake_host) == {"/dev/accel0"}
+
+
+def test_dead_container_raises_agent_fault(agent, fake_host):
+    agent.warm(PID)
+    shutil.rmtree(os.path.join(fake_host.proc_root, str(PID)))
+    with pytest.raises(AgentFault):
+        agent.apply(PID, [("/dev/accel0", 120, 0)], [])
+
+
+def test_actuation_error_passes_through_not_agent_fault(agent, fake_host):
+    """Filesystem-level failures are genuine actuation failures: falling
+    back would fail identically, and the rollback path needs the typed
+    error. (Trigger: the node's parent path is occupied by a FILE, so
+    mkdir fails — permission-based triggers don't bite under root.)"""
+    root = os.path.join(fake_host.proc_root, str(PID), "root")
+    with open(os.path.join(root, "dev", "blocked"), "w"):
+        pass
+    with pytest.raises(ActuationError):
+        agent.apply(PID, [("/dev/blocked/accel0", 120, 7)], [])
+
+
+def test_executor_crash_mid_batch_faults_then_recovers(agent):
+    """An agent crash mid-batch surfaces as AgentFault to the submitter
+    (who falls back); the executor keeps serving, and an idempotent
+    retry of the half-applied batch completes it (accel0 landed before
+    the crash, so only accel1 is new)."""
+    calls = {"n": 0}
+
+    def die_on_second(op, pid, path):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("injected agent crash")
+
+    agent._op_hook = die_on_second
+    with pytest.raises(AgentFault):
+        agent.apply(PID, [("/dev/accel0", 120, 0),
+                          ("/dev/accel1", 120, 1)], [])
+    agent._op_hook = None
+    assert agent.apply(PID, [("/dev/accel0", 120, 0),
+                             ("/dev/accel1", 120, 1)], []) == 1
+    assert agent.status()["executor_alive"] is True
+
+
+def test_stopped_agent_faults_instead_of_hanging(agent):
+    agent.stop()
+    with pytest.raises(AgentFault):
+        agent.apply(PID, [("/dev/accel0", 120, 0)], [])
+
+
+# -- the AgentActuator fallback seam ------------------------------------------
+
+def test_agent_fault_falls_back_to_wrapped_actuator(fake_host):
+    """The container never existed for the agent (no pid dir): every op
+    degrades to the fallback actuator and is counted."""
+    agent = ResidentActuationAgent(fake_host, fake_nodes=True)
+    fallback = RecordingActuator()
+    actuator = AgentActuator(agent, fallback)
+    before = REGISTRY.agent_fallbacks.value(reason="open_ns_fd")
+    try:
+        made = actuator.apply_device_nodes(9999, [("/dev/accel0", 1, 2)],
+                                           [])
+        assert made == 1
+        assert fallback.created == [(9999, "/dev/accel0", 1, 2)]
+        assert REGISTRY.agent_fallbacks.value(reason="open_ns_fd") \
+            == before + 1
+    finally:
+        agent.stop()
+
+
+def test_single_op_methods_ride_the_agent(agent, fake_host):
+    actuator = AgentActuator(agent, RecordingActuator())
+    assert actuator.create_device_node(PID, "/dev/accel0", 120, 0) is True
+    assert actuator.create_device_node(PID, "/dev/accel0", 120, 0) is False
+    actuator.remove_device_node(PID, "/dev/accel0")
+    assert _container_nodes(fake_host) == set()
+
+
+# -- service-level chaos: journal / rollback interplay ------------------------
+
+def _attach(rig, request_id="agent-chaos"):
+    return rig.service.add_tpu("workload", "default", 4, True,
+                               request_id=request_id)
+
+
+def test_agent_crash_mid_batch_fallback_completes_attach(fake_host):
+    """Agent dies between the cgroup grant and the last mknod: the
+    fallback actuator idempotently completes the batch and the attach
+    SUCCEEDS — invariants hold, journal clean."""
+    rig = WorkerRig(fake_host, n_chips=4, actuator="procroot",
+                    informer=True, agent=True)
+    calls = {"n": 0}
+
+    def die_once_mid_batch(op, pid, path):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("injected agent crash mid-batch")
+
+    rig.agent._op_hook = die_once_mid_batch
+    try:
+        outcome = _attach(rig)
+        assert outcome.result == consts.AddResult.SUCCESS
+        assert calls["n"] >= 2          # the crash actually fired
+        assert_invariants(rig, {c.uuid for c in outcome.chips})
+    finally:
+        rig.close()
+
+
+def test_agent_crash_plus_fallback_failure_rolls_back(fake_host):
+    """Agent dies mid-batch AND the fallback fails: the service's normal
+    rollback runs (slave pods deleted, partial nodes reverted, journal
+    reverted) — the chaos contract the journal exists for."""
+    rig = WorkerRig(fake_host, n_chips=4, actuator="procroot",
+                    informer=True, agent=True)
+
+    def always_die(op, pid, path):
+        raise RuntimeError("injected agent crash")
+
+    rig.agent._op_hook = always_die
+    fallback = rig.actuator.fallback
+    orig = fallback.create_device_node
+
+    def failing_create(pid, device_path, major, minor,
+                       mode=consts.DEVICE_FILE_MODE):
+        raise ActuationError("injected fallback failure")
+
+    fallback.create_device_node = failing_create
+    try:
+        with pytest.raises(TPUMounterError):
+            _attach(rig)
+        fallback.create_device_node = orig
+        rig.agent._op_hook = None
+        assert_invariants(rig, set())
+        assert rig.service.journal.backlog() == 0
+    finally:
+        rig.close()
+
+
+def test_agent_attach_detach_cycle_end_to_end(fake_host):
+    rig = WorkerRig(fake_host, n_chips=4, actuator="procroot",
+                    informer=True, agent=True)
+    try:
+        outcome = _attach(rig)
+        assert outcome.result == consts.AddResult.SUCCESS
+        status = rig.agent.status()
+        assert status["executor_alive"] is True
+        assert status["ns_fds"], "attach did not warm an ns handle"
+        assert rig.service.remove_tpu("workload", "default", [],
+                                      False).result \
+            == consts.RemoveResult.SUCCESS
+        assert_invariants(rig, set(), max_attached_events=1)
+    finally:
+        rig.close()

@@ -1,0 +1,130 @@
+"""Attachment-record cache (worker/service.py): detach resolution of a
+pod this worker just attached is served from attach-time knowledge —
+ZERO kubelet round trips — validated against the informer's slave-pod
+view, with every staleness signal falling back to the full path."""
+
+import dataclasses
+
+import pytest
+
+from gpumounter_tpu.testing.sim import WorkerRig
+from gpumounter_tpu.utils import consts
+
+
+@pytest.fixture
+def rig(fake_host):
+    r = WorkerRig(fake_host, n_chips=4, informer=True)
+    yield r
+    r.close()
+
+
+def _attach(rig, n=4, entire=True, rid="cache-test"):
+    outcome = rig.service.add_tpu("workload", "default", n, entire,
+                                  request_id=rid)
+    assert outcome.result == consts.AddResult.SUCCESS
+    return outcome
+
+
+def test_detach_resolve_pays_zero_kubelet_round_trips(rig):
+    """The phase-breakdown win pinned: detach of a just-attached pod
+    takes NO kubelet PodResources snapshot (the ~3 ms `detach_resolve`
+    re-resolution in BENCH r05) — the attach-time record serves it."""
+    _attach(rig)
+    before = rig.sim.podresources.list_calls
+    outcome = rig.service.remove_tpu("workload", "default", [], False)
+    assert outcome.result == consts.RemoveResult.SUCCESS
+    assert rig.sim.podresources.list_calls == before, \
+        "detach re-resolved through the kubelet despite a valid " \
+        "attachment record"
+
+
+def test_detach_subset_by_uuid_served_from_record(rig):
+    chips = _attach(rig, n=2, entire=False).chips
+    target = chips[0].uuid
+    before = rig.sim.podresources.list_calls
+    outcome = rig.service.remove_tpu("workload", "default", [target],
+                                     False)
+    assert outcome.result == consts.RemoveResult.SUCCESS
+    assert rig.sim.podresources.list_calls == before
+
+
+def test_record_invalidated_after_detach(rig):
+    """A partial detach consumes the record; the NEXT detach must
+    re-resolve (the record described pre-detach state)."""
+    _attach(rig, n=2, entire=False)
+    assert rig.service.remove_tpu("workload", "default", [], False).result \
+        == consts.RemoveResult.SUCCESS
+    assert ("default", "workload") not in rig.service._attach_records
+
+
+def test_slave_set_drift_falls_back_to_full_resolution(rig):
+    """An external mutation (reconciler GC, operator delete) between
+    attach and detach flunks the informer-view check: the cached record
+    is NOT trusted and the full path re-resolves ground truth."""
+    _attach(rig)
+    record = rig.service._attach_records[("default", "workload")]
+    victim = next(iter(record.slaves))
+    rig.sim.kube.delete_pod(rig.sim.settings.pool_namespace, victim)
+    # informer catches up before the detach looks
+    rig.reads.wait_pods(rig.sim.settings.pool_namespace, None,
+                        lambda pods: victim not in pods, 5.0)
+    before = rig.sim.podresources.list_calls
+    outcome = rig.service.remove_tpu("workload", "default", [], False)
+    assert rig.sim.podresources.list_calls > before, \
+        "stale record served despite slave-set drift"
+    assert outcome.result in (consts.RemoveResult.SUCCESS,
+                              consts.RemoveResult.TPU_NOT_FOUND)
+    assert ("default", "workload") not in rig.service._attach_records
+
+
+def test_recreated_pod_uid_mismatch_falls_back(rig):
+    _attach(rig)
+    record = rig.service._attach_records[("default", "workload")]
+    # simulate a same-named recreated pod: the record's uid no longer
+    # matches what the live pod reports
+    rig.service._attach_records[("default", "workload")] = \
+        dataclasses.replace(record, uid="uid-of-a-previous-life")
+    before = rig.sim.podresources.list_calls
+    assert rig.service.remove_tpu("workload", "default", [], False).result \
+        == consts.RemoveResult.SUCCESS
+    assert rig.sim.podresources.list_calls > before
+
+
+def test_aged_record_falls_back(rig):
+    _attach(rig)
+    record = rig.service._attach_records[("default", "workload")]
+    rig.service._attach_records[("default", "workload")] = \
+        dataclasses.replace(
+            record,
+            recorded_at=record.recorded_at
+            - rig.sim.settings.attach_cache_ttl_s - 1)
+    before = rig.sim.podresources.list_calls
+    assert rig.service.remove_tpu("workload", "default", [], False).result \
+        == consts.RemoveResult.SUCCESS
+    assert rig.sim.podresources.list_calls > before
+
+
+def test_unknown_uuid_still_raises_precise_error(rig):
+    """Ids outside the record go to the full path, which answers with
+    the precise DeviceNotFound — the cache must not change error
+    semantics."""
+    _attach(rig)
+    outcome = rig.service.remove_tpu("workload", "default",
+                                     ["no-such-chip"], False)
+    assert outcome.result == consts.RemoveResult.TPU_NOT_FOUND
+
+
+def test_informerless_rig_never_uses_the_record(fake_host):
+    """Without an informer there is no cache-served slave view to
+    validate against: detach always runs the full resolution (the
+    legacy-path contrast)."""
+    rig = WorkerRig(fake_host, n_chips=4)
+    try:
+        _attach(rig)
+        before = rig.sim.podresources.list_calls
+        assert rig.service.remove_tpu("workload", "default", [],
+                                      False).result \
+            == consts.RemoveResult.SUCCESS
+        assert rig.sim.podresources.list_calls > before
+    finally:
+        rig.close()

@@ -1,0 +1,22 @@
+"""Bench contention selftest (ISSUE 6 satellite): the contention config
+must measure real wakeup latency — capacity release keyed off the
+broker's own lease table, every contender finishing SUCCESS, and the
+queued-wait p50 far below the queue timeout (BENCH r05 recorded the
+60 s timeout constant because the old config could fail to release
+capacity to the parked pair)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+
+def test_contention_config_measures_wakeup_not_timeout():
+    out = bench.measure_contention(cycles=1)
+    # measure_contention itself asserts: every contender SUCCESS, no
+    # queue_timeout, p50 < timeout/2. Pin the output contract here.
+    assert out["queued_attach_samples"] >= 2
+    assert 0 < out["queued_attach_wait_p50_s"] < 30.0
+    assert out["preemption_e2e_p50_s"] > 0

@@ -79,9 +79,33 @@ def wait_http(url: str, timeout_s: float = 20.0,
 
 
 @pytest.fixture
-def boot_env(fake_host, tmp_path):
+def boot_fake_host():
+    """Like the shared ``fake_host`` but rooted on tmpfs when available:
+    the boot tests do REAL ``mknod(S_IFCHR)`` into the fixture tree, and
+    network/overlay filesystems (9p /tmp on some dev hosts) refuse char
+    nodes even for root — tmpfs behaves like the real devtmpfs."""
+    import shutil
+    import tempfile
+    from gpumounter_tpu.utils.config import HostPaths
+    base = "/dev/shm" if os.access("/dev/shm", os.W_OK) else None
+    root = tempfile.mkdtemp(prefix="tpumounter-boot-", dir=base)
+    host = HostPaths(
+        dev_root=os.path.join(root, "dev"),
+        proc_root=os.path.join(root, "proc"),
+        sys_root=os.path.join(root, "sys"),
+        cgroup_root=os.path.join(root, "sys", "fs", "cgroup"),
+        kubelet_socket=os.path.join(root, "pod-resources", "kubelet.sock"))
+    for d in (host.dev_root, host.proc_root, host.cgroup_root):
+        os.makedirs(d, exist_ok=True)
+    yield host
+    shutil.rmtree(root, ignore_errors=True)
+
+
+@pytest.fixture
+def boot_env(boot_fake_host, tmp_path):
     """ClusterSim + HTTP apiserver + kubeconfig + fixture container, and
     the env both binaries boot from."""
+    fake_host = boot_fake_host
     sim = ClusterSim(n_chips=4, kubelet_socket_path=fake_host.kubelet_socket)
     sim.settings.host = fake_host
     # fixture chips on "disk" so the worker subprocess's enumerator sees the

@@ -323,3 +323,67 @@ def test_v1_allow_covers_companions(fake_host):
     ctrl.revoke_device_access(pod, cid, [chips[0]], [chips[1]])
     assert open(os.path.join(cdir, "devices.deny")).read().splitlines() \
         == ["c 511:0 rw"]
+
+
+def test_v1_batch_issues_one_write_syscall_per_rule(fake_host):
+    """Kernel contract: devices.allow/deny parse ONE rule per write(2) —
+    the batched writer must flush per entry, never coalesce the batch
+    into a single buffered write (the kernel would silently drop every
+    rule after the first newline)."""
+    import builtins
+    from gpumounter_tpu.device.model import CompanionNode, TPUChip
+    pod = mk_pod(qos_reported="Guaranteed")
+    ctrl = CgroupDeviceController(fake_host, driver="cgroupfs", version=1)
+    cid = "containerd://" + "ab" * 32
+    cdir = ctrl.container_dir(pod, cid)
+    os.makedirs(cdir)
+    comp = CompanionNode("/dev/vfio/vfio", 10, 196)
+    chips = [TPUChip(index=i, device_path=f"/dev/vfio/{i}", major=511,
+                     minor=i, uuid=str(i), companions=(comp,))
+             for i in range(3)]
+
+    flushed_writes: list[str] = []
+    real_open = builtins.open
+
+    def spying_open(path, mode="r", *args, **kwargs):
+        f = real_open(path, mode, *args, **kwargs)
+        if not (str(path).endswith("devices.allow") and "a" in mode):
+            return f
+        buffered: list[str] = []
+        real_write, real_flush = f.write, f.flush
+
+        class Spy:
+            def write(self, data):
+                buffered.append(data)
+                return real_write(data)
+
+            def flush(self):
+                # one flush = at most one rule reaches the kernel intact
+                flushed_writes.append("".join(buffered))
+                buffered.clear()
+                return real_flush()
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                if buffered:            # unflushed residue would coalesce
+                    flushed_writes.append("".join(buffered))
+                f.close()
+
+            def __getattr__(self, name):
+                return getattr(f, name)
+
+        return Spy()
+
+    builtins.open = spying_open
+    try:
+        ctrl.sync_device_access(pod, cid, chips)
+    finally:
+        builtins.open = real_open
+    # 3 chips + 1 shared companion = 4 rules, each its own write(2)
+    assert len(flushed_writes) == 4, flushed_writes
+    for chunk in flushed_writes:
+        assert chunk.count("\n") == 1, \
+            f"coalesced multi-rule write would be truncated by the " \
+            f"kernel: {chunk!r}"

@@ -537,7 +537,21 @@ class AgentActuator(ContainerNsActuator):
         self.fallback = fallback
 
     def _fall_back(self, fault: AgentFault, pid: int):
+        from gpumounter_tpu.utils.events import EVENTS
+        from gpumounter_tpu.utils.flight import RECORDER
+        from gpumounter_tpu.utils.trace import current_span
         REGISTRY.agent_fallbacks.inc(reason=fault.reason)
+        # correlate with the request being actuated: the active trace's
+        # rid (fallbacks happen inside a traced attach/detach phase)
+        span = current_span()
+        rid = (span._trace.rid if span is not None
+               and getattr(span, "_trace", None) is not None else "")
+        rid = "" if rid == "-" else rid
+        EVENTS.emit("agent_fallback", rid=rid, reason=fault.reason,
+                    pid=pid)
+        # a BURST of fallbacks (not a routine single stale-fd one) is a
+        # flight-recorder trigger: the fork-free warm path is down
+        RECORDER.note("agent_fallback", rid=rid, reason=fault.reason)
         logger.warning("actuation agent fault (%s) for pid %d; falling "
                        "back to %s: %s", fault.reason, pid,
                        type(self.fallback).__name__, fault)

@@ -421,6 +421,129 @@ def cmd_agentz(args) -> int:
     return rc
 
 
+def cmd_fleet(args) -> int:
+    """Render the master's /fleetz cluster view: per-node scrape health,
+    per-tenant chips in use, top SLO burn, and the merged lifecycle event
+    tail. Exit non-zero when any node is stale (unscraped)."""
+    path = ("/fleetz" if args.events <= 0
+            else f"/fleetz?limit={args.events}")
+    try:
+        payload = json.loads(_fetch_text(args.master, path,
+                                         args.timeout))
+    except TransportError as e:
+        print(f"unreachable: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    except ValueError as e:
+        print(f"bad /fleetz payload: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    nodes = payload.get("nodes") or {}
+    lines = [f"fleet: {len(nodes)} worker(s), "
+             f"{payload.get('ticks', 0)} scrape tick(s) "
+             f"@ {payload.get('tick_interval_s')}s"]
+    rc = 0
+    for node in sorted(nodes):
+        n = nodes[node]
+        state = n.get("state", "?")
+        if state != "fresh":
+            rc = EXIT_OTHER
+        chips = n.get("chips") or {}
+        chip_str = " ".join(f"{k.lower()}:{v}"
+                            for k, v in sorted(chips.items())) or "-"
+        extras = []
+        if n.get("journal_backlog"):
+            extras.append(f"journal backlog {n['journal_backlog']}")
+        if n.get("missed_ticks"):
+            extras.append(f"{n['missed_ticks']} missed tick(s)")
+        if n.get("error"):
+            extras.append(n["error"])
+        lines.append(
+            f"  {node}: {state.upper()}  chips[{chip_str}]  "
+            f"events@{n.get('events_seq', 0)}"
+            + (f"  [{'; '.join(extras)}]" if extras else ""))
+    tenants = payload.get("tenants") or {}
+    if tenants:
+        lines.append("  tenants: " + ", ".join(
+            f"{t}={c} chip(s)" for t, c in sorted(tenants.items())))
+    top = (payload.get("slo") or {}).get("top_burn")
+    if top:
+        lines.append(f"  top burn: tenant {top.get('tenant')} "
+                     f"slo {top.get('slo')} burn {top.get('burn')} (5m)")
+    tail = (payload.get("events") or [])[-args.events:] \
+        if args.events > 0 else []
+    for event in tail:
+        attrs = event.get("attrs") or {}
+        detail = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        lines.append(
+            f"    {event.get('ts')} [{event.get('node') or 'master'}] "
+            f"{event.get('kind')} rid={event.get('rid', '-') or '-'} "
+            + detail)
+    _emit(payload, args.json, "\n".join(lines))
+    return rc
+
+
+def cmd_flight(args) -> int:
+    """Inspect flight-recorder bundles (local TPU_FLIGHT_DIR — the
+    recorder writes on the master/worker host, so run this where the
+    process runs or on a copy of the directory)."""
+    from gpumounter_tpu.utils.flight import FlightRecorder
+    flight_dir = args.dir or os.environ.get("TPU_FLIGHT_DIR", "")
+    if not flight_dir:
+        print("no flight dir: pass --dir or set TPU_FLIGHT_DIR",
+              file=sys.stderr)
+        return EXIT_OTHER
+    if args.flight_action == "list":
+        bundles = FlightRecorder.list_bundles(flight_dir)
+        if args.json:
+            print(json.dumps(bundles, indent=2))
+            return 0
+        if not bundles:
+            print(f"no flight bundles in {flight_dir}")
+            return 0
+        for b in bundles:
+            print(f"{b.get('id')}  trigger={b.get('trigger')}  "
+                  f"rid={b.get('rid') or '-'}  ts={b.get('ts')}  "
+                  f"{b.get('events', 0)} event(s)")
+        return 0
+    bundle = FlightRecorder.load(flight_dir, args.bundle_id)
+    if bundle is None:
+        print(f"no bundle {args.bundle_id!r} in {flight_dir}",
+              file=sys.stderr)
+        return EXIT_OTHER
+    if bundle.get("error") and "trigger" not in bundle:
+        print(f"bundle {args.bundle_id!r} is unreadable "
+              f"(corrupt or partially written)", file=sys.stderr)
+        return EXIT_OTHER
+    if args.json:
+        print(json.dumps(bundle, indent=2))
+        return 0
+    print(f"bundle {bundle.get('id')}  trigger={bundle.get('trigger')}  "
+          f"rid={bundle.get('rid') or '-'}  ts={bundle.get('ts')}")
+    if bundle.get("context"):
+        print(f"  context: {bundle['context']}")
+    rid_events = bundle.get("rid_events") or []
+    for event in rid_events or (bundle.get("events") or [])[-10:]:
+        attrs = event.get("attrs") or {}
+        detail = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        print(f"  event {event.get('seq')}: {event.get('kind')} "
+              f"rid={event.get('rid', '-') or '-'} {detail}")
+    traces = bundle.get("traces") or {}
+    for group in ("rid", "failed", "slowest"):
+        for trace in traces.get(group) or []:
+            print(f"  trace[{group}] op={trace.get('op')} "
+                  f"rid={trace.get('rid')} result={trace.get('result')} "
+                  f"total={trace.get('total_ms')}ms")
+    journal = bundle.get("journal")
+    if isinstance(journal, dict):
+        print(f"  journal: backlog={journal.get('backlog')}, "
+              f"{len(journal.get('records') or [])} record(s)")
+    broker = bundle.get("broker")
+    if isinstance(broker, dict):
+        leases = (broker.get("leases") or {}).get("count")
+        print(f"  broker: {leases} lease(s), queue depth "
+              f"{(broker.get('queue') or {}).get('depth')}")
+    return 0
+
+
 def cmd_health(args) -> int:
     try:
         status, payload = _request(args.master, "GET", "/healthz",
@@ -443,38 +566,15 @@ def _fetch_text(master: str, path: str, timeout: float) -> str:
         raise TransportError(f"GET {url}: {e}") from e
 
 
+# The exposition parser lives next to the renderer it round-trips with
+# (utils/metrics.py); wrapped under the historical name because doctor's
+# helpers and the existing tests address it as cli._parse_exposition.
+# Imported lazily: the CLI's module scope is stdlib-only, and commands
+# that never scrape (health, add, remove) must not pay for constructing
+# the full metrics Registry at startup.
 def _parse_exposition(text: str) -> dict:
-    """Minimal parser for Prometheus text exposition: returns
-    {metric_name: {frozen label tuple: value}} for non-comment lines.
-    Handles the standard optional trailing timestamp
-    (``name{labels} value timestamp_ms``) — the value is the FIRST token
-    after the name/labels, not the last (rpartition took the timestamp as
-    the value when doctor was pointed at a non-registry endpoint)."""
-    out: dict = {}
-    for line in text.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        labels = {}
-        if "{" in line:
-            name, _, rest = line.partition("{")
-            labelstr, _, tail = rest.rpartition("}")
-            for part in labelstr.split(","):
-                if "=" in part:
-                    k, _, v = part.partition("=")
-                    labels[k] = v.strip('"')
-            fields = tail.split()
-        else:
-            fields = line.split()
-            name, fields = fields[0], fields[1:]
-        if not fields:
-            continue
-        try:
-            out.setdefault(name, {})[tuple(sorted(labels.items()))] = \
-                float(fields[0])
-        except ValueError:
-            continue
-    return out
+    from gpumounter_tpu.utils.metrics import parse_exposition
+    return parse_exposition(text)
 
 
 def _histogram_quantile(metrics: dict, family: str, q: float,
@@ -789,6 +889,88 @@ def cmd_doctor(args) -> int:
                   f"lease(s) auto-detached, {int(preemptions)} "
                   f"preemption(s) — {scope}")
 
+    # SLO burn rates (utils/slo.py, ticked by the master's fleet loop):
+    # CURRENT state — a fast 5m burn means a tenant is eating its error
+    # budget ~14x the sustainable rate RIGHT NOW and pages CRIT; a slow
+    # 1h burn tickets WARN. The top-burning tenant is reported either
+    # way. Thresholds come from the engine itself, so doctor pages at
+    # exactly the bound the control plane acts on.
+    if metrics:
+        from gpumounter_tpu.utils.slo import FAST_BURN, SLOW_BURN
+        burns = metrics.get("tpumounter_slo_burn_rate", {})
+        fast, slow = [], []
+        top = None
+        for labels, burn in burns.items():
+            d = dict(labels)
+            tenant, slo = d.get("tenant", "?"), d.get("slo", "?")
+            if d.get("window") == "5m":
+                if top is None or burn > top[2]:
+                    top = (tenant, slo, burn)
+                if burn >= FAST_BURN:
+                    fast.append(f"{tenant}/{slo} ({burn:g}x)")
+            elif d.get("window") == "1h" and burn >= SLOW_BURN:
+                slow.append(f"{tenant}/{slo} ({burn:g}x)")
+        if fast:
+            check("crit", f"FAST SLO burn (5m >= {FAST_BURN:g}x): "
+                          f"{', '.join(sorted(fast))} — the error budget "
+                          "is being consumed at page rate")
+        elif slow:
+            check("warn", f"slow SLO burn (1h >= {SLOW_BURN:g}x): "
+                          f"{', '.join(sorted(slow))}")
+        elif top is not None:
+            check("ok", f"SLO burn nominal; top: tenant {top[0]} "
+                        f"slo {top[1]} at {top[2]:g}x (5m)")
+
+    # Flight recorder: a dump inside the window means an anomaly trigger
+    # fired RIGHT NOW (fast burn / fallback burst / journal backlog /
+    # open circuit) and there is a fresh bundle to read.
+    if metrics:
+        src = metrics_delta if metrics_delta is not None else metrics
+        scope = (f"in the last {window:g}s" if metrics_delta is not None
+                 else "lifetime")
+        dumps = _counter_total(src, "tpumounter_flight_dumps_total")
+        if dumps:
+            check("warn" if metrics_delta is not None else "ok",
+                  f"flight-recorder bundles: {int(dumps)} — {scope} — "
+                  "`tpumounterctl flight list` to inspect")
+
+    # Fleet staleness (master-side /fleetz; workers answer 404 → skipped):
+    # a node unscraped for >= 2 ticks means the master is flying blind on
+    # it — its health/journal/event numbers are frozen.
+    try:
+        fleetz = json.loads(_fetch_text(args.master, "/fleetz",
+                                        args.timeout))
+    except (TransportError, ValueError):
+        fleetz = None
+    if isinstance(fleetz, dict) and "nodes" in fleetz:
+        nodes = fleetz.get("nodes") or {}
+        warn_ticks = int(fleetz.get("stale_ticks_warn") or 2)
+        stale = sorted(
+            node for node, n in nodes.items()
+            if n.get("state") != "fresh"
+            and int(n.get("missed_ticks") or 0) >= warn_ticks)
+        if not nodes:
+            check("ok", "fleet: no workers discovered yet")
+        elif stale:
+            check("warn",
+                  f"fleet: {len(stale)}/{len(nodes)} worker(s) stale "
+                  f"(unscraped >= {warn_ticks} ticks): "
+                  f"{', '.join(stale)} — their numbers are frozen")
+        else:
+            lagging = sorted(node for node, n in nodes.items()
+                             if n.get("state") != "fresh")
+            if lagging:
+                check("ok",
+                      f"fleet: {len(lagging)}/{len(nodes)} worker(s) "
+                      f"missed their last scrape (< {warn_ticks} ticks, "
+                      f"not yet a concern): {', '.join(lagging)}")
+            else:
+                check("ok", f"fleet: all {len(nodes)} worker(s) fresh")
+        top = (fleetz.get("slo") or {}).get("top_burn")
+        if top and not metrics.get("tpumounter_slo_burn_rate"):
+            check("ok", f"top burn tenant (fleetz): {top.get('tenant')} "
+                        f"slo {top.get('slo')} at {top.get('burn')}x")
+
     # Resident actuation agent: fallback RATE is the health signal — a
     # windowed non-zero delta means attaches are degrading to the
     # fallback actuator RIGHT NOW (stale ns fds beyond repair, executor
@@ -1006,6 +1188,26 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("health", help="master liveness")
     p.set_defaults(fn=cmd_health)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser(
+        "fleet",
+        help="cluster view from the master's fleet aggregator (/fleetz): "
+             "per-node scrape health, tenant usage, SLO burn, event tail")
+    p.add_argument("--events", type=int, default=10,
+                   help="merged lifecycle events to show (default 10)")
+    p.set_defaults(fn=cmd_fleet)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser(
+        "flight",
+        help="inspect flight-recorder anomaly bundles (TPU_FLIGHT_DIR)")
+    p.add_argument("flight_action", choices=["list", "show"])
+    p.add_argument("bundle_id", nargs="?", default="",
+                   help="bundle id for `show` (from `flight list`)")
+    p.add_argument("--dir", default="",
+                   help="bundle directory (default: $TPU_FLIGHT_DIR)")
+    p.set_defaults(fn=cmd_flight)
     _add_common(p, suppress=True)
 
     p = sub.add_parser(

@@ -52,6 +52,7 @@ from gpumounter_tpu.master.lease import Lease, LeaseTable
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.errors import (K8sApiError, QueueFullError,
                                          QuotaExceededError)
+from gpumounter_tpu.utils.events import EVENTS
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 
@@ -209,12 +210,16 @@ class AttachBroker:
             if usage + chips > cap:
                 REGISTRY.admission_decisions.inc(tenant=tenant,
                                                  outcome="over_quota")
+                EVENTS.emit("admit_denied", rid=rid, tenant=tenant,
+                            chips=chips, outcome="over_quota",
+                            usage=usage, cap=cap)
                 logger.info("[rid=%s] admission DENIED: tenant=%s "
                             "usage=%d + %d > cap %d", rid, tenant, usage,
                             chips, cap)
                 raise QuotaExceededError(tenant, usage, chips, cap,
                                          self._retry_after_hint(tenant))
         REGISTRY.admission_decisions.inc(tenant=tenant, outcome="granted")
+        EVENTS.emit("admit_granted", rid=rid, tenant=tenant, chips=chips)
 
     @contextlib.contextmanager
     def admission(self, tenant: str, chips: int, rid: str = "-"):
@@ -306,6 +311,8 @@ class AttachBroker:
             if depth >= self.config.queue_depth:
                 REGISTRY.admission_decisions.inc(tenant=tenant,
                                                  outcome="queue_full")
+                EVENTS.emit("queue_full", rid=rid, tenant=tenant,
+                            chips=chips, priority=priority, depth=depth)
                 raise QueueFullError(priority, depth, retry_after_s=1.0)
             waiter = _Waiter(tenant, priority, chips, node, rid,
                              namespace, pod, gen=gen0)
@@ -318,6 +325,9 @@ class AttachBroker:
                 waiter.event.set()
             self._refresh_queue_gauges_locked()
         deadline = waiter.enqueued_at + self.config.queue_timeout_s
+        EVENTS.emit("queue_enqueue", rid=rid, tenant=tenant, chips=chips,
+                    node=node, namespace=namespace, pod=pod,
+                    priority=priority, depth=depth + 1)
         logger.info("[rid=%s] attach queued: tenant=%s priority=%s "
                     "chips=%d node=%s depth=%d", rid, tenant, priority,
                     chips, node, depth + 1)
@@ -328,9 +338,12 @@ class AttachBroker:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not waiter.event.wait(remaining):
                     waited = time.monotonic() - waiter.enqueued_at
-                    REGISTRY.queue_wait.observe(waited)
+                    REGISTRY.queue_wait.observe(waited, tenant=tenant)
                     REGISTRY.admission_decisions.inc(
                         tenant=tenant, outcome="queue_timeout")
+                    EVENTS.emit("queue_timeout", rid=rid, tenant=tenant,
+                                chips=chips, priority=priority,
+                                waited_s=round(waited, 3))
                     payload = dict(payload)
                     payload["queued_s"] = round(waited, 3)
                     payload["queue_timeout"] = True
@@ -346,9 +359,12 @@ class AttachBroker:
                         if waiter in self._waiters:
                             self._waiters.remove(waiter)
                     waited = time.monotonic() - waiter.enqueued_at
-                    REGISTRY.queue_wait.observe(waited)
+                    REGISTRY.queue_wait.observe(waited, tenant=tenant)
                     REGISTRY.admission_decisions.inc(
                         tenant=tenant, outcome="granted_queued")
+                    EVENTS.emit("queue_granted", rid=rid, tenant=tenant,
+                                chips=chips, priority=priority,
+                                waited_s=round(waited, 3))
                     self._record_success(namespace, pod, tenant, priority,
                                          payload, node, rid)
                     payload["queued_s"] = round(waited, 3)
@@ -447,6 +463,14 @@ class AttachBroker:
         if result in _DETACH_GONE:
             if self.leases.drop(victim.namespace, victim.pod) is not None:
                 REGISTRY.preemptions.inc()
+                # emitted only when the drop landed: a lease released
+                # concurrently (pod detached on its own) is not a
+                # preemption, and the event stream must agree with
+                # tpumounter_preemptions_total on volume
+                EVENTS.emit("preempt", rid=waiter.rid, tenant=waiter.tenant,
+                            namespace=victim.namespace, pod=victim.pod,
+                            chips=victim.chips, victim_tenant=victim.tenant,
+                            victim_priority=victim.priority, result=result)
             self.signal_capacity()
             return True
         logger.warning("preemption of %s/%s did not free chips: %s",
@@ -575,6 +599,9 @@ class AttachBroker:
                 logger.info("lease expired: detached %s/%s (%d chips, "
                             "tenant=%s)", lease.namespace, lease.pod,
                             lease.chips, lease.tenant)
+            EVENTS.emit("lease_expired", rid=lease.rid,
+                        tenant=lease.tenant, namespace=lease.namespace,
+                        pod=lease.pod, chips=lease.chips, result=result)
             self.signal_capacity()
             return True
         # busy devices / transport trouble: back off linearly, keep the

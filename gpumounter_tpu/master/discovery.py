@@ -94,6 +94,24 @@ class WorkerDirectory:
             return (time.monotonic() - self._fetched_at
                     > self.MISS_REFRESH_INTERVAL_S)
 
+    def targets(self) -> dict[str, str]:
+        """Snapshot of every known node's gRPC target (the fleet
+        aggregator's worker enumeration). Refreshes on TTL expiry; an
+        unreachable apiserver degrades to the stale snapshot — the fleet
+        view goes stale, it does not wedge."""
+        with self._lock:
+            stale = time.monotonic() - self._fetched_at > self.ttl_s
+            snapshot = dict(self._by_node)
+        if stale:
+            try:
+                self._refresh()
+            except TPUMounterError as e:
+                logger.warning("worker directory refresh failed: %s", e)
+                return snapshot
+            with self._lock:
+                snapshot = dict(self._by_node)
+        return snapshot
+
     def invalidate(self, node: str) -> None:
         """Drop a cached entry the caller found to be dead (e.g. gRPC
         UNAVAILABLE after a worker pod restart) so the next request

@@ -43,7 +43,9 @@ from gpumounter_tpu.k8s.client import KubeClient
 from gpumounter_tpu.master.admission import AttachBroker
 from gpumounter_tpu.master.discovery import (WorkerDirectory,
                                              WorkerNotFoundError)
+from gpumounter_tpu.master.fleet import FleetAggregator
 from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.events import EVENTS
 from gpumounter_tpu.utils.errors import (CircuitOpenError, K8sApiError,
                                          PodNotFoundError, QueueFullError,
                                          QuotaExceededError, TopologyError)
@@ -129,13 +131,14 @@ _ROUTE_LABELS = (
 )
 _PLAIN_ROUTES = {"/healthz": "healthz", "/version": "version",
                  "/tracez": "tracez", "/brokerz": "brokerz",
+                 "/eventz": "eventz", "/fleetz": "fleetz",
                  "/addtpuslice": "addtpuslice",
                  "/removetpuslice": "removetpuslice"}
 # Pure introspection requests (and renew heartbeats) would drown the
 # mount traces in the ring buffer; they are measured (histogram) but not
 # stored.
-_UNTRACED_ROUTES = {"healthz", "version", "tracez", "brokerz", "renew",
-                    "unknown"}
+_UNTRACED_ROUTES = {"healthz", "version", "tracez", "brokerz", "eventz",
+                    "fleetz", "renew", "unknown"}
 
 
 def _route_label(path: str) -> str:
@@ -164,6 +167,29 @@ class MasterGateway:
         # the normal traced, breaker-guarded worker path.
         self.broker = broker or AttachBroker(kube)
         self.broker.bind(self._broker_detach)
+        # Telemetry plane: the SLO engine computes per-tenant burn rates
+        # from this process's registry; the fleet aggregator scrapes every
+        # worker's health port into the /fleetz cluster view and ticks the
+        # engine. serve() starts the loop; unit tests drive tick().
+        from gpumounter_tpu.utils.slo import SloEngine
+        self.slo = SloEngine()
+        try:
+            fleet_interval = float(os.environ.get(
+                consts.ENV_FLEET_INTERVAL_S, "5"))
+        except ValueError:
+            fleet_interval = 5.0
+        if fleet_interval <= 0:
+            # wait(0) never blocks: the loop would busy-spin a core and
+            # hammer every worker's health port with no pacing
+            logger.warning("%s=%r is not a valid scrape interval; "
+                           "using 1s", consts.ENV_FLEET_INTERVAL_S,
+                           fleet_interval)
+            fleet_interval = 1.0
+        self.fleet = FleetAggregator(
+            targets_fn=self._fleet_targets,
+            usage_fn=self.broker.leases.usage,
+            slo=self.slo,
+            tick_interval_s=fleet_interval)
         # gRPC target "ip:port" -> base URL of that worker's health/tracez
         # HTTP endpoint. The default follows the worker's fixed convention
         # (health on grpc_port + 1, worker/main.py HEALTH_PORT_OFFSET);
@@ -194,6 +220,17 @@ class MasterGateway:
                                             base_delay_s=0.05,
                                             max_delay_s=1.0,
                                             deadline_s=60.0)
+
+    def _fleet_targets(self) -> dict[str, str]:
+        """{node: worker health base URL} for the fleet aggregator —
+        the directory's gRPC targets mapped through the same health-port
+        convention the /tracez stitch uses."""
+        out = {}
+        for node, target in self.directory.targets().items():
+            base = self.worker_tracez_base(target)
+            if base:
+                out[node] = base
+        return out
 
     @staticmethod
     def _default_tracez_base(target: str) -> str | None:
@@ -329,10 +366,21 @@ class MasterGateway:
             # don't know — answer with JSON instead of dropping the socket
             status, payload = 502, {"result": "UnknownWorkerResult",
                                     "message": str(e)}
-        REGISTRY.gateway_requests.observe(time.monotonic() - t0, route=route)
+        # rid exemplar on the route histogram: a bad bucket links straight
+        # to its /tracez entry (introspection routes carry no trace)
+        REGISTRY.gateway_requests.observe(
+            time.monotonic() - t0, route=route,
+            exemplar={"rid": rid} if trace is not None else None)
         if trace is not None:
             trace.root.attrs.update(route=route, status=status)
             trace.finish(str(payload.get("result", status)))
+            if status >= 500:
+                # 5xx on a mount route is a lifecycle-visible failure the
+                # result counters alone can't correlate: log it into the
+                # event stream with the rid and the typed result
+                EVENTS.emit("request_error", rid=rid, route=route,
+                            status=status,
+                            result=str(payload.get("result", "")))
         # error paths especially need the id — they're what gets debugged
         payload.setdefault("request_id", rid)
         return status, payload
@@ -415,6 +463,19 @@ class MasterGateway:
             if method != "GET":
                 return self._method_not_allowed("GET", method, p)
             return 200, self.broker.snapshot()
+        if p == "/eventz":
+            if method != "GET":
+                return self._method_not_allowed("GET", method, p)
+            return 200, EVENTS.snapshot_from_query(query)
+        if p == "/fleetz":
+            if method != "GET":
+                return self._method_not_allowed("GET", method, p)
+            try:
+                limit = int((query.get("limit") or [64])[0])
+            except ValueError:
+                limit = 64
+            return 200, self.fleet.snapshot(
+                events_limit=max(1, min(512, limit)))
         return 404, {"result": "NoSuchRoute", "message": path}
 
     # -- /tracez: trace introspection + master↔worker stitching ----------------
@@ -441,10 +502,11 @@ class MasterGateway:
         traces = [json.loads(json.dumps(t)) for t in STORE.find(rid)
                   if (result is None or t["result"] == result)
                   and t["op"] not in self._WORKER_OPS]
-        errors: list[str] = []
-        worker_traces = self._fetch_worker_traces(traces, rid, errors)
+        failed: dict[str, str] = {}
+        worker_traces = self._fetch_worker_traces(traces, rid, failed)
+        errors = [f"worker {t}: {m}" for t, m in failed.items()]
         for trace in traces:
-            self._graft_worker_spans(trace, worker_traces)
+            self._graft_worker_spans(trace, worker_traces, failed)
         payload: dict = {"rid": rid, "traces": traces,
                          "worker_traces": len(worker_traces)}
         if errors:
@@ -457,7 +519,7 @@ class MasterGateway:
     _WORKER_OPS = ("attach", "detach", "status", "node_status")
 
     def _fetch_worker_traces(self, traces: list[dict], rid: str,
-                             errors: list[str]) -> list[dict]:
+                             failed: dict[str, str]) -> list[dict]:
         """GET /tracez?rid= from every worker the master traces name."""
         targets: list[str] = []
         for trace in traces:
@@ -479,7 +541,7 @@ class MasterGateway:
                 # stitch is best-effort, but only expected network/parse
                 # failures degrade silently — a coding bug must not
                 # vanish into "worker spans incomplete"
-                errors.append(f"worker {target}: {e}")
+                failed[target] = str(e)
                 continue
             for entry in remote.get("recent", []):
                 if entry.get("op") in self._WORKER_OPS \
@@ -490,7 +552,8 @@ class MasterGateway:
         return fetched
 
     def _graft_worker_spans(self, trace: dict,
-                            worker_traces: list[dict]) -> None:
+                            worker_traces: list[dict],
+                            failed: dict[str, str] | None = None) -> None:
         rpcs = _find_spans(trace.get("spans", {}), "rpc")
         if not rpcs:
             if worker_traces:
@@ -498,6 +561,7 @@ class MasterGateway:
             return
         for rpc in rpcs:
             rpc_worker = (rpc.get("attrs") or {}).get("worker")
+            grafted_before = len(rpc.get("children") or [])
             for worker in worker_traces:
                 # graft only under the rpc that actually talked to this
                 # worker — a retried request has two rpc spans, a slice
@@ -513,6 +577,28 @@ class MasterGateway:
                              worker=worker.get("worker"))
                 child["attrs"] = attrs
                 rpc.setdefault("children", []).append(child)
+            if failed and len(rpc.get("children") or []) == grafted_before:
+                # the worker half could not be fetched (health port down /
+                # unreachable): degrade, don't error — the master half of
+                # the tree still renders, annotated with the cause. The
+                # cause must be THIS rpc's worker's failure: with one
+                # worker down and another merely rotated out of its
+                # bounded store, quoting the global error list would
+                # point the operator at the wrong node's outage.
+                if rpc_worker:
+                    cause = failed.get(rpc_worker)
+                else:
+                    cause = "; ".join(f"worker {t}: {m}"
+                                      for t, m in failed.items())
+                if not cause:
+                    continue
+                cause = cause[:200]
+                rpc.setdefault("children", []).append({
+                    "name": "worker spans unavailable",
+                    "start_unix": rpc.get("start_unix"),
+                    "duration_ms": 0.0,
+                    "attrs": {"cause": cause},
+                })
 
     # -- multi-host slice transactions (BASELINE config 5) ---------------------
 
@@ -869,10 +955,15 @@ class MasterGateway:
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 if self.path == "/metrics":
-                    payload = REGISTRY.render_text().encode()
+                    # exemplars only under negotiated OpenMetrics — the
+                    # classic text exposition would fail a real
+                    # Prometheus scrape on the ` # {...}` suffix
+                    openmetrics, ctype = REGISTRY.negotiate(
+                        self.headers.get("Accept"))
+                    payload = REGISTRY.render_text(
+                        openmetrics=openmetrics).encode()
                     self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
@@ -913,8 +1004,35 @@ class MasterGateway:
                 max_conns=max_conns or int(os.environ.get(
                     consts.ENV_GATEWAY_MAX_CONNS, "1024")))
         # A serving master runs the broker's maintenance loop (lease
-        # expiry, gauge refresh); unit tests drive broker.tick() directly.
+        # expiry, gauge refresh) and the fleet aggregator's scrape loop
+        # (which also ticks the SLO engine); unit tests drive
+        # broker.tick() / fleet.tick() directly. The loops' lifetime is
+        # tied to the server's: shutting the front down stops them (an
+        # orphaned fleet loop would keep ticking the SLO engine against
+        # the process registry — and withdraw, on stop, the burn gauges
+        # it exported).
         self.broker.start()
+        self.fleet.start()
+        # Flight-recorder bundles written by this master carry the broker
+        # state (who held what when the anomaly fired). Registered HERE,
+        # symmetric with the removal in shutdown: a gateway constructed
+        # but never served must not park a provider on the process-global
+        # recorder (stale broker snapshots in later bundles, retained
+        # object graph).
+        from gpumounter_tpu.utils.flight import RECORDER
+        RECORDER.register_provider("broker", self.broker.snapshot)
+        orig_shutdown = server.shutdown
+
+        def shutdown_with_loops():
+            self.fleet.stop()
+            self.broker.stop()
+            # the process-global recorder must not snapshot a stopped
+            # broker into later bundles (or retain this gateway forever)
+            from gpumounter_tpu.utils.flight import RECORDER
+            RECORDER.unregister_provider("broker", self.broker.snapshot)
+            orig_shutdown()
+
+        server.shutdown = shutdown_with_loops
         logger.info("master gateway serving on %s:%d (%s front)", address,
                     server.server_port, front)
         return server

@@ -32,6 +32,7 @@ import time
 
 from gpumounter_tpu.k8s import objects
 from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.events import EVENTS
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 
@@ -144,6 +145,9 @@ class LeaseTable:
                 lease.rederived = False
             self._known_tenants.add(tenant)
         self.export_gauges()
+        EVENTS.emit("lease_record", rid=rid, tenant=tenant,
+                    namespace=namespace, pod=pod, chips=lease.chips,
+                    node=node, ttl_s=ttl_s)
         return lease
 
     def renew(self, namespace: str, pod: str, ttl_s: float) -> Lease:
@@ -155,7 +159,17 @@ class LeaseTable:
                                 if ttl_s > 0 else None)
             lease.renewals += 1
             lease.reap_failures = 0
-            return lease
+            first = lease.renewals == 1
+        # renewals are heartbeats: emitting every one would cycle the
+        # bounded event ring in minutes and evict the admit/preempt
+        # evidence it exists to hold (same reason the gateway keeps
+        # /renew out of the trace ring). The FIRST renewal proves the
+        # heartbeat path works; the running count lives in /brokerz.
+        if first:
+            EVENTS.emit("lease_renew", rid=lease.rid, tenant=lease.tenant,
+                        namespace=namespace, pod=pod, chips=lease.chips,
+                        ttl_s=ttl_s, renewals=lease.renewals)
+        return lease
 
     def release(self, namespace: str, pod: str,
                 uuids: list[str] | None = None) -> int:
@@ -182,12 +196,19 @@ class LeaseTable:
                 if lease.chips <= 0:
                     del self._leases[(namespace, pod)]
         self.export_gauges()
+        if released:
+            EVENTS.emit("lease_release", rid=lease.rid,
+                        tenant=lease.tenant, namespace=namespace,
+                        pod=pod, chips=released)
         return released
 
     def drop(self, namespace: str, pod: str) -> Lease | None:
         with self._lock:
             lease = self._leases.pop((namespace, pod), None)
         self.export_gauges()
+        if lease is not None:
+            EVENTS.emit("lease_drop", rid=lease.rid, tenant=lease.tenant,
+                        namespace=namespace, pod=pod, chips=lease.chips)
         return lease
 
     # -- read side -------------------------------------------------------------

@@ -407,6 +407,7 @@ class LiveStack:
         _HealthHandler.journal = None
         _HealthHandler.cache = None
         _HealthHandler.agent = None
+        self.gateway.fleet.stop()
         self.gateway.broker.stop()
         self.http_server.shutdown()
         self.health_server.shutdown()
@@ -419,13 +420,20 @@ class MultiNodeStack:
     ONE master — the multi-host slice topology (BASELINE config 5). Node i
     is ``node-i`` holding pod ``workload-i``."""
 
-    def __init__(self, hosts: list, n_chips=4):
+    def __init__(self, hosts: list, n_chips=4, health: bool = False):
         from gpumounter_tpu.master.discovery import WorkerDirectory
         from gpumounter_tpu.master.gateway import MasterGateway
         from gpumounter_tpu.worker.grpc_server import build_server
+        from gpumounter_tpu.worker.main import start_health_server
 
         self.rigs: list[WorkerRig] = []
         self.grpc_servers = []
+        # ``health=True``: each simulated worker gets its own real health
+        # sidecar (ephemeral port) serving ITS journal — what the master's
+        # fleet aggregator scrapes (the /eventz ring and /metrics registry
+        # are process-global, exactly like a LiveStack's).
+        self.health_servers = []
+        health_bases: dict[str, str] = {}
         self.master_kube = FakeKubeClient()
         for i, host in enumerate(hosts):
             rig = WorkerRig(host, n_chips=n_chips, node=f"node-{i}",
@@ -435,17 +443,31 @@ class MultiNodeStack:
             server.start()
             self.rigs.append(rig)
             self.grpc_servers.append(server)
+            if health:
+                hs = start_health_server(0, journal=rig.journal,
+                                         cache=rig.service.reads,
+                                         ready=True)
+                self.health_servers.append(hs)
+                health_bases[f"127.0.0.1:{port}"] = \
+                    f"http://127.0.0.1:{hs.server_port}"
             self.master_kube.put_pod(worker_pod(
                 f"node-{i}", "127.0.0.1", name=f"w{i}", grpc_port=port))
             self.master_kube.put_pod(rig.pod)
-        self.gateway = MasterGateway(self.master_kube,
-                                     WorkerDirectory(self.master_kube))
+        self.gateway = MasterGateway(
+            self.master_kube, WorkerDirectory(self.master_kube),
+            worker_tracez_base=(health_bases.get if health else None))
         self.http_server = self.gateway.serve(port=0, address="127.0.0.1")
         self.base = f"http://127.0.0.1:{self.http_server.server_port}"
 
     def close(self) -> None:
+        self.gateway.fleet.stop()
         self.gateway.broker.stop()
         self.http_server.shutdown()
+        for server in self.health_servers:
+            try:
+                server.shutdown()
+            except Exception:       # noqa: BLE001 — may be dead mid-test
+                pass
         for server in self.grpc_servers:
             server.stop(grace=0)
         for rig in self.rigs:

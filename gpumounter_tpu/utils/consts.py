@@ -137,6 +137,26 @@ DEFAULT_ENUM_CACHE_TTL_S = 5.0
 ENV_ATTACH_CACHE_TTL_S = "TPU_ATTACH_CACHE_TTL_S"
 DEFAULT_ATTACH_CACHE_TTL_S = 600.0
 
+# --- Telemetry plane (utils/events.py, master/fleet.py, utils/flight.py) ------
+# "1" (default): every attach/detach/admit/queue/preempt/lease/journal/
+# agent-fallback transition emits a structured lifecycle event into the
+# bounded in-memory ring served as GET /eventz. "0" disables emission
+# entirely (the bench A/B configuration).
+ENV_EVENTS = "TPU_EVENTS"
+# Optional JSONL sidecar file every event is appended to (post-mortems
+# that outlive the ring). Unset = ring only.
+ENV_EVENT_LOG = "TPU_EVENT_LOG"
+# Ring capacity (events), default 512.
+ENV_EVENT_RING = "TPU_EVENT_RING"
+# Flight recorder (utils/flight.py): directory correlated anomaly bundles
+# are atomically written to when a trigger fires (fast SLO burn,
+# agent-fallback burst, journal backlog, circuit open). Unset = disabled.
+ENV_FLIGHT_DIR = "TPU_FLIGHT_DIR"
+# Minimum seconds between bundles (rate limit), default 300.
+ENV_FLIGHT_INTERVAL_S = "TPU_FLIGHT_INTERVAL_S"
+# Fleet aggregator (master/fleet.py) scrape cadence, default 5 s.
+ENV_FLEET_INTERVAL_S = "TPU_FLEET_INTERVAL_S"
+
 # --- Master gateway front (master/httpfront.py) --------------------------------
 # "multiplexed" (default): bounded selector + worker-pool front with
 # HTTP/1.1 keep-alive and connection admission before thread allocation.

@@ -36,9 +36,22 @@ class Counter:
         with self._lock:
             return self._values.get(key, 0.0)
 
-    def render(self) -> Iterator[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} counter"
+    def series(self) -> dict[tuple[tuple[str, str], ...], float]:
+        """Every label set ever observed with its value (the SLO engine
+        discovers tenants from here)."""
+        with self._lock:
+            return dict(self._values)
+
+    def render(self, openmetrics: bool = False) -> Iterator[str]:
+        # OpenMetrics names the counter FAMILY without the _total suffix
+        # (samples keep it); a spec-strict OM parser rejects a family
+        # named *_total. The classic exposition keeps the historical
+        # family name == sample name.
+        family = self.name
+        if openmetrics and family.endswith("_total"):
+            family = family[:-len("_total")]
+        yield f"# HELP {family} {self.help}"
+        yield f"# TYPE {family} counter"
         with self._lock:
             items = list(self._values.items())
         for key, value in items:
@@ -61,8 +74,13 @@ class Histogram:
         self._total = 0
         self._observations: collections.deque[float] = collections.deque(
             maxlen=self.MAX_OBSERVATIONS)
+        # bucket index -> (labels, value, unix ts): the LAST exemplar that
+        # landed in that bucket (OpenMetrics semantics) — a bad
+        # gateway_request_seconds bucket links straight to its /tracez rid.
+        self._exemplars: dict[int, tuple[dict, float, float]] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: dict[str, str] | None = None) -> None:
         with self._lock:
             self._sum += value
             self._total += 1
@@ -70,8 +88,13 @@ class Histogram:
             for i, bound in enumerate(self.buckets):
                 if value <= bound:
                     self._counts[i] += 1
+                    if exemplar:
+                        self._exemplars[i] = (exemplar, value, time.time())
                     return
             self._counts[-1] += 1
+            if exemplar:
+                self._exemplars[len(self.buckets)] = (exemplar, value,
+                                                      time.time())
 
     def time(self) -> "_Timer":
         return _Timer(self)
@@ -91,17 +114,50 @@ class Histogram:
         with self._lock:
             return self._total
 
-    def render(self) -> Iterator[str]:
+    def count_le(self, bound: float) -> int:
+        """Cumulative observations in buckets whose upper bound is <=
+        ``bound`` — what the SLO engine diffs over windows to get
+        "fraction of requests under the latency objective". Rounding is
+        CONSERVATIVE: a bound between bucket boundaries excludes the
+        straddling bucket, over-reporting violations rather than hiding
+        them — SLO thresholds should sit on bucket boundaries (the
+        shipped ones do: 3.0 s / 30.0 s)."""
+        with self._lock:
+            cumulative = 0
+            for i, upper in enumerate(self.buckets):
+                if upper > bound:
+                    break
+                cumulative += self._counts[i]
+            return cumulative
+
+    @staticmethod
+    def _fmt_exemplar(ex: tuple[dict, float, float]) -> str:
+        labels, value, ts = ex
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        return f" # {{{inner}}} {_fmt_num(value)} {round(ts, 3)}"
+
+    def render(self, exemplars: bool = False) -> Iterator[str]:
+        """``exemplars=True`` appends the OpenMetrics ``# {...}`` suffix
+        to exemplar-bearing bucket lines. That syntax is NOT valid in the
+        classic ``text/plain; version=0.0.4`` exposition (a real
+        Prometheus would fail the WHOLE scrape on it), so it is emitted
+        only when the scraper negotiated OpenMetrics — see
+        Registry.render_text."""
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} histogram"
         with self._lock:
             cumulative = 0
             for i, bound in enumerate(self.buckets):
                 cumulative += self._counts[i]
+                suffix = (self._fmt_exemplar(self._exemplars[i])
+                          if exemplars and i in self._exemplars else "")
                 yield (f'{self.name}_bucket{{le="{_fmt_num(bound)}"}} '
-                       f"{cumulative}")
+                       f"{cumulative}{suffix}")
             cumulative += self._counts[-1]
-            yield f'{self.name}_bucket{{le="+Inf"}} {cumulative}'
+            last = len(self.buckets)
+            suffix = (self._fmt_exemplar(self._exemplars[last])
+                      if exemplars and last in self._exemplars else "")
+            yield f'{self.name}_bucket{{le="+Inf"}} {cumulative}{suffix}'
             yield f"{self.name}_sum {_fmt_num(self._sum)}"
             yield f"{self.name}_count {cumulative}"
 
@@ -137,8 +193,10 @@ class LabeledHistogram:
         with self._lock:
             return self._series.get(tuple(sorted(labels.items())))
 
-    def observe(self, value: float, **labels: str) -> None:
-        self._get(labels).observe(value)
+    def observe(self, value: float,
+                exemplar: dict[str, str] | None = None,
+                **labels: str) -> None:
+        self._get(labels).observe(value, exemplar=exemplar)
 
     def percentile(self, q: float, **labels: str) -> float:
         hist = self._peek(labels)
@@ -148,18 +206,22 @@ class LabeledHistogram:
         hist = self._peek(labels)
         return hist.count if hist is not None else 0
 
+    def count_le(self, bound: float, **labels: str) -> int:
+        hist = self._peek(labels)
+        return hist.count_le(bound) if hist is not None else 0
+
     def phases(self) -> list[dict[str, str]]:
         with self._lock:
             return [dict(key) for key in self._series]
 
-    def render(self) -> Iterator[str]:
+    def render(self, exemplars: bool = False) -> Iterator[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} histogram"
         with self._lock:
             items = sorted(self._series.items())
         for key, hist in items:
             labels = dict(key)
-            for line in hist.render():
+            for line in hist.render(exemplars=exemplars):
                 if line.startswith("#"):
                     continue
                 if not labels:
@@ -187,6 +249,45 @@ class _Timer:
 
     def __exit__(self, *exc) -> None:
         self._hist.observe(time.monotonic() - self._start)
+
+
+def parse_exposition(text: str) -> dict:
+    """Minimal parser for Prometheus text exposition: returns
+    {metric_name: {frozen label tuple: value}} for non-comment lines —
+    the read half of the format this module renders (the operator CLI's
+    doctor and the master's fleet aggregator both scrape with it).
+    Handles the standard optional trailing timestamp
+    (``name{labels} value timestamp_ms``) — the value is the FIRST token
+    after the name/labels, not the last — and OpenMetrics exemplars
+    (``... value # {rid="..."} exemplar_value ts``), which are stripped
+    before the label/value split (the exemplar's own ``}`` would
+    otherwise hijack the label rpartition)."""
+    out: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        line = line.split(" # ", 1)[0].rstrip()
+        labels = {}
+        if "{" in line:
+            name, _, rest = line.partition("{")
+            labelstr, _, tail = rest.rpartition("}")
+            for part in labelstr.split(","):
+                if "=" in part:
+                    k, _, v = part.partition("=")
+                    labels[k] = v.strip('"')
+            fields = tail.split()
+        else:
+            fields = line.split()
+            name, fields = fields[0], fields[1:]
+        if not fields:
+            continue
+        try:
+            out.setdefault(name, {})[tuple(sorted(labels.items()))] = \
+                float(fields[0])
+        except ValueError:
+            continue
+    return out
 
 
 def _fmt_labels(labels: dict[str, str]) -> str:
@@ -423,10 +524,13 @@ class Registry:
             "tpumounter_queue_oldest_age",
             "Age in seconds of the oldest queued attach request "
             "(0 = queue empty)")
-        self.queue_wait = Histogram(
+        # Labeled per tenant so the SLO engine can compute a per-tenant
+        # queue-wait burn rate; unlabeled PromQL aggregates keep working
+        # (sum without(tenant)).
+        self.queue_wait = LabeledHistogram(
             "tpumounter_queue_wait_seconds",
             "Time a contended attach spent queued in the broker before "
-            "completing or timing out")
+            "completing or timing out, by tenant")
         self.preemptions = Counter(
             "tpumounter_preemptions_total",
             "Live attachments detached by the broker to make room for a "
@@ -448,6 +552,43 @@ class Registry:
         self.tenant_quota_chips = Gauge(
             "tpumounter_tenant_quota_chips",
             "Configured chip quota by tenant (absent = unlimited)")
+        # Telemetry plane (utils/events.py): lifecycle events emitted into
+        # the bounded ring + optional JSONL, by kind — the rate view of
+        # the /eventz stream (admit/queue/preempt/lease/journal/attach/
+        # detach/agent-fallback transitions).
+        self.events_emitted = Counter(
+            "tpumounter_events_total",
+            "Lifecycle events emitted into the event log, by kind")
+        # SLO engine (utils/slo.py): error-budget burn rate per tenant and
+        # objective over each window ("5m"/"1h"). 1.0 = burning exactly
+        # the budget; doctor CRITs on fast burn (5m >= 14.4, the
+        # multiwindow paging threshold).
+        self.slo_burn_rate = Gauge(
+            "tpumounter_slo_burn_rate",
+            "Error-budget burn rate by tenant, slo and window "
+            "(1 = exactly consuming the budget; >=14.4 over 5m pages)")
+        # Flight recorder (utils/flight.py): correlated anomaly bundles
+        # written to TPU_FLIGHT_DIR, by trigger; suppressed = triggers
+        # swallowed by the rate limit (the anomaly was already captured).
+        self.flight_dumps = Counter(
+            "tpumounter_flight_dumps_total",
+            "Flight-recorder bundles written, by trigger")
+        # pre-seed every trigger: incidents are usually exactly ONE
+        # bundle (the 300 s rate limit), and increase() over a series
+        # that first appears at value 1 reads as 0 — the alert would
+        # silently miss each trigger's first-ever bundle
+        for trigger in ("fast_burn", "agent_fallback", "journal_backlog",
+                        "circuit_open"):
+            self.flight_dumps.inc(0.0, trigger=trigger)
+        self.flight_suppressed = Counter(
+            "tpumounter_flight_suppressed_total",
+            "Flight-recorder triggers suppressed by the rate limit")
+        self.flight_suppressed.inc(0.0)  # pre-seed: see orphans_reclaimed
+        # Fleet aggregator (master/fleet.py): workers by scrape health.
+        self.fleet_nodes = Gauge(
+            "tpumounter_fleet_nodes",
+            "Workers known to the master's fleet aggregator, by state "
+            "(fresh/stale)")
         # Identifies the build on every /metrics surface (standard
         # <name>_info pattern: constant 1, the payload is the label).
         from gpumounter_tpu import __version__
@@ -462,11 +603,37 @@ class Registry:
         single source for rendering and for the naming-convention lint."""
         return [m for m in vars(self).values() if hasattr(m, "render")]
 
-    def render_text(self) -> str:
+    # Content types the /metrics endpoints answer with: exemplars are
+    # only legal in the OpenMetrics syntax, so the classic exposition
+    # stays exemplar-free and a scraper opts in via its Accept header.
+    TEXT_CONTENT_TYPE = "text/plain; version=0.0.4"
+    OPENMETRICS_CONTENT_TYPE = \
+        "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+    def render_text(self, openmetrics: bool = False) -> str:
+        """Classic Prometheus exposition by default; ``openmetrics=True``
+        additionally carries the rid exemplars on histogram buckets and
+        the ``# EOF`` terminator (served when the scraper's Accept header
+        asks for application/openmetrics-text)."""
         lines: list[str] = []
         for metric in self.families():
-            lines.extend(metric.render())
+            if openmetrics and isinstance(metric, (Histogram,
+                                                   LabeledHistogram)):
+                lines.extend(metric.render(exemplars=True))
+            elif openmetrics and isinstance(metric, Counter):
+                lines.extend(metric.render(openmetrics=True))
+            else:
+                lines.extend(metric.render())
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
+
+    @classmethod
+    def negotiate(cls, accept: str | None) -> tuple[bool, str]:
+        """(openmetrics?, content type) from a request's Accept header."""
+        if accept and "application/openmetrics-text" in accept:
+            return True, cls.OPENMETRICS_CONTENT_TYPE
+        return False, cls.TEXT_CONTENT_TYPE
 
 
 REGISTRY = Registry()

@@ -206,6 +206,7 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = 0.0
         self._probe_in_flight = False
+        self._announced = False
         self._export()
 
     def _export(self) -> None:
@@ -243,14 +244,17 @@ class CircuitBreaker:
             self._state = self.CLOSED
             self._failures = 0
             self._probe_in_flight = False
+            self._announced = False
             self._export()
 
     def record_failure(self) -> None:
+        opened = False
         with self._lock:
             self._failures += 1
             if self._state == self.HALF_OPEN \
                     or self._failures >= self.failure_threshold:
                 if self._state != self.OPEN:
+                    opened = True
                     logger.warning(
                         "circuit for %s OPEN after %d consecutive "
                         "failure(s); failing fast for %.1fs", self.target,
@@ -258,7 +262,33 @@ class CircuitBreaker:
                 self._state = self.OPEN
                 self._opened_at = self._clock()
                 self._probe_in_flight = False
+                # announce the RISING edge only: every failed half-open
+                # probe re-enters here with state != OPEN, and a target
+                # down for an hour must not flood the event ring (or eat
+                # the flight rate-limit slot) with one circuit_open per
+                # reset_timeout_s — the outage is announced once until
+                # the circuit actually closes again
+                if opened and not self._announced:
+                    self._announced = True
+                else:
+                    opened = False
                 self._export()
+        if opened:
+            # outside the lock (the recorder snapshots stores that may
+            # themselves export circuit state)
+            self._announce_open()
+
+    def _announce_open(self) -> None:
+        """Lifecycle event + flight-recorder trigger on CLOSED→OPEN.
+        Overridable for the same reason as ``_export``: a breaker whose
+        opening is NOT an anomaly (the fleet's scrape breakers — a
+        telemetry miss, already surfaced as ``stale``) must not write a
+        flight bundle or flood the event ring with ``circuit_open``."""
+        from gpumounter_tpu.utils.events import EVENTS
+        from gpumounter_tpu.utils.flight import RECORDER
+        EVENTS.emit("circuit_open", target=self.target,
+                    failures=self._failures)
+        RECORDER.note("circuit_open", target=self.target)
 
 
 def call_with_retry(fn: Callable, *, policy: RetryPolicy,

@@ -46,6 +46,7 @@ import secrets
 import threading
 import time
 
+from gpumounter_tpu.utils.events import EVENTS
 from gpumounter_tpu.utils.log import get_logger
 
 logger = get_logger("worker.journal")
@@ -144,6 +145,10 @@ class AttachJournal:
         with self._lock:
             self._append(event)
             self._apply(event)
+        # every journal record is a lifecycle transition: paired event
+        # emission (tests/test_events_lint.py pins the pairing)
+        EVENTS.emit("journal_intent", rid=rid, namespace=namespace,
+                    pod=pod, chips=len(devices), jid=jid)
         return jid
 
     def _mark(self, jid: str, kind: str) -> None:
@@ -156,6 +161,10 @@ class AttachJournal:
                      "ts": round(time.time(), 3)}
             self._append(event)
             self._apply(event)
+            record = self._records.get(jid, {})
+        EVENTS.emit(f"journal_{kind}", rid=record.get("rid", ""),
+                    namespace=record.get("namespace", ""),
+                    pod=record.get("pod", ""), jid=jid)
 
     def record_detach(self, rid: str, namespace: str, pod: str,
                       devices: list[str], cause: str = "",
@@ -172,6 +181,9 @@ class AttachJournal:
         with self._lock:
             self._append(event)
             self._apply(event)
+        EVENTS.emit("journal_detach", rid=rid, namespace=namespace,
+                    pod=pod, chips=len(devices), jid=jid, cause=cause,
+                    force=force)
         return jid
 
     def commit(self, jid: str) -> None:

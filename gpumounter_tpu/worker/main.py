@@ -38,14 +38,31 @@ class _HealthHandler(BaseHTTPRequestHandler):
     journal = None     # AttachJournal, set by main() when journaling is on
     cache = None       # PodCacheReads, set by main() (informer handle)
     agent = None       # ResidentActuationAgent, set when the agent is on
+    events = None      # EventLog override; None = the process singleton
 
     def log_message(self, *args):
         pass
 
     def do_GET(self):
         if self.path == "/metrics":
-            body = REGISTRY.render_text().encode()
-            ctype = "text/plain; version=0.0.4"
+            # exemplars only when the scraper negotiated OpenMetrics —
+            # they are a parse error in the classic text exposition
+            openmetrics, ctype = REGISTRY.negotiate(
+                self.headers.get("Accept"))
+            body = REGISTRY.render_text(openmetrics=openmetrics).encode()
+            code = 200
+        elif self.path.split("?", 1)[0] == "/eventz":
+            # lifecycle event tail: every attach/detach/journal/pool/
+            # agent transition on this node, cursor-paginated by seq —
+            # what the master's fleet aggregator tails per tick
+            import json
+            import urllib.parse
+            from gpumounter_tpu.utils.events import EVENTS
+            params = urllib.parse.parse_qs(
+                urllib.parse.urlparse(self.path).query)
+            log = type(self).events or EVENTS
+            body = json.dumps(log.snapshot_from_query(params)).encode()
+            ctype = "application/json"
             code = 200
         elif self.path.split("?", 1)[0] == "/tracez":
             # recent + slowest completed traces (span trees), filterable
@@ -116,8 +133,21 @@ class _HealthHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
 
-def start_health_server(port: int) -> ThreadingHTTPServer:
-    server = ThreadingHTTPServer(("0.0.0.0", port), _HealthHandler)
+def start_health_server(port: int, **state) -> ThreadingHTTPServer:
+    """Serve the health/metrics/introspection sidecar. ``state`` keys
+    (``journal``/``cache``/``pool``/``agent``/``events``/``ready``)
+    override the module-level handler attributes for THIS server only —
+    multi-worker test stacks give each simulated node its own journal and
+    event log behind its own port; production (and existing rigs) keep
+    setting the ``_HealthHandler`` class attributes directly."""
+    handler = _HealthHandler
+    if state:
+        unknown = set(state) - {"journal", "cache", "pool", "agent",
+                                "events", "ready"}
+        if unknown:
+            raise TypeError(f"unknown health-server state: {unknown}")
+        handler = type("_ScopedHealthHandler", (_HealthHandler,), state)
+    server = ThreadingHTTPServer(("0.0.0.0", port), handler)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     return server
 
@@ -198,6 +228,9 @@ def main() -> None:
     _HealthHandler.journal = service.journal
     _HealthHandler.cache = service.reads
     if service.journal is not None:
+        # flight-recorder bundles on this node carry the journal tail
+        from gpumounter_tpu.utils.flight import RECORDER
+        RECORDER.register_provider("journal", service.journal.snapshot)
         # BEFORE serving: a crash mid-attach must be repaired before new
         # requests can race the leftover state
         outcomes = service.replay_journal()

@@ -36,6 +36,7 @@ from gpumounter_tpu.allocator.allocator import is_unschedulable
 from gpumounter_tpu.k8s import objects
 from gpumounter_tpu.k8s.informer import PodCacheReads
 from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.events import EVENTS
 from gpumounter_tpu.utils.errors import K8sApiError, PodNotFoundError
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
@@ -221,6 +222,11 @@ class PoolManager:
         REGISTRY.pool_hits.inc(len(claimed))
         REGISTRY.pool_misses.inc(count - len(claimed))
         if claimed:
+            EVENTS.emit("pool_adopt", rid=request_id or txn_id,
+                        namespace=objects.namespace(owner),
+                        pod=objects.name(owner),
+                        node=self.settings.node_name,
+                        adopted=len(claimed), requested=count, key=key)
             logger.debug("adopted %d/%d warm pod(s) %s for %s/%s",
                         len(claimed), count, claimed,
                         objects.namespace(owner), objects.name(owner))
@@ -307,6 +313,9 @@ class PoolManager:
                     break
                 created.append(objects.name(spec))
                 create_t0[objects.name(spec)] = time.monotonic()
+        if created or deleted:
+            EVENTS.emit("pool_refill", node=self.settings.node_name,
+                        created=len(created), deleted=len(deleted))
         if created:
             self._await_running(created, create_t0)
         self._refresh_gauge()

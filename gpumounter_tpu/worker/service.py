@@ -39,6 +39,8 @@ from gpumounter_tpu.utils.errors import (AllocationTimeoutError,
                                          InsufficientTPUError,
                                          MountPolicyError, PodNotFoundError,
                                          TPUMounterError)
+from gpumounter_tpu.utils.events import EVENTS
+from gpumounter_tpu.utils.flight import RECORDER
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 from gpumounter_tpu.utils.trace import Trace, annotate
@@ -184,23 +186,26 @@ class TPUMountService:
         trace = Trace("attach", request_id or txn_id)
         trace.root.attrs.update(pod=f"{namespace}/{pod_name}",
                                 tpus=tpu_num, entire=is_entire_mount)
+        rid = request_id or txn_id
         result_name = "EXCEPTION"
+        chips_granted = 0
+        t0 = time.monotonic()
         try:
-            with REGISTRY.attach_latency.time():
-                # lock order: request fence, then pod mutation lock
-                if request_id:
-                    with self._request_lock(namespace, pod_name,
-                                            request_id), \
-                            self._pod_lock(namespace, pod_name):
-                        outcome = self._add_tpu(pod_name, namespace, tpu_num,
-                                                is_entire_mount, txn_id,
-                                                request_id, trace=trace)
-                else:
-                    with self._pod_lock(namespace, pod_name):
-                        outcome = self._add_tpu(pod_name, namespace, tpu_num,
-                                                is_entire_mount, txn_id,
-                                                request_id, trace=trace)
+            # lock order: request fence, then pod mutation lock
+            if request_id:
+                with self._request_lock(namespace, pod_name,
+                                        request_id), \
+                        self._pod_lock(namespace, pod_name):
+                    outcome = self._add_tpu(pod_name, namespace, tpu_num,
+                                            is_entire_mount, txn_id,
+                                            request_id, trace=trace)
+            else:
+                with self._pod_lock(namespace, pod_name):
+                    outcome = self._add_tpu(pod_name, namespace, tpu_num,
+                                            is_entire_mount, txn_id,
+                                            request_id, trace=trace)
             result_name = outcome.result.name
+            chips_granted = len(outcome.chips)
             trace.root.attrs.update(chips=len(outcome.chips),
                                     pool_hits=outcome.pool_hits,
                                     pool_misses=outcome.pool_misses)
@@ -210,12 +215,21 @@ class TPUMountService:
             result_name = "POLICY_DENIED"
             raise
         finally:
+            # the rid exemplar links a bad latency bucket straight to its
+            # /tracez entry
+            REGISTRY.attach_latency.observe(
+                time.monotonic() - t0,
+                exemplar={"rid": rid} if rid else None)
             # emitted on failure too — the phase breakdown of an attach
             # that threw is when the decomposition matters most; the result
             # counter rides the same path so counters, trace lines and
             # phase histograms agree on request volume
             trace.finish(result_name, REGISTRY.attach_phase)
             REGISTRY.attach_results.inc(result=result_name)
+            EVENTS.emit("attach", rid=rid, namespace=namespace,
+                        pod=pod_name, node=self.settings.node_name,
+                        chips=chips_granted, result=result_name,
+                        entire=is_entire_mount)
         return outcome
 
     def _add_tpu(self, pod_name: str, namespace: str, tpu_num: int,
@@ -330,6 +344,12 @@ class TPUMountService:
                     self.journal.revert(jid)
                 else:
                     self.journal.revert_pending(jid)
+                    # incomplete actuation state is now parked on the
+                    # node: a flight-recorder trigger (the bundle carries
+                    # this rid's events, traces and the journal tail)
+                    RECORDER.note("journal_backlog",
+                                  rid=request_id or txn_id,
+                                  backlog=self.journal.backlog())
             self._forget_attachment(namespace, pod_name)
             self._record_event(pod, "TPUAttachFailed",
                                f"actuation failed, rolled back: {e}",
@@ -375,18 +395,25 @@ class TPUMountService:
                                 uuids=len(uuids), force=force)
         if cause:
             trace.root.attrs["cause"] = cause
+        rid = request_id or txn_id
         result_name = "EXCEPTION"
+        t0 = time.monotonic()
         try:
-            with REGISTRY.detach_latency.time():
-                with self._pod_lock(namespace, pod_name):
-                    outcome = self._remove_tpu(pod_name, namespace, uuids,
-                                               force, txn_id, trace=trace,
-                                               request_id=request_id,
-                                               cause=cause)
+            with self._pod_lock(namespace, pod_name):
+                outcome = self._remove_tpu(pod_name, namespace, uuids,
+                                           force, txn_id, trace=trace,
+                                           request_id=request_id,
+                                           cause=cause)
             result_name = outcome.result.name
         finally:
+            REGISTRY.detach_latency.observe(
+                time.monotonic() - t0,
+                exemplar={"rid": rid} if rid else None)
             trace.finish(result_name, REGISTRY.detach_phase)
             REGISTRY.detach_results.inc(result=result_name)
+            EVENTS.emit("detach", rid=rid, namespace=namespace,
+                        pod=pod_name, node=self.settings.node_name,
+                        result=result_name, cause=cause, force=force)
         return outcome
 
     def _remove_tpu(self, pod_name: str, namespace: str, uuids: list[str],
@@ -710,10 +737,20 @@ class TPUMountService:
                 outcome = "failed"
             outcomes[outcome] += 1
             REGISTRY.journal_replays.inc(outcome=outcome)
+            EVENTS.emit("journal_replay", rid=record.get("rid", ""),
+                        namespace=record.get("namespace", ""),
+                        pod=record.get("pod", ""),
+                        node=self.settings.node_name,
+                        jid=record.get("jid"), outcome=outcome)
             logger.info("journal replay %s (%s/%s devices=%s): %s",
                         record.get("jid"), record.get("namespace"),
                         record.get("pod"), record.get("devices"), outcome)
         self.journal.compact()
+        if self.journal.backlog():
+            # replay could not resolve everything (busy devices, apiserver
+            # trouble): incomplete actuation state remains — capture it
+            RECORDER.note("journal_backlog",
+                          backlog=self.journal.backlog())
         return dict(outcomes)
 
     def _replay_record(self, record: dict) -> str:

@@ -187,6 +187,7 @@ def test_http_surface_retry_after_header_and_allow(stack_factory):
         assert err.value.code == 405
         assert err.value.headers["Allow"] == "GET"
     finally:
+        stack.gateway.fleet.stop()       # serve() started it too
         stack.gateway.broker.stop()
         server.shutdown()
 
@@ -246,7 +247,7 @@ def test_contended_attach_queues_then_completes(stack_factory):
     status, body = done["res"]
     assert status == 200 and body["result"] == "SUCCESS"
     assert body["queued_s"] >= 0.0
-    assert REGISTRY.queue_wait.count >= 1
+    assert REGISTRY.queue_wait.count(tenant="default") >= 1
     assert REGISTRY.admission_decisions.value(
         tenant="default", outcome="granted_queued") >= 1
     assert_broker_invariants(gw.broker, stack.rig.sim)

@@ -199,18 +199,51 @@ def test_exposition_round_trip_registry_to_parser():
     reg.attach_phase.observe(0.2, phase="allocate")
     reg.attach_phase.observe(0.05, phase="actuate")
     reg.detach_phase.observe(0.1, phase="cleanup")
-    reg.gateway_requests.observe(0.4, route="addtpu")
+    # exemplar-bearing series (ISSUE 7): the rid exemplar rides the
+    # bucket line after ` # ` and must NOT disturb value parsing
+    reg.gateway_requests.observe(0.4, route="addtpu",
+                                 exemplar={"rid": "deadbeef0001"})
+    reg.attach_latency.observe(0.31, exemplar={"rid": "deadbeef0002"})
     reg.k8s_latency.observe(0.02, verb="GET", resource="pods")
     reg.k8s_errors.inc(verb="LIST", resource="pods")
+    # telemetry-plane families: lifecycle event counter, tenant-labeled
+    # queue wait, SLO burn gauge, flight counters, fleet gauge
+    reg.events_emitted.inc(kind="attach")
+    reg.events_emitted.inc(3, kind="lease_record")
+    reg.queue_wait.observe(2.5, tenant="teamA")
+    reg.slo_burn_rate.set(1.25, tenant="teamA", slo="attach_success",
+                          window="5m")
+    reg.flight_dumps.inc(trigger="fast_burn")
+    reg.fleet_nodes.set(3, state="fresh")
 
-    text = reg.render_text()
+    # classic exposition: NO exemplars (the ` # {...}` suffix is a parse
+    # error for a real Prometheus scraping text/plain; version=0.0.4) —
+    # they appear only in the negotiated OpenMetrics rendering
+    plain = reg.render_text()
+    assert " # {" not in plain and "deadbeef0001" not in plain
+    text = reg.render_text(openmetrics=True)
     parsed = cli._parse_exposition(text)
+    # the exemplars rendered (and will be stripped by the parser)
+    assert 'deadbeef0001' in text and " # {" in text
+    assert text.rstrip().endswith("# EOF")
+    # OpenMetrics names counter FAMILIES without the _total suffix
+    # (samples keep it); classic exposition keeps the historical
+    # family name == sample name
+    assert "# TYPE tpumounter_events counter" in text
+    assert "# TYPE tpumounter_events_total counter" not in text
+    assert "tpumounter_events_total{" in text     # samples unchanged
+    assert "# TYPE tpumounter_events_total counter" in plain
+    # both renderings parse to the same series values
+    assert cli._parse_exposition(plain)[
+        "tpumounter_gateway_request_seconds_bucket"] == parsed[
+        "tpumounter_gateway_request_seconds_bucket"]
 
     reproduced = 0
     for line in text.splitlines():
         if not line or line.startswith("#"):
             continue
-        name = line.partition("{")[0].split()[0]
+        line = line.split(" # ", 1)[0].rstrip()   # exemplar-aware, like
+        name = line.partition("{")[0].split()[0]  # the parser itself
         value = float(line.rsplit(" ", 1)[1])
         labels = {}
         if "{" in line:
@@ -232,6 +265,19 @@ def test_exposition_round_trip_registry_to_parser():
                                   0.5, phase="allocate")
     assert p50 is not None and 0 < p50 <= 0.25
     assert parsed["tpumounter_build_info"]
+    # telemetry-plane round trips
+    assert cli._counter_total(parsed, "tpumounter_events_total") == 4
+    assert parsed["tpumounter_slo_burn_rate"][
+        (("slo", "attach_success"), ("tenant", "teamA"),
+         ("window", "5m"))] == 1.25
+    assert cli._counter_total(parsed, "tpumounter_flight_dumps_total",
+                              trigger="fast_burn") == 1
+    assert cli._histogram_quantile(
+        parsed, "tpumounter_queue_wait_seconds", 0.5,
+        tenant="teamA") is not None
+    # the exemplar-bearing bucket parsed to its exact cumulative count
+    assert parsed["tpumounter_gateway_request_seconds_bucket"][
+        (("le", "0.5"), ("route", "addtpu"))] == 1
 
 
 def test_doctor_reports_version_and_slowest_trace(live_stack):

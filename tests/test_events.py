@@ -1,0 +1,253 @@
+"""Lifecycle event log (utils/events.py): ring semantics, the /eventz
+``since`` cursor contract, emit-site integration through a real attach/
+detach, and the chaos guarantee — sequence numbers stay gap-free across a
+worker crash/replay."""
+
+import json
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.testing.sim import LiveStack, WorkerRig
+from gpumounter_tpu.utils.events import EVENTS, EventLog
+
+
+# -- EventLog unit semantics ---------------------------------------------------
+
+def test_emit_assigns_consecutive_seqs_and_fields():
+    log = EventLog(ring_size=16)
+    s1 = log.emit("attach", rid="r1", namespace="default", pod="w",
+                  chips=4, result="SUCCESS")
+    s2 = log.emit("detach", rid="r2")
+    assert s2 == s1 + 1
+    events, latest, dropped = log.since(0)
+    assert latest == s2 and dropped == 0
+    assert [e["kind"] for e in events] == ["attach", "detach"]
+    first = events[0]
+    assert first["rid"] == "r1" and first["pod"] == "w"
+    assert first["chips"] == 4
+    assert first["attrs"] == {"result": "SUCCESS"}
+    # empty correlation fields are skipped, not serialized as ""
+    assert "tenant" not in first and "node" not in first
+
+
+def test_since_cursor_returns_only_newer_events():
+    log = EventLog(ring_size=16)
+    log.emit("a")
+    cursor = log.emit("b")
+    log.emit("c")
+    events, latest, dropped = log.since(cursor)
+    assert [e["kind"] for e in events] == ["c"]
+    assert latest == cursor + 1 and dropped == 0
+    # caught-up cursor: empty, no drop signal
+    events, _, dropped = log.since(latest)
+    assert events == [] and dropped == 0
+
+
+def test_ring_rotation_reports_dropped_count():
+    log = EventLog(ring_size=16)      # floor-clamped sizes stay >= 16
+    seqs = [log.emit(f"k{i}") for i in range(40)]
+    events, latest, dropped = log.since(0)
+    assert len(events) == 16
+    assert latest == seqs[-1]
+    assert dropped == seqs[-1] - 16           # everything that rotated out
+    # a cursor inside the retained window sees a complete tail
+    events, _, dropped = log.since(seqs[-1] - 5)
+    assert len(events) == 5 and dropped == 0
+
+
+def test_since_limit_keeps_oldest_for_pagination():
+    """A page-limited read returns the OLDEST unseen events so a cursor
+    reader can advance to the last returned seq and fetch the rest —
+    newest-first truncation would silently skip the middle."""
+    log = EventLog(ring_size=64)
+    seqs = [log.emit(f"k{i}") for i in range(10)]
+    page, latest, dropped = log.since(0, limit=4)
+    assert [e["seq"] for e in page] == seqs[:4]
+    assert latest == seqs[-1] and dropped == 0
+    page2, _, _ = log.since(page[-1]["seq"], limit=4)
+    assert [e["seq"] for e in page2] == seqs[4:8]
+
+
+def test_disabled_log_emits_nothing():
+    log = EventLog(enabled=False)
+    assert log.emit("attach", rid="r") == 0
+    assert log.snapshot() == {"enabled": False, "boot": log.boot,
+                              "seq": 0, "since": 0,
+                              "truncated": False, "dropped": 0,
+                              "events": []}
+
+
+def test_jsonl_sidecar_appends_every_event(tmp_path):
+    path = tmp_path / "events" / "log.jsonl"
+    log = EventLog(path=str(path))
+    log.emit("attach", rid="r1")
+    log.emit("detach", rid="r2")
+    # emit only buffers for the background drain thread (the hot path
+    # never touches disk); flush() gives tests synchronous visibility
+    log.flush()
+    lines = [json.loads(line) for line in
+             path.read_text().strip().splitlines()]
+    assert [e["kind"] for e in lines] == ["attach", "detach"]
+    assert lines[0]["seq"] == lines[1]["seq"] - 1
+
+
+def test_emit_feeds_the_event_counter():
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    before = REGISTRY.events_emitted.value(kind="unit_test_kind")
+    EVENTS.emit("unit_test_kind")
+    assert REGISTRY.events_emitted.value(kind="unit_test_kind") \
+        == before + 1
+
+
+# -- emit-site integration through a real attach -------------------------------
+
+@pytest.fixture
+def rig(fake_host):
+    r = WorkerRig(fake_host, use_kubelet_socket=False)
+    yield r
+    r.close()
+
+
+def _kinds_since(cursor, rid=None):
+    events, _, _ = EVENTS.since(cursor)
+    return [e["kind"] for e in events
+            if rid is None or e.get("rid") == rid]
+
+
+def test_attach_detach_emit_correlated_lifecycle_events(rig):
+    _, cursor, _ = EVENTS.since(0)
+    outcome = rig.service.add_tpu("workload", "default", 2, True,
+                                  request_id="rid-events-1")
+    assert outcome.result.name == "SUCCESS"
+    kinds = _kinds_since(cursor, rid="rid-events-1")
+    # journal write-ahead + the attach itself, all carrying the SAME rid
+    assert kinds.count("journal_intent") == 1
+    assert kinds.count("journal_commit") == 1
+    assert kinds[-1] == "attach"
+    events, _, _ = EVENTS.since(cursor)
+    attach = [e for e in events if e["kind"] == "attach"][-1]
+    assert attach["rid"] == "rid-events-1"
+    assert attach["chips"] == 2
+    assert attach["attrs"]["result"] == "SUCCESS"
+
+    _, cursor, _ = EVENTS.since(0)
+    rig.service.remove_tpu("workload", "default", [], False,
+                           request_id="rid-events-2")
+    kinds = _kinds_since(cursor, rid="rid-events-2")
+    assert "journal_detach" in kinds and "detach" in kinds
+
+
+# -- /eventz endpoints ---------------------------------------------------------
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_eventz_served_on_worker_health_port_and_master(fake_host):
+    stack = LiveStack(WorkerRig(fake_host, use_kubelet_socket=False))
+    try:
+        _, cursor, _ = EVENTS.since(0)
+        with urllib.request.urlopen(
+                f"{stack.base}/addtpu/namespace/default/pod/workload"
+                f"/tpu/1/isEntireMount/false", timeout=30) as resp:
+            assert json.loads(resp.read())["result"] == "SUCCESS"
+        health = f"http://127.0.0.1:{stack.health_server.server_port}"
+        payload = _get_json(f"{health}/eventz?since={cursor}")
+        assert payload["enabled"] and payload["seq"] > cursor
+        kinds = [e["kind"] for e in payload["events"]]
+        assert "attach" in kinds
+        # cursor contract over HTTP: asking again from the latest seq
+        # returns nothing new
+        again = _get_json(f"{health}/eventz?since={payload['seq']}")
+        assert again["events"] == [] and again["dropped"] == 0
+        # the master serves the same stream (shared process in this stack)
+        master = _get_json(f"{stack.base}/eventz?since={cursor}&limit=500")
+        assert "attach" in [e["kind"] for e in master["events"]]
+    finally:
+        stack.close()
+
+
+# -- chaos: gap-free sequencing across worker crash/replay ---------------------
+
+def test_event_seqs_gap_free_across_worker_crash_and_replay(fake_host):
+    from gpumounter_tpu.testing.chaos import ChaosRig, WorkerCrash
+    chaos = ChaosRig(fake_host)
+    try:
+        _, cursor, _ = EVENTS.since(0)
+        chaos.arm_crash("before_commit")
+        with pytest.raises(WorkerCrash):
+            chaos.rig.service.add_tpu("workload", "default", 2, True,
+                                      request_id="rid-crash")
+        outcomes = chaos.restart_worker()
+        assert sum(outcomes.values()) >= 1
+        events, _, dropped = EVENTS.since(cursor)
+        assert dropped == 0
+        seqs = [e["seq"] for e in events]
+        assert seqs == list(range(seqs[0], seqs[0] + len(seqs))), \
+            f"gapped seqs across crash/replay: {seqs}"
+        kinds = [e["kind"] for e in events]
+        # the intent survived the crash, the replay resolved it — all on
+        # one consecutive sequence
+        assert "journal_intent" in kinds
+        assert "journal_replay" in kinds
+    finally:
+        chaos.close()
+
+
+def test_sidecar_write_race_with_disabled_path_is_silent(tmp_path):
+    """A drain races the sidecar going unwritable: it picks up buffered
+    lines, then finds ``path = None`` under the file lock (another drain
+    hit OSError and disabled the sidecar) — it must return silently,
+    never raise into the attach path."""
+    log = EventLog(ring_size=16, path=str(tmp_path / "ev.jsonl"))
+    log.emit("attach", rid="r1")
+    # the race, made deterministic: another drain hit OSError and
+    # disabled the sidecar between our buffer pickup and the lock
+    log.path = None
+    log._file = None
+    log.flush()                                      # no TypeError
+    assert log.emit("detach", rid="r2") > 0          # hot path unharmed
+
+
+def test_truncated_page_reports_last_returned_seq_and_flag():
+    """A truncated /eventz page must hand the reader a cursor it can
+    re-baseline from: top-level ``seq`` is the last RETURNED seq (not the
+    ring's newest) and ``truncated`` says more pages are pending —
+    draining by re-polling ``since=<seq>`` sees every event in order."""
+    log = EventLog(ring_size=64)
+    first = log.emit("k0")
+    for i in range(1, 10):
+        log.emit(f"k{i}")
+    latest = first + 9
+    page = log.snapshot(since=0, limit=4)
+    assert page["truncated"] is True
+    assert page["seq"] == page["events"][-1]["seq"] < latest
+    # drain by the documented contract: cursor = payload seq, re-poll
+    seen, cursor = [], 0
+    for _ in range(10):
+        page = log.snapshot(since=cursor, limit=4)
+        seen.extend(e["seq"] for e in page["events"])
+        cursor = page["seq"]
+        if not page["truncated"]:
+            break
+    assert seen == list(range(first, latest + 1))     # nothing skipped
+    assert page["seq"] == latest
+
+
+def test_limit_zero_page_holds_the_cursor():
+    """``limit=0`` returns an empty page but must NOT advance the
+    reader's cursor: ``seq`` stays at ``since`` and ``truncated`` says
+    events are pending — re-baselining to the ring's newest here would
+    skip every withheld event while reporting dropped=0."""
+    log = EventLog(ring_size=16)
+    cursor = log.emit("k0")
+    log.emit("k1")
+    page = log.snapshot(since=cursor, limit=0)
+    assert page["events"] == []
+    assert page["truncated"] is True
+    assert page["seq"] == cursor and page["dropped"] == 0
+    # a caught-up reader with limit=0 is NOT truncated — nothing pending
+    page = log.snapshot(since=cursor + 1, limit=0)
+    assert page["truncated"] is False and page["seq"] == cursor + 1

@@ -1,0 +1,123 @@
+"""AST lint: no silent lifecycle transitions.
+
+The telemetry plane's value is completeness — an operator reading
+``/eventz`` must be able to trust that every journal record and every
+broker admission outcome produced an event. These lints walk the source
+so a future journal record kind or admission outcome can't ship without
+its paired emission:
+
+1. every :class:`AttachJournal` method that appends a journal record
+   (``begin`` / ``_mark`` / ``record_detach``) calls ``EVENTS.emit``;
+2. every ``REGISTRY.admission_decisions.inc(...)`` call-site in
+   ``master/admission.py`` lives in a function that also emits an event
+   (the decision stream and the counter must agree on volume);
+3. the preemption and lease-expiry reclaim paths emit too.
+"""
+
+import ast
+import os
+
+import gpumounter_tpu
+
+_PKG = os.path.dirname(gpumounter_tpu.__file__)
+
+
+def _parse(rel_path):
+    path = os.path.join(_PKG, rel_path)
+    with open(path) as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def _functions(tree):
+    """Every function/method in the module, by name (qualified with the
+    class name for methods)."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    out[f"{node.name}.{item.name}"] = item
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def _calls_attr(func_node, attr, base=None):
+    """Does the function body contain a call to ``<base>.<attr>(...)``
+    (any base when ``base`` is None)?"""
+    for node in ast.walk(func_node):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr == attr):
+            continue
+        if base is None:
+            return True
+        value = fn.value
+        if isinstance(value, ast.Name) and value.id == base:
+            return True
+        if isinstance(value, ast.Attribute) and value.attr == base:
+            return True
+    return False
+
+
+def _emits_event(func_node):
+    return _calls_attr(func_node, "emit", base="EVENTS")
+
+
+def test_every_journal_record_writer_emits_an_event():
+    funcs = _functions(_parse("worker/journal.py"))
+    writers = ["AttachJournal.begin", "AttachJournal._mark",
+               "AttachJournal.record_detach"]
+    for name in writers:
+        assert name in funcs, f"{name} vanished — update this lint"
+        assert _emits_event(funcs[name]), \
+            f"{name} appends a journal record without emitting a " \
+            "lifecycle event (silent transition)"
+    # completeness: any OTHER method that calls _append must be one of
+    # the known writers (or the writers' shared helper set) — a new
+    # record kind can't bypass the emission requirement
+    for name, node in funcs.items():
+        if not name.startswith("AttachJournal."):
+            continue
+        if _calls_attr(node, "_append"):
+            assert name in writers + ["AttachJournal._load"], \
+                f"{name} writes journal records but is not covered by " \
+                "the event-emission lint — pair it with EVENTS.emit " \
+                "and add it here"
+
+
+def test_every_admission_outcome_emits_an_event():
+    tree = _parse("master/admission.py")
+    funcs = _functions(tree)
+    offenders = []
+    for name, node in funcs.items():
+        has_decision = False
+        for call in ast.walk(node):
+            if (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "inc"
+                    and isinstance(call.func.value, ast.Attribute)
+                    and call.func.value.attr == "admission_decisions"):
+                has_decision = True
+        if has_decision and not _emits_event(node):
+            offenders.append(name)
+    assert not offenders, \
+        f"admission outcomes recorded without a paired lifecycle " \
+        f"event in: {offenders}"
+
+
+def test_reclaim_paths_emit_events():
+    funcs = _functions(_parse("master/admission.py"))
+    for name in ("AttachBroker._try_preempt", "AttachBroker._reap"):
+        assert name in funcs, f"{name} vanished — update this lint"
+        assert _emits_event(funcs[name]), \
+            f"{name} reclaims chips without emitting a lifecycle event"
+
+
+def test_attach_and_detach_completions_emit_events():
+    funcs = _functions(_parse("worker/service.py"))
+    for name in ("TPUMountService.add_tpu", "TPUMountService.remove_tpu"):
+        assert _emits_event(funcs[name]), \
+            f"{name} completes without emitting a lifecycle event"

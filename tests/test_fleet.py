@@ -1,0 +1,261 @@
+"""Fleet aggregator (master/fleet.py): merged cluster view over every
+worker's health port, per-worker scrape breakers, and the acceptance
+contract — a killed worker degrades to ``stale`` within ONE tick while
+healthy nodes keep getting scraped."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.master.fleet import FleetAggregator
+from gpumounter_tpu.testing.sim import MultiNodeStack
+from gpumounter_tpu.utils.events import EVENTS
+from gpumounter_tpu.worker.main import start_health_server
+
+
+@pytest.fixture
+def two_workers():
+    servers = [start_health_server(0, ready=True) for _ in range(2)]
+    bases = {f"node-{i}": f"http://127.0.0.1:{s.server_port}"
+             for i, s in enumerate(servers)}
+    yield servers, bases
+    for server in servers:
+        try:
+            server.shutdown()
+        except Exception:   # noqa: BLE001 — one is dead mid-test
+            pass
+
+
+def test_tick_scrapes_every_worker_fresh(two_workers):
+    _, bases = two_workers
+    fleet = FleetAggregator(lambda: bases, usage_fn=lambda: {"teamA": 4},
+                            scrape_timeout_s=2.0)
+    states = fleet.tick()
+    assert states == {"node-0": "fresh", "node-1": "fresh"}
+    snap = fleet.snapshot()
+    assert snap["ticks"] == 1
+    assert snap["tenants"] == {"teamA": 4}
+    for node in ("node-0", "node-1"):
+        record = snap["nodes"][node]
+        assert record["state"] == "fresh"
+        assert record["missed_ticks"] == 0
+        assert record["last_scrape_age_s"] is not None
+        assert record["events_seq"] >= 0
+
+
+def test_killed_worker_goes_stale_within_one_tick_without_stalling(
+        two_workers):
+    servers, bases = two_workers
+    fleet = FleetAggregator(lambda: bases, scrape_timeout_s=2.0)
+    assert set(fleet.tick().values()) == {"fresh"}
+    servers[0].shutdown()
+    t0 = time.monotonic()
+    states = fleet.tick()
+    elapsed = time.monotonic() - t0
+    # ONE tick: the dead node is already stale, the healthy one fresh,
+    # and the dead scrape (connection refused) did not stall the pass
+    assert states["node-0"] == "stale"
+    assert states["node-1"] == "fresh"
+    assert elapsed < fleet.scrape_timeout_s + 2.0
+    record = fleet.snapshot()["nodes"]["node-0"]
+    assert record["missed_ticks"] == 1 and record["error"]
+    # further ticks keep aging the dead node, never the healthy one
+    fleet.tick()
+    snap = fleet.snapshot()
+    assert snap["nodes"]["node-0"]["missed_ticks"] == 2
+    assert snap["nodes"]["node-1"]["missed_ticks"] == 0
+
+
+def test_scrape_breaker_skips_dead_node_instead_of_redialling(
+        two_workers):
+    servers, bases = two_workers
+    fleet = FleetAggregator(lambda: bases, scrape_timeout_s=1.0)
+    servers[1].shutdown()
+    for _ in range(4):          # threshold is 3: the 4th tick fails fast
+        fleet.tick()
+    breaker = fleet._breakers["node-1"]
+    assert breaker.state == breaker.OPEN
+    record = fleet.snapshot()["nodes"]["node-1"]
+    assert record["state"] == "stale"
+    assert "breaker open" in record["error"]
+    # the healthy node is unaffected by its neighbour's open breaker
+    assert fleet.snapshot()["nodes"]["node-0"]["state"] == "fresh"
+
+
+def test_event_tail_is_cursor_incremental_and_node_stamped(two_workers):
+    _, bases = two_workers
+    only_node0 = {"node-0": bases["node-0"]}
+    fleet = FleetAggregator(lambda: only_node0, scrape_timeout_s=2.0)
+    EVENTS.emit("fleet_test_marker", rid="fleet-rid-1")
+    fleet.tick()
+    tail = list(fleet._tail)
+    hits = [e for e in tail if e["kind"] == "fleet_test_marker"]
+    assert hits and hits[-1]["node"] == "node-0"
+    # the cursor advanced: a second tick does not re-ingest the event
+    before = len(fleet._tail)
+    fleet.tick()
+    tail = list(fleet._tail)
+    assert len([e for e in tail if e["kind"] == "fleet_test_marker"]) \
+        == len(hits)
+    assert len(tail) - before <= 2      # at most new events, no replays
+    merged = fleet.snapshot()["events"]
+    assert any(e["kind"] == "fleet_test_marker" for e in merged)
+
+
+def test_worker_restart_seq_reset_rebaselines_the_cursor():
+    """A restarted worker's event seq starts over at 1; the aggregator
+    must detect seq moving backwards and re-baseline instead of polling
+    a cursor the new process will never reach (which would silently drop
+    every post-restart event forever)."""
+    from gpumounter_tpu.utils.events import EventLog
+    log1 = EventLog(ring_size=64)
+    for _ in range(20):
+        log1.emit("before_restart")
+    server = start_health_server(0, ready=True, events=log1)
+    bases = {"node-0": f"http://127.0.0.1:{server.server_port}"}
+    fleet = FleetAggregator(lambda: bases, scrape_timeout_s=2.0)
+    try:
+        fleet.tick()
+        record = fleet._nodes["node-0"]
+        assert record.events_seq == 20
+        # "restart": a fresh ring starting at seq 1
+        log2 = EventLog(ring_size=64)
+        log2.emit("after_restart")
+        server.RequestHandlerClass.events = log2
+        fleet.tick()
+        assert record.events_seq == 1
+        assert any(e["kind"] == "after_restart" for e in fleet._tail)
+    finally:
+        server.shutdown()
+
+
+def test_worker_restart_past_the_cursor_rebaselines_via_boot_id():
+    """A restarted worker whose NEW incarnation already emitted past the
+    master's cursor (e.g. a busy boot journal replay) never moves seq
+    backwards — only the payload's boot id reveals the restart. The
+    aggregator must re-baseline and ingest the new stream from seq 1
+    instead of silently skipping its first <cursor> events."""
+    from gpumounter_tpu.utils.events import EventLog
+    log1 = EventLog(ring_size=64)
+    for _ in range(20):
+        log1.emit("before_restart")
+    server = start_health_server(0, ready=True, events=log1)
+    bases = {"node-0": f"http://127.0.0.1:{server.server_port}"}
+    fleet = FleetAggregator(lambda: bases, scrape_timeout_s=2.0)
+    try:
+        fleet.tick()
+        record = fleet._nodes["node-0"]
+        assert record.events_seq == 20
+        assert record.events_boot == log1.boot
+        # "restart": a fresh ring that is ALREADY past the cursor
+        log2 = EventLog(ring_size=64)
+        for _ in range(30):
+            log2.emit("after_restart")
+        server.RequestHandlerClass.events = log2
+        fleet.tick()
+        assert record.events_boot == log2.boot
+        assert record.events_seq == 30
+        # every post-restart event made the merged tail, including the
+        # 20 the stale cursor would have skipped
+        replayed = [e for e in fleet._tail
+                    if e["kind"] == "after_restart"]
+        assert [e["seq"] for e in replayed] == list(range(1, 31))
+    finally:
+        server.shutdown()
+
+
+def test_vanished_worker_is_kept_visible_as_stale(two_workers):
+    _, bases = two_workers
+    targets = dict(bases)
+    fleet = FleetAggregator(lambda: targets, scrape_timeout_s=1.0)
+    fleet.tick()
+    del targets["node-1"]       # directory no longer lists it
+    fleet.tick()
+    snap = fleet.snapshot()
+    # still shown (the operator must SEE the dead node), marked stale
+    assert "node-1" in snap["nodes"]
+
+
+# -- acceptance: /fleetz over a live 2-worker sim stack ------------------------
+
+def _get_json(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def test_fleetz_live_two_workers_one_killed_mid_run(fake_host, tmp_path):
+    """ISSUE 7 acceptance: /fleetz on the master shows per-node health +
+    per-tenant usage aggregated from >= 2 live workers, with one worker
+    killed mid-run marked stale and the rest still fresh."""
+    hosts = []
+    for i in range(2):
+        root = tmp_path / f"host-{i}"
+        for sub in ("dev", "proc", "sys/fs/cgroup"):
+            (root / sub).mkdir(parents=True)
+        from gpumounter_tpu.utils.config import HostPaths
+        hosts.append(HostPaths(
+            dev_root=str(root / "dev"), proc_root=str(root / "proc"),
+            sys_root=str(root / "sys"),
+            cgroup_root=str(root / "sys/fs/cgroup"),
+            kubelet_socket=str(root / "pr" / "kubelet.sock")))
+    stack = MultiNodeStack(hosts, n_chips=4, health=True)
+    try:
+        # one live attach per node so the broker holds per-tenant usage
+        for i in range(2):
+            payload = _get_json(
+                f"{stack.base}/addtpu/namespace/default/pod/workload-{i}"
+                f"/tpu/2/isEntireMount/true")
+            assert payload["result"] == "SUCCESS", payload
+        states = stack.gateway.fleet.tick()
+        assert states == {"node-0": "fresh", "node-1": "fresh"}
+        fleetz = _get_json(f"{stack.base}/fleetz")
+        assert set(fleetz["nodes"]) == {"node-0", "node-1"}
+        assert all(n["state"] == "fresh"
+                   for n in fleetz["nodes"].values())
+        # per-tenant chips in use, aggregated by the broker's lease table
+        assert fleetz["tenants"].get("default") == 4
+        # the merged event tail carries the attaches
+        assert any(e["kind"] == "attach" for e in fleetz["events"])
+        # SLO section present (engine ticked by the fleet pass)
+        assert "slo" in fleetz
+
+        # kill worker 0's health port mid-run: ONE tick marks it stale,
+        # node-1 stays fresh, and the scrape pass didn't wedge
+        stack.health_servers[0].shutdown()
+        states = stack.gateway.fleet.tick()
+        assert states["node-0"] == "stale"
+        assert states["node-1"] == "fresh"
+        fleetz = _get_json(f"{stack.base}/fleetz")
+        assert fleetz["nodes"]["node-0"]["state"] == "stale"
+        assert fleetz["nodes"]["node-1"]["state"] == "fresh"
+
+        # tpumounterctl fleet renders the view and exits non-zero on a
+        # stale node
+        from gpumounter_tpu import cli
+        import contextlib
+        import io
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli.main(["--master", stack.base, "fleet"])
+        rendered = out.getvalue()
+        assert rc == cli.EXIT_OTHER
+        assert "node-0: STALE" in rendered
+        assert "node-1: FRESH" in rendered
+        assert "tenants: default=4 chip(s)" in rendered
+    finally:
+        stack.close()
+
+
+def test_fresh_cursor_does_not_count_history_as_dropped(two_workers):
+    """A master joining late (since=0) against a worker whose ring has
+    rotated must not report the pre-ring history as events_dropped —
+    nothing was lost, the master just wasn't there."""
+    for i in range(600):                # > ring size 512: forces rotation
+        EVENTS.emit("test_filler", rid=f"fill-{i}")
+    _, bases = two_workers
+    fleet = FleetAggregator(lambda: bases, scrape_timeout_s=2.0)
+    assert set(fleet.tick().values()) == {"fresh"}
+    for record in fleet.snapshot()["nodes"].values():
+        assert "events_dropped" not in record, record

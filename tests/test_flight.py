@@ -1,0 +1,257 @@
+"""Flight recorder (utils/flight.py): trigger thresholds, rate limiting,
+atomic bundle writes, and the ISSUE 7 acceptance — an injected failure
+burst (agent fallbacks + journal backlog through the chaos harness)
+produces exactly ONE rate-limited bundle holding the correlated events,
+traces, and journal tail for the failing rid."""
+
+import json
+import os
+
+import pytest
+
+from gpumounter_tpu.utils.errors import TPUMounterError
+from gpumounter_tpu.utils.flight import (FALLBACK_BURST, FlightRecorder,
+                                         RECORDER)
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- unit semantics ------------------------------------------------------------
+
+def test_disabled_recorder_notes_are_noops(tmp_path):
+    rec = FlightRecorder(dir_path=None, settle_s=0.0)
+    assert rec.note("journal_backlog", rid="r") is None
+    assert not rec.enabled
+
+
+def test_single_occurrence_triggers_dump_on_first_note(tmp_path):
+    rec = FlightRecorder(str(tmp_path), min_interval_s=300.0,
+                         settle_s=0.0, clock=FakeClock())
+    bundle_id = rec.note("journal_backlog", rid="r1", backlog=2)
+    assert bundle_id is not None
+    bundle = FlightRecorder.load(str(tmp_path), bundle_id)
+    assert bundle["trigger"] == "journal_backlog"
+    assert bundle["rid"] == "r1"
+    assert bundle["context"] == {"backlog": 2}
+    # atomic write: no .tmp residue
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+
+
+def test_agent_fallbacks_need_a_burst(tmp_path):
+    clock = FakeClock()
+    rec = FlightRecorder(str(tmp_path), min_interval_s=0.0, settle_s=0.0, clock=clock)
+    for i in range(FALLBACK_BURST - 1):
+        assert rec.note("agent_fallback", reason="stopped") is None
+    assert rec.note("agent_fallback", reason="stopped") is not None
+
+
+def test_rate_limit_suppresses_and_counts(tmp_path):
+    clock = FakeClock()
+    rec = FlightRecorder(str(tmp_path), min_interval_s=300.0, settle_s=0.0, clock=clock)
+    before = REGISTRY.flight_suppressed.value()
+    assert rec.note("circuit_open", target="w1") is not None
+    assert rec.note("journal_backlog", rid="r2") is None     # suppressed
+    assert REGISTRY.flight_suppressed.value() == before + 1
+    clock.t += 301.0
+    assert rec.note("journal_backlog", rid="r2") is not None
+    assert len(FlightRecorder.list_bundles(str(tmp_path))) == 2
+
+
+def test_failed_write_releases_the_rate_limit_slot(tmp_path):
+    """An unwritable flight dir must not swallow the incident: the slot
+    claimed before the write is given back, so the NEXT trigger retries
+    instead of counting as 'suppressed' with zero bundles on disk."""
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("")
+    rec = FlightRecorder(str(blocker), min_interval_s=300.0,
+                         settle_s=0.0, clock=FakeClock())
+    assert rec.note("circuit_open", target="w1") is None   # write failed
+    rec.dir = str(tmp_path / "flight")                     # volume fixed
+    bundle_id = rec.note("journal_backlog", rid="r1")
+    assert bundle_id is not None                           # NOT suppressed
+    assert FlightRecorder.load(rec.dir, bundle_id)
+
+
+def test_raising_provider_degrades_to_error_string(tmp_path):
+    rec = FlightRecorder(str(tmp_path), min_interval_s=0.0,
+                         settle_s=0.0, clock=FakeClock())
+    rec.providers["broken"] = lambda: 1 / 0
+    rec.providers["fine"] = lambda: {"ok": True}
+    bundle_id = rec.note("circuit_open")
+    bundle = FlightRecorder.load(str(tmp_path), bundle_id)
+    assert bundle["fine"] == {"ok": True}
+    assert "ZeroDivisionError" in bundle["broken"]["error"]
+
+
+def test_list_bundles_newest_first_and_flight_cli(tmp_path):
+    clock = FakeClock()
+    rec = FlightRecorder(str(tmp_path), min_interval_s=0.0, settle_s=0.0, clock=clock)
+    first = rec.note("circuit_open", target="a")
+    second = rec.note("journal_backlog", rid="rX")
+    bundles = FlightRecorder.list_bundles(str(tmp_path))
+    assert [b["id"] for b in bundles] == [second, first]
+
+    from gpumounter_tpu import cli
+    import contextlib
+    import io
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli.main(["flight", "list", "--dir", str(tmp_path)])
+    assert rc == 0
+    assert second in out.getvalue() and first in out.getvalue()
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli.main(["flight", "show", second, "--dir", str(tmp_path)])
+    assert rc == 0
+    assert "trigger=journal_backlog" in out.getvalue()
+    assert "rid=rX" in out.getvalue()
+    # unknown bundle: clean non-zero, not a traceback
+    assert cli.main(["flight", "show", "nope",
+                     "--dir", str(tmp_path)]) == cli.EXIT_OTHER
+
+
+def test_bundle_order_is_numeric_past_the_zero_pad(tmp_path):
+    """Ids zero-pad to 4 digits, so lexical order inverts at 10000 —
+    pruning must still delete the OLDEST bundle and list_bundles must
+    keep newest-first (a recorder that has dumped 10k bundles over its
+    life would otherwise destroy fresh incident evidence)."""
+    from gpumounter_tpu.utils import flight as flight_mod
+    for bid in (9999, 10000):
+        name = f"flight-{bid:04d}-journal_backlog.json"
+        (tmp_path / name).write_text(json.dumps(
+            {"id": name[:-5], "trigger": "journal_backlog", "ts": bid}))
+    bundles = FlightRecorder.list_bundles(str(tmp_path))
+    assert [b["id"] for b in bundles] == [
+        "flight-10000-journal_backlog", "flight-9999-journal_backlog"]
+    # counter resumes past the highest id on disk
+    rec = FlightRecorder(str(tmp_path), min_interval_s=0.0, settle_s=0.0,
+                         clock=FakeClock())
+    assert rec._next_id() == 10001
+    # prune keeps the numerically newest MAX_BUNDLES
+    for bid in range(10001, 10001 + flight_mod.MAX_BUNDLES):
+        (tmp_path / f"flight-{bid}-circuit_open.json").write_text("{}")
+    rec._prune()
+    kept = sorted(os.listdir(str(tmp_path)),
+                  key=FlightRecorder._bundle_order)
+    assert len(kept) == flight_mod.MAX_BUNDLES
+    assert kept[-1] == (
+        f"flight-{10000 + flight_mod.MAX_BUNDLES}-circuit_open.json")
+    assert "flight-9999-journal_backlog.json" not in kept
+
+
+# -- acceptance: chaos-injected failure burst → exactly one bundle -------------
+
+def test_failure_burst_produces_exactly_one_correlated_bundle(
+        fake_host, tmp_path):
+    """Agent fallbacks + an interrupted rollback (journal backlog), all
+    for one failing rid: ONE bundle appears (rate limit swallows the
+    rest) and it correlates the rid's events, traces and journal tail."""
+    from gpumounter_tpu.testing.chaos import ChaosRig, Fault, FaultPlan
+    from gpumounter_tpu.utils.errors import ActuationError
+
+    flight_dir = str(tmp_path / "flight")
+    chaos = ChaosRig(fake_host, agent=True)
+    rig = chaos.rig
+    RECORDER.configure(flight_dir, min_interval_s=300.0, settle_s=0.25)
+    RECORDER.providers["journal"] = rig.journal.snapshot
+    try:
+        # the resident agent is down: every actuation degrades to the
+        # fallback — which itself fails on create, so the attach rolls
+        # back; the rollback's slave-pod deletes hit an apiserver outage,
+        # leaving the journal record revert_pending (backlog)
+        rig.agent.stop()
+        fallback = rig.actuator.fallback
+        orig_create = fallback.create_device_node
+
+        def failing_create(*args, **kwargs):
+            raise ActuationError("injected fallback failure")
+
+        fallback.create_device_node = failing_create
+        chaos.install(FaultPlan("delete-outage", [
+            Fault(op="DELETE", resource="pods", times=50, status=500)]))
+        suppressed_before = REGISTRY.flight_suppressed.value()
+        try:
+            with pytest.raises(TPUMounterError):
+                rig.service.add_tpu("workload", "default", 2, True,
+                                    request_id="rid-burst")
+            # keep the burst coming: a second pod's attach degrades the
+            # same way (the first pod's leaked slave pod would deny on
+            # mount policy before ever reaching actuation) — every
+            # further trigger must be rate-limited away
+            pod2 = rig.sim.add_target_pod(
+                name="workload-2", uid="uid-w2",
+                container_id="containerd://" + "cd" * 32)
+            rig.provision_container(pod2)
+            with pytest.raises(TPUMounterError):
+                rig.service.add_tpu("workload-2", "default", 1, True,
+                                    request_id="rid-burst-2")
+        finally:
+            fallback.create_device_node = orig_create
+
+        # collection is settle-deferred so the failing request's own
+        # trace lands in the bundle — wait for the write
+        import time
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline \
+                and not FlightRecorder.list_bundles(flight_dir):
+            time.sleep(0.05)
+        bundles = FlightRecorder.list_bundles(flight_dir)
+        assert len(bundles) == 1, \
+            f"expected exactly one rate-limited bundle, got {bundles}"
+        assert REGISTRY.flight_suppressed.value() > suppressed_before
+        bundle = FlightRecorder.load(flight_dir, bundles[0]["id"])
+        assert bundle["rid"] == "rid-burst"
+
+        # correlated events: the failing rid's whole lifecycle is inside
+        rid_kinds = [e["kind"] for e in bundle["rid_events"]]
+        assert "journal_intent" in rid_kinds
+        assert "journal_revert_pending" in rid_kinds
+        assert "agent_fallback" in rid_kinds
+        # correlated traces: the EXCEPTION attach for this rid
+        rid_traces = bundle["traces"]["rid"]
+        assert any(t["result"] == "EXCEPTION" for t in rid_traces)
+        # journal tail: the revert_pending record for this rid's attach
+        journal = bundle["journal"]
+        assert journal["backlog"] >= 1
+        assert any("rid-burst" in (r.get("jid") or "")
+                   for r in journal["incomplete"])
+    finally:
+        RECORDER.providers.pop("journal", None)
+        RECORDER.configure(None)
+        chaos.close()
+
+
+def test_restart_does_not_overwrite_previous_incarnations_bundles(tmp_path):
+    """Bundle ids seed from what's already on disk: a crash-looping
+    process (fresh recorder each boot, same trigger) must ADD a bundle,
+    not os.replace the previous incarnation's forensic evidence."""
+    first = FlightRecorder(str(tmp_path), min_interval_s=0.0, settle_s=0.0,
+                           clock=FakeClock())
+    first_id = first.note("journal_backlog", rid="boot1")
+    assert first_id == "flight-0001-journal_backlog"
+    # process restarts: a brand-new recorder over the same TPU_FLIGHT_DIR
+    reborn = FlightRecorder(str(tmp_path), min_interval_s=0.0, settle_s=0.0,
+                            clock=FakeClock())
+    second_id = reborn.note("journal_backlog", rid="boot2")
+    assert second_id == "flight-0002-journal_backlog"
+    assert FlightRecorder.load(str(tmp_path), first_id)["rid"] == "boot1"
+    assert FlightRecorder.load(str(tmp_path), second_id)["rid"] == "boot2"
+
+
+def test_flight_dumps_counter_is_preseeded_per_trigger():
+    """increase() over a series that first appears at value 1 reads 0 —
+    every trigger's series must exist at 0 before its first bundle or
+    the bundle-written alert misses one-bundle incidents."""
+    from gpumounter_tpu.utils.metrics import Registry
+    reg = Registry()
+    for trigger in ("fast_burn", "agent_fallback", "journal_backlog",
+                    "circuit_open"):
+        assert reg.flight_dumps.value(trigger=trigger) == 0.0
+    assert "tpumounter_flight_dumps_total" in reg.render_text()

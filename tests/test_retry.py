@@ -328,3 +328,77 @@ def test_fake_watch_resumes_after_midstream_death():
     events = list(kube.watch_pods("default", timeout_s=1.0))
     names = [pod["metadata"]["name"] for _, pod in events]
     assert names == ["p0", "p1", "p2"]       # no loss, no duplicates
+
+
+def test_breaker_open_emits_event_and_flight_trigger(tmp_path, monkeypatch):
+    """CLOSED->OPEN is a lifecycle anomaly: one ``circuit_open`` event in
+    the global ring + one flight-recorder note (threshold 1 => bundle)."""
+    import gpumounter_tpu.utils.flight as flight
+    from gpumounter_tpu.utils.events import EVENTS
+    from gpumounter_tpu.utils.flight import FlightRecorder
+    rec = FlightRecorder(str(tmp_path), settle_s=0.0)
+    monkeypatch.setattr(flight, "RECORDER", rec)
+    cursor = EVENTS.emit("test_marker")
+    breaker = CircuitBreaker("evt-target", failure_threshold=1,
+                             reset_timeout_s=10.0, clock=_Clock())
+    breaker.record_failure()
+    fresh, _, _ = EVENTS.since(cursor)
+    opened = [e for e in fresh if e["kind"] == "circuit_open"]
+    assert len(opened) == 1
+    assert opened[0]["attrs"]["target"] == "evt-target"
+    assert len(list(tmp_path.glob("flight-*.json"))) == 1
+    breaker.record_success()   # close: the state gauge is process-global
+
+
+def test_scrape_breaker_open_is_silent(tmp_path, monkeypatch):
+    """The fleet's scrape breaker opening is a telemetry miss, already
+    surfaced as the node's ``stale`` record — it must not write an
+    anomaly bundle or emit ``circuit_open`` into the event ring."""
+    import gpumounter_tpu.utils.flight as flight
+    from gpumounter_tpu.master.fleet import _ScrapeBreaker
+    from gpumounter_tpu.utils.events import EVENTS
+    from gpumounter_tpu.utils.flight import FlightRecorder
+    rec = FlightRecorder(str(tmp_path), settle_s=0.0)
+    monkeypatch.setattr(flight, "RECORDER", rec)
+    cursor = EVENTS.emit("test_marker")
+    breaker = _ScrapeBreaker("node-9", failure_threshold=1,
+                             reset_timeout_s=10.0, clock=_Clock())
+    breaker.record_failure()
+    assert breaker.state == CircuitBreaker.OPEN
+    fresh, _, _ = EVENTS.since(cursor)
+    assert [e for e in fresh if e["kind"] == "circuit_open"] == []
+    assert list(tmp_path.glob("flight-*.json")) == []
+    breaker.record_success()
+
+
+def test_breaker_announces_outage_once_not_per_failed_probe(tmp_path,
+                                                            monkeypatch):
+    """A target down for an hour re-opens on every failed half-open probe;
+    only the RISING edge is announced — the ring must not fill with
+    duplicate circuit_open events (nor the flight dir with bundles) while
+    one outage persists. Recovery re-arms the announcement."""
+    import gpumounter_tpu.utils.flight as flight
+    from gpumounter_tpu.utils.events import EVENTS
+    from gpumounter_tpu.utils.flight import FlightRecorder
+    rec = FlightRecorder(str(tmp_path), min_interval_s=0.0, settle_s=0.0)
+    monkeypatch.setattr(flight, "RECORDER", rec)
+    clock = _Clock()
+    cursor = EVENTS.emit("test_marker")
+    breaker = CircuitBreaker("probe-target", failure_threshold=1,
+                             reset_timeout_s=10.0, clock=clock)
+    breaker.record_failure()                 # CLOSED -> OPEN: announced
+    for _ in range(3):                       # three failed probes
+        clock.now += 11.0
+        breaker.allow()
+        breaker.record_failure()             # HALF_OPEN -> OPEN: silent
+    fresh, _, _ = EVENTS.since(cursor)
+    assert len([e for e in fresh if e["kind"] == "circuit_open"]) == 1
+    assert len(list(tmp_path.glob("flight-*.json"))) == 1
+    # recovery then a NEW outage announces again
+    clock.now += 11.0
+    breaker.allow()
+    breaker.record_success()
+    breaker.record_failure()
+    fresh, _, _ = EVENTS.since(cursor)
+    assert len([e for e in fresh if e["kind"] == "circuit_open"]) == 2
+    breaker.record_success()   # close: the state gauge is process-global

@@ -149,3 +149,67 @@ def test_worker_tracez_serves_rid_filtered_span_trees(live_stack):
     workers = [c for c in rpc.get("children", [])
                if c["name"].startswith("worker:")]
     assert len(workers) == 1
+
+
+def test_cli_trace_degrades_when_worker_health_port_unreachable(
+        live_stack):
+    """ISSUE 7 satellite: with the worker's health port down, the master
+    still renders ITS half of the tree, annotated `worker spans
+    unavailable: <cause>` under the rpc span — no error, no empty
+    output."""
+    import contextlib
+    import io
+    base = live_stack.base
+    rid = "e2e-degraded-" + uuid.uuid4().hex[:8]
+    _attach(base, rid)
+    live_stack.health_server.shutdown()         # the stitch source dies
+    status, payload = _get(f"{base}/tracez?rid={rid}")
+    assert status == 200
+    assert payload["stitch_errors"], payload
+    assert payload["worker_traces"] == 0
+    names = list(_span_names(payload["traces"][0]["spans"]))
+    assert "rpc" in names
+    assert "worker spans unavailable" in names
+    unavailable = [s for t in payload["traces"]
+                   for s in _find(t["spans"], "worker spans unavailable")]
+    assert unavailable and "cause" in unavailable[0]["attrs"]
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli.main(["--master", base, "trace", rid])
+    text = out.getvalue()
+    assert rc == 0, text                        # degraded, not an error
+    assert f"trace {rid} op=addtpu result=SUCCESS" in text
+    assert "resolve" in text and "rpc" in text  # the master half renders
+    assert "worker spans unavailable" in text
+    assert "worker spans incomplete" in text    # the stitch_errors note
+
+
+def _find(span_dict, name):
+    hits = []
+    if span_dict.get("name") == name:
+        hits.append(span_dict)
+    for child in span_dict.get("children", []) or []:
+        hits.extend(_find(child, name))
+    return hits
+
+
+def test_unavailable_annotation_names_this_rpcs_worker_not_any_failure():
+    """One worker's health port down must not annotate OTHER workers' rpc
+    spans with its outage: an rpc whose worker was fetched fine (its
+    trace merely rotated out of the bounded store) stays un-annotated,
+    and the down worker's rpc quotes ITS OWN cause."""
+    from gpumounter_tpu.master.gateway import MasterGateway
+    def rpc(worker):
+        return {"name": "rpc", "attrs": {"worker": worker},
+                "start_unix": 0.0, "children": []}
+    trace = {"spans": {"name": "addtpu", "attrs": {},
+                       "children": [rpc("node-a"), rpc("node-b")]}}
+    MasterGateway._graft_worker_spans(
+        None, trace, [], {"node-a": "connection refused"})
+    rpc_a, rpc_b = trace["spans"]["children"]
+    a_notes = [c for c in rpc_a["children"]
+               if c["name"] == "worker spans unavailable"]
+    assert len(a_notes) == 1
+    assert "connection refused" in a_notes[0]["attrs"]["cause"]
+    assert rpc_b["children"] == []      # node-b's fetch did not fail

@@ -326,6 +326,12 @@ def cmd_trace(args) -> int:
 # of silence means the cache is coasting on its last LIST.
 CACHE_STALENESS_WARN_S = 120.0
 
+# Leadership transitions per doctor --window above this WARN as flapping:
+# one clean failover is a single acquire (+ the deposed side's lose), so
+# more than two transitions inside one observation window means shard
+# ownership is bouncing, not failing over.
+FLAP_WARN = 2
+
 
 def cmd_cachez(args) -> int:
     """Shared-informer cache introspection from a worker's health port:
@@ -460,6 +466,42 @@ def cmd_fleet(args) -> int:
             f"  {node}: {state.upper()}  chips[{chip_str}]  "
             f"events@{n.get('events_seq', 0)}"
             + (f"  [{'; '.join(extras)}]" if extras else ""))
+    # HA posture of the answering master (docs/guide/HA.md): its role per
+    # shard, the peers its lock records name, and store lag — a stuck
+    # failover (leaderless shard, lagging store) is visible right here.
+    masters = payload.get("masters") or {}
+    if masters.get("enabled"):
+        replica = masters.get("replica", "?")
+        shards = (masters.get("election") or {}).get("shards")
+        if not isinstance(shards, dict):
+            # store-only HA (election off): NullElection reports shards
+            # as a plain count — no per-shard roles to render
+            shards = {}
+        roles = []
+        for shard in sorted(shards, key=lambda s: int(s)):
+            s = shards[shard]
+            holder = s.get("holder") or "NONE"
+            expires = float(s.get("expires_in_s") or 0.0)
+            if s.get("leader"):
+                roles.append(f"{shard}:LEADER")
+            elif expires <= 0:
+                # observed lock expired and nobody here holds it: either
+                # failover in flight or the shard is down — flag it
+                roles.append(f"{shard}:NO-LEADER({holder})")
+                rc = EXIT_OTHER
+            else:
+                roles.append(f"{shard}:follower({holder})")
+        store = masters.get("store") or {}
+        store_str = ""
+        if store:
+            lag = float(store.get("lag_s") or 0.0)
+            store_str = (f"  store lag {lag:g}s"
+                         + (f" ({store.get('dirty')} dirty)"
+                            if store.get("dirty") else ""))
+            if store.get("torn_records"):
+                store_str += f" torn={store['torn_records']}"
+        lines.append(f"  master {replica}: " + " ".join(roles)
+                     + store_str)
     tenants = payload.get("tenants") or {}
     if tenants:
         lines.append("  tenants: " + ", ".join(
@@ -970,6 +1012,72 @@ def cmd_doctor(args) -> int:
         if top and not metrics.get("tpumounter_slo_burn_rate"):
             check("ok", f"top burn tenant (fleetz): {top.get('tenant')} "
                         f"slo {top.get('slo')} at {top.get('burn')}x")
+
+    # HA posture (docs/guide/HA.md): a shard with no live leader means
+    # admission for its keyspace is DOWN right now — every request 503s
+    # until a replica takes it over — and pages CRIT. Leadership
+    # transitions are counters: windowed deltas above the flap threshold
+    # WARN (a failover is 1 acquire; churn past FLAP_WARN means the lock
+    # is bouncing — renew interval too tight, apiserver struggling, or
+    # two replicas fighting); lifetime totals only inform.
+    masters = (fleetz or {}).get("masters") or {}
+    if masters.get("enabled"):
+        election_view = masters.get("election") or {}
+        shards = election_view.get("shards") or {}
+        if election_view.get("enabled"):
+            leaderless = sorted(
+                shard for shard, s in shards.items()
+                if not s.get("leader")
+                and (not s.get("holder")
+                     or float(s.get("expires_in_s") or 0.0) <= 0))
+            if leaderless:
+                check("crit",
+                      f"shard(s) {', '.join(leaderless)} have NO live "
+                      "leader — admission for their keyspace is down "
+                      "until a replica takes over (watch "
+                      "tpumounter_election_is_leader)")
+            else:
+                led = sum(1 for s in shards.values() if s.get("leader"))
+                check("ok", f"HA: replica {masters.get('replica')} leads "
+                            f"{led}/{len(shards)} shard(s), every shard "
+                            "has a live leader")
+        store_view = masters.get("store") or {}
+        lag = float(store_view.get("lag_s") or 0.0)
+        if lag > 0:
+            check("warn",
+                  f"intent store lagging {lag:g}s "
+                  f"({store_view.get('dirty', 0)} dirty mutation(s) "
+                  "parked) — a failover NOW would rehydrate stale "
+                  "records")
+        if store_view.get("torn_records"):
+            check("warn",
+                  f"{store_view['torn_records']} torn store record(s) "
+                  "dropped at rehydration (crash mid-write) — those "
+                  "leases degraded to slave-pod re-derivation")
+    if metrics:
+        src = metrics_delta if metrics_delta is not None else metrics
+        scope = (f"in the last {window:g}s" if metrics_delta is not None
+                 else "lifetime")
+        # judged PER SHARD (like the shipped sum-by-shard alert rule): a
+        # clean multi-shard failover is one acquire on EACH shard and
+        # must not read as flapping in aggregate
+        per_shard: dict[str, float] = {}
+        for labels, value in src.get(
+                "tpumounter_election_transitions_total", {}).items():
+            shard = dict(labels).get("shard", "?")
+            per_shard[shard] = per_shard.get(shard, 0.0) + value
+        transitions = sum(per_shard.values())
+        flapping = sorted(shard for shard, n in per_shard.items()
+                          if n > FLAP_WARN)
+        if metrics_delta is not None and flapping:
+            check("warn",
+                  f"leadership flapping on shard(s) "
+                  f"{', '.join(flapping)} (> {FLAP_WARN} transitions "
+                  f"{scope}) — ownership is bouncing between replicas; "
+                  "check TPU_ELECTION_RENEW_S vs apiserver latency")
+        elif transitions:
+            check("ok", f"leadership transitions: {int(transitions)} — "
+                        f"{scope}")
 
     # Resident actuation agent: fallback RATE is the health signal — a
     # windowed non-zero delta means attaches are degrading to the

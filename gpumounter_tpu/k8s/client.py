@@ -210,6 +210,36 @@ class KubeClient(abc.ABC):
         """Node object (for TPU topology labels / allocatable). Raises
         :class:`K8sApiError` (status 404 for unknown nodes)."""
 
+    # ConfigMaps: the declaratively-persisted, CAS-able object kind the
+    # HA control plane keeps broker intent and election locks in
+    # (master/store.py, master/election.py). Same optimistic-concurrency
+    # contract as patch_pod: a resourceVersion precondition answers 409
+    # when the live object moved on — which is exactly how two master
+    # replicas decide every state/lock race.
+
+    @abc.abstractmethod
+    def get_config_map(self, namespace: str, name: str) -> dict[str, Any]:
+        """Raises :class:`K8sApiError` (status 404) for unknown maps."""
+
+    @abc.abstractmethod
+    def create_config_map(self, namespace: str,
+                          obj: dict[str, Any]) -> dict[str, Any]:
+        """409 :class:`K8sApiError` when the name exists (create IS the
+        acquisition CAS for a lock object that does not exist yet)."""
+
+    @abc.abstractmethod
+    def patch_config_map(self, namespace: str, name: str,
+                         patch: dict[str, Any],
+                         resource_version: str | None = None
+                         ) -> dict[str, Any]:
+        """JSON merge-patch (null deletes a key) with an optional
+        resourceVersion precondition; 409 on a lost CAS, 404
+        :class:`K8sApiError` when absent."""
+
+    @abc.abstractmethod
+    def delete_config_map(self, namespace: str, name: str) -> None:
+        """404s are swallowed — deleting an already-gone map is success."""
+
     @abc.abstractmethod
     def create_event(self, namespace: str,
                      event: dict[str, Any]) -> dict[str, Any]:
@@ -407,6 +437,37 @@ class RestKubeClient(KubeClient):
 
     def get_node(self, name: str) -> dict[str, Any]:
         return self._request("GET", f"/api/v1/nodes/{name}")
+
+    def get_config_map(self, namespace: str, name: str) -> dict[str, Any]:
+        return self._request(
+            "GET", f"/api/v1/namespaces/{namespace}/configmaps/{name}")
+
+    def create_config_map(self, namespace: str,
+                          obj: dict[str, Any]) -> dict[str, Any]:
+        return self._request(
+            "POST", f"/api/v1/namespaces/{namespace}/configmaps", body=obj)
+
+    def patch_config_map(self, namespace: str, name: str,
+                         patch: dict[str, Any],
+                         resource_version: str | None = None
+                         ) -> dict[str, Any]:
+        if resource_version is not None:
+            meta = dict(patch.get("metadata") or {})
+            meta["resourceVersion"] = resource_version
+            patch = {**patch, "metadata": meta}
+        return self._request(
+            "PATCH",
+            f"/api/v1/namespaces/{namespace}/configmaps/{name}",
+            body=patch, content_type="application/merge-patch+json")
+
+    def delete_config_map(self, namespace: str, name: str) -> None:
+        try:
+            self._request(
+                "DELETE",
+                f"/api/v1/namespaces/{namespace}/configmaps/{name}")
+        except K8sApiError as e:
+            if e.status != 404:
+                raise
 
     def create_event(self, namespace: str,
                      event: dict[str, Any]) -> dict[str, Any]:
@@ -728,6 +789,12 @@ class FakeKubeClient(KubeClient):
         self._lock = threading.Condition()
         self._pods: dict[tuple[str, str], objects.Pod] = {}
         self._nodes: dict[str, dict[str, Any]] = {}
+        # ConfigMaps (HA intent store + election locks) with their own
+        # monotonic resourceVersion stream; cm_calls counts every
+        # configmap round-trip so tests can pin "HA off = zero traffic".
+        self._cms: dict[tuple[str, str], dict[str, Any]] = {}
+        self._cm_rv = 0
+        self.cm_calls = 0
         self._events: list[tuple[str, objects.Pod]] = []
         self.on_create: list[Callable[[objects.Pod], None]] = []
         self.on_delete: list[Callable[[objects.Pod], None]] = []
@@ -785,6 +852,91 @@ class FakeKubeClient(KubeClient):
                 if node is None:
                     raise K8sApiError(404, f"node {name} not found")
                 return json.loads(json.dumps(node))
+
+    # -- ConfigMaps (HA intent store / election locks) -------------------------
+
+    def get_config_map(self, namespace: str, name: str) -> dict[str, Any]:
+        return self._retry(lambda: self._get_cm_once(namespace, name))
+
+    def _get_cm_once(self, namespace: str, name: str) -> dict[str, Any]:
+        with k8s_call("GET", "configmaps"):
+            self._fault("GET", "configmaps")
+            with self._lock:
+                self.cm_calls += 1
+                cm = self._cms.get((namespace, name))
+                if cm is None:
+                    raise K8sApiError(
+                        404, f"configmap {namespace}/{name} not found")
+                return json.loads(json.dumps(cm))
+
+    def create_config_map(self, namespace: str,
+                          obj: dict[str, Any]) -> dict[str, Any]:
+        return self._retry(lambda: self._create_cm_once(namespace, obj),
+                           classify=retryable_non_idempotent)
+
+    def _create_cm_once(self, namespace: str,
+                        obj: dict[str, Any]) -> dict[str, Any]:
+        with k8s_call("POST", "configmaps"):
+            self._fault("POST", "configmaps")
+            obj = json.loads(json.dumps(obj))
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("namespace", namespace)
+            key = (namespace, meta.get("name", ""))
+            with self._lock:
+                self.cm_calls += 1
+                if key in self._cms:
+                    raise K8sApiError(
+                        409, f"configmap {key} already exists")
+                self._cm_rv += 1
+                meta["resourceVersion"] = str(self._cm_rv)
+                self._cms[key] = obj
+                return json.loads(json.dumps(obj))
+
+    def patch_config_map(self, namespace: str, name: str,
+                         patch: dict[str, Any],
+                         resource_version: str | None = None
+                         ) -> dict[str, Any]:
+        return self._retry(lambda: self._patch_cm_once(
+            namespace, name, patch, resource_version))
+
+    def _patch_cm_once(self, namespace: str, name: str,
+                       patch: dict[str, Any],
+                       resource_version: str | None = None
+                       ) -> dict[str, Any]:
+        patch = json.loads(json.dumps(patch))
+        # the precondition is consumed here, not merged into the object
+        patch.get("metadata", {}).pop("resourceVersion", None)
+        with k8s_call("PATCH", "configmaps"):
+            self._fault("PATCH", "configmaps")
+            with self._lock:
+                self.cm_calls += 1
+                cm = self._cms.get((namespace, name))
+                if cm is None:
+                    raise K8sApiError(
+                        404, f"configmap {namespace}/{name} not found")
+                live_rv = cm.get("metadata", {}).get("resourceVersion", "")
+                if resource_version is not None \
+                        and live_rv != resource_version:
+                    raise K8sApiError(
+                        409, f"Operation cannot be fulfilled on configmaps "
+                             f"{name!r}: the object has been modified "
+                             f"(have {live_rv}, precondition "
+                             f"{resource_version})")
+                _json_merge_patch(cm, patch)
+                self._cm_rv += 1
+                cm.setdefault("metadata", {})["resourceVersion"] = \
+                    str(self._cm_rv)
+                return json.loads(json.dumps(cm))
+
+    def delete_config_map(self, namespace: str, name: str) -> None:
+        self._retry(lambda: self._delete_cm_once(namespace, name))
+
+    def _delete_cm_once(self, namespace: str, name: str) -> None:
+        with k8s_call("DELETE", "configmaps"):
+            self._fault("DELETE", "configmaps")
+            with self._lock:
+                self.cm_calls += 1
+                self._cms.pop((namespace, name), None)
 
     def create_event(self, namespace: str,
                      event: dict[str, Any]) -> dict[str, Any]:

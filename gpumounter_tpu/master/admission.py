@@ -51,7 +51,8 @@ from gpumounter_tpu.k8s import objects
 from gpumounter_tpu.master.lease import Lease, LeaseTable
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.errors import (K8sApiError, QueueFullError,
-                                         QuotaExceededError)
+                                         QuotaExceededError,
+                                         StoreFencedError)
 from gpumounter_tpu.utils.events import EVENTS
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
@@ -100,14 +101,18 @@ class _Waiter:
     """One parked attach request. ``tried_gen`` is the last capacity
     generation this waiter already retried at — the baton-passing that
     lets a wrong-node waiter hand the wakeup to the next in line instead
-    of swallowing it."""
+    of swallowing it. ``deadline`` is its absolute give-up time;
+    ``entire`` rides along so the persisted intent record can re-run the
+    exact attach; ``outcome`` is set ("moved") when shard hand-off wakes
+    the waiter to re-route instead of retrying here."""
 
     __slots__ = ("tenant", "priority", "chips", "node", "rid",
                  "namespace", "pod", "enqueued_at", "event", "tried_gen",
-                 "preempted")
+                 "preempted", "entire", "deadline", "outcome")
 
     def __init__(self, tenant: str, priority: str, chips: int, node: str,
-                 rid: str, namespace: str, pod: str, gen: int):
+                 rid: str, namespace: str, pod: str, gen: int,
+                 entire: bool = False, timeout_s: float = 0.0):
         self.tenant = tenant
         self.priority = priority
         self.chips = chips
@@ -119,6 +124,9 @@ class _Waiter:
         self.event = threading.Event()
         self.tried_gen = gen
         self.preempted = 0     # victims already detached for this waiter
+        self.entire = entire
+        self.deadline = self.enqueued_at + timeout_s
+        self.outcome: str | None = None
 
 
 class AttachBroker:
@@ -153,11 +161,233 @@ class AttachBroker:
         self._rederived = False
         self._loop: threading.Thread | None = None
         self._stop = threading.Event()
+        # HA plane (bind_ha): the declarative intent store, the shard
+        # ring, and this replica's election view. All None/Null = PR 7
+        # single-master semantics, zero configmap traffic.
+        self.store = None
+        self.ring = None
+        self.election = None
+        # attempt_factory(namespace, pod, chips, entire, rid, node) ->
+        # attempt_fn: how an ADOPTED waiter (rehydrated from a dead
+        # peer's store records) re-runs its attach through the gateway's
+        # worker path. rids already adopted (or currently parked here)
+        # are never adopted twice.
+        self._attempt_factory = None
+        # rid -> shard of every adoption in flight: membership prevents
+        # double-adoption; the shard lets a lost shard's entries be
+        # pruned (a reacquire must re-adopt records a dead peer never
+        # resolved) and resolution removes its own entry (bounded set).
+        # _adopt_lock serializes every check-then-act on BOTH structures
+        # — rehydration races between the election thread (acquire), the
+        # tick loop (deferred retry) and request threads (lazy boot)
+        # must not adopt one intent twice.
+        self._adopt_lock = threading.Lock()
+        self._adopted_rids: dict[str, int] = {}
+        self._rehydrated_shards: set[int] = set()
 
     def bind(self, detach_fn) -> None:
         """``detach_fn(lease, cause, force) -> result name`` — the
         gateway's worker-path detach, used for preemption and expiry."""
         self._detach_fn = detach_fn
+
+    def bind_ha(self, store, ring, election) -> None:
+        """Wire the HA plane: lease mutations write through ``store``
+        (master/store.py), admission ownership follows ``election`` over
+        ``ring``'s shards, and a fenced store write demotes this replica's
+        shard immediately."""
+        self.store = store
+        self.ring = ring
+        self.election = election
+        self.leases.store = store
+        self.leases.on_fenced = self._on_fenced
+
+    def bind_attempt_factory(self, factory) -> None:
+        self._attempt_factory = factory
+
+    # -- sharding / ownership --------------------------------------------------
+
+    def shard_of(self, namespace: str) -> int:
+        return self.ring.shard_of(namespace) if self.ring else 0
+
+    def _owns(self, namespace: str) -> bool:
+        if self.election is None:
+            return True
+        return self.election.is_leader(self.shard_of(namespace))
+
+    def _on_fenced(self, err) -> None:
+        """A store write bounced off a higher fence: a peer leads that
+        shard now — demote locally instead of fighting the token. The
+        refused fence is recorded so a later acquisition (e.g. after
+        the lock object was deleted, restarting lock fences at 1) must
+        clear it instead of livelocking acquire→fenced→demote."""
+        if self.election is not None:
+            self.election.note_fence(err.shard, err.fence)
+            self.election.demote(err.shard, str(err))
+
+    def on_shard_acquired(self, shard: int) -> None:
+        """Election hand-off: this replica now owns the shard — load its
+        persisted intent (exact leases AND parked waiters) and drain the
+        recovered waiters as if their clients were still connected (the
+        original request ids make the re-runs idempotent)."""
+        if self.store is not None:
+            # force a fresh read even for a shard this replica held
+            # before: an acquire can be a RESUME after decayed validity,
+            # and the shard map may have moved while we were not acting
+            with self._adopt_lock:
+                self._rehydrated_shards.discard(shard)
+            self._rehydrate_shard(shard)
+        # The store may predate some leases (attaches that only exist as
+        # slave-pod labels) — and with no store at all, the slave-pod
+        # derivation is the ONLY source of the dead leader's leases:
+        # either way the next decision must re-derive cluster ground
+        # truth, same lazy discipline as boot.
+        self._rederived = False
+        self.signal_capacity()
+
+    def on_shard_lost(self, shard: int) -> None:
+        """Deposed: evict the shard's in-memory leases (WITHOUT store
+        deletes — the records belong to the new leader now), drop its
+        parked store mutations, and wake its waiters to re-route."""
+        if self.ring is None:
+            return
+        self.leases.evict_where(
+            lambda lease: self.ring.shard_of(lease.namespace) == shard)
+        if self.store is not None:
+            self.store.forget_shard(shard)
+        with self._adopt_lock:
+            self._rehydrated_shards.discard(shard)
+            # adoption history belongs to the shard: keeping it would
+            # make a later reacquire skip records the interim leader
+            # never resolved, stranding their intent forever
+            for rid in [r for r, s in self._adopted_rids.items()
+                        if s == shard]:
+                del self._adopted_rids[rid]
+        with self._lock:
+            for waiter in self._waiters:
+                if self.ring.shard_of(waiter.namespace) == shard:
+                    waiter.outcome = "moved"
+                    waiter.event.set()
+
+    def _rehydrate_shard(self, shard: int) -> None:
+        if self.store is None:
+            return
+        with self._adopt_lock:
+            if shard in self._rehydrated_shards:
+                return
+            self._rehydrated_shards.add(shard)
+        try:
+            leases, waiters, torn = self.store.rehydrate(shard)
+        except K8sApiError as e:
+            with self._adopt_lock:
+                self._rehydrated_shards.discard(shard)
+            logger.warning("shard %d store rehydration deferred: %s",
+                           shard, e)
+            return
+        merged = self.leases.merge_records(leases)
+        if merged or waiters or torn:
+            logger.info("shard %d rehydrated: %d lease(s) merged, %d "
+                        "waiter(s) to adopt, %d torn record(s)", shard,
+                        merged, len(waiters), torn)
+        self._adopt_waiters(waiters)
+
+    # -- recovered-waiter adoption ---------------------------------------------
+
+    def _adopt_waiters(self, records) -> int:
+        """Re-run persisted queue intent from a dead (or restarted)
+        leader. Each record becomes a server-side attach under its
+        ORIGINAL rid and remaining deadline — the worker's per-rid
+        idempotent adoption makes a re-run of an attach that actually
+        landed return the same chips instead of double-actuating."""
+        if self._attempt_factory is None:
+            return 0
+        adopted = 0
+        with self._lock:
+            live = {w.rid for w in self._waiters}
+        for record in records:
+            with self._adopt_lock:
+                if record.rid in self._adopted_rids or record.rid in live:
+                    continue
+                self._adopted_rids[record.rid] = \
+                    self.shard_of(record.namespace)
+            adopted += 1
+            threading.Thread(target=self._run_adopted, args=(record,),
+                             daemon=True,
+                             name=f"tpumounter-adopt-{record.rid}").start()
+        return adopted
+
+    def _run_adopted(self, record) -> None:
+        remaining = record.deadline_unix - time.time()
+        EVENTS.emit("waiter_adopted", rid=record.rid,
+                    tenant=record.tenant, namespace=record.namespace,
+                    pod=record.pod, chips=record.chips,
+                    remaining_s=round(max(0.0, remaining), 3))
+        if remaining <= 0:
+            # its client's deadline passed while nobody owned the shard:
+            # resolve as a clean timeout — delete the intent so it never
+            # resurrects, and account the outcome
+            REGISTRY.admission_decisions.inc(tenant=record.tenant,
+                                             outcome="queue_timeout")
+            EVENTS.emit("queue_timeout", rid=record.rid,
+                        tenant=record.tenant, chips=record.chips,
+                        priority=record.priority, adopted=True)
+            self._unpersist_rid(record.namespace, record.rid)
+            with self._adopt_lock:
+                self._adopted_rids.pop(record.rid, None)
+            return
+        attempt_fn = self._attempt_factory(
+            record.namespace, record.pod, record.chips, record.entire,
+            record.rid, record.node)
+        try:
+            status, payload = self.attach(
+                tenant=record.tenant, priority=record.priority,
+                namespace=record.namespace, pod=record.pod,
+                chips=record.chips, node=record.node, rid=record.rid,
+                attempt_fn=attempt_fn, entire=record.entire,
+                timeout_s=remaining)
+            logger.info("[rid=%s] adopted waiter resolved: %s / %s",
+                        record.rid, status,
+                        payload.get("result", "-"))
+        except Exception as e:     # noqa: BLE001 — a drain thread dying
+            # would strand the intent record forever; resolve it below
+            logger.warning("[rid=%s] adopted waiter failed: %s",
+                           record.rid, e)
+        finally:
+            # resolved either way (an immediate 200 never parks, so the
+            # queue path's own cleanup may not have run): the intent
+            # record must not outlive its resolution, and neither must
+            # the adoption entry (the record is gone — nothing left to
+            # double-adopt)
+            self._unpersist_rid(record.namespace, record.rid)
+            with self._adopt_lock:
+                self._adopted_rids.pop(record.rid, None)
+
+    # -- waiter persistence (master/store.py write-through) --------------------
+
+    def _persist_waiter(self, waiter: _Waiter, timeout_s: float) -> None:
+        if self.store is None:
+            return
+        from gpumounter_tpu.master.store import WaiterRecord
+        record = WaiterRecord(
+            rid=waiter.rid, namespace=waiter.namespace, pod=waiter.pod,
+            tenant=waiter.tenant, priority=waiter.priority,
+            chips=waiter.chips, node=waiter.node, entire=waiter.entire,
+            enqueued_unix=round(time.time(), 3),
+            deadline_unix=round(time.time() + timeout_s, 3))
+        try:
+            self.store.put_waiter(record)
+        except StoreFencedError as e:
+            self._on_fenced(e)
+
+    def _unpersist_waiter(self, waiter: _Waiter) -> None:
+        self._unpersist_rid(waiter.namespace, waiter.rid)
+
+    def _unpersist_rid(self, namespace: str, rid: str) -> None:
+        if self.store is None:
+            return
+        try:
+            self.store.delete_waiter(namespace, rid)
+        except StoreFencedError as e:
+            self._on_fenced(e)
 
     # -- restart re-derivation -------------------------------------------------
 
@@ -171,6 +401,14 @@ class AttachBroker:
         with self._rederive_lock:
             if self._rederived:
                 return
+            # Persisted intent first: the store's records carry what the
+            # cluster derivation cannot (exact tenant/priority/uuids AND
+            # the parked waiters); the slave-pod derivation below then
+            # fills whatever the store doesn't know — including records
+            # torn by a crash mid-write.
+            if self.store is not None and self.election is not None:
+                for shard in self.election.owned():
+                    self._rehydrate_shard(shard)
             try:
                 self.leases.rederive(self.kube, self.config.pool_namespace,
                                      self.config.resource_name,
@@ -179,6 +417,12 @@ class AttachBroker:
                 logger.warning("lease re-derivation deferred (apiserver "
                                "unreachable): %s", e)
                 return
+            if self.election is not None and self.election.enabled:
+                # cluster derivation sees EVERY owner pod; foreign
+                # shards' leases belong to their leaders (holding them
+                # here would only pollute /brokerz and the reaper)
+                self.leases.evict_where(
+                    lambda lease: not self._owns(lease.namespace))
             self._rederived = True
 
     # -- admission -------------------------------------------------------------
@@ -241,12 +485,15 @@ class AttachBroker:
                 else:
                     self._inflight.pop(tenant, None)
 
-    def _retry_after_hint(self, tenant: str) -> float:
-        """When might this tenant's capacity free? The soonest expiry of
-        one of its own leases, clamped [1, 60]; 5s when nothing expires."""
+    def _retry_after_hint(self, tenant: str | None = None) -> float:
+        """When might capacity free? The soonest expiry among the
+        tenant's own leases (quota 429s), or across ALL leases when
+        ``tenant`` is None — the lease horizon, for queue-timeout 503s.
+        Clamped [1, 60]; 5s when nothing expires (a detach could happen
+        any time, but the client should not hammer)."""
         soonest = None
         for lease in self.leases.leases():
-            if lease.tenant != tenant:
+            if tenant is not None and lease.tenant != tenant:
                 continue
             remaining = lease.expires_in_s()
             if remaining is not None and (soonest is None
@@ -254,6 +501,21 @@ class AttachBroker:
                 soonest = remaining
         if soonest is None:
             return 5.0
+        return min(max(soonest, 1.0), 60.0)
+
+    def _capacity_hint(self) -> float:
+        return self._retry_after_hint(tenant=None)
+
+    def _queue_full_hint_locked(self, priority: str) -> float:
+        """Queue-full Retry-After: a slot frees no later than when the
+        OLDEST same-priority waiter hits its deadline (it may grant and
+        leave sooner) — that remaining time, floored by the lease
+        horizon when the queue math says "now", clamped [1, 60]."""
+        now = time.monotonic()
+        soonest = min((w.deadline - now for w in self._waiters
+                       if w.priority == priority), default=None)
+        if soonest is None or soonest <= 0:
+            return min(self._capacity_hint(), 60.0)
         return min(max(soonest, 1.0), 60.0)
 
     # -- attach orchestration --------------------------------------------------
@@ -265,13 +527,16 @@ class AttachBroker:
 
     def attach(self, *, tenant: str, priority: str, namespace: str,
                pod: str, chips: int, node: str, rid: str,
-               attempt_fn) -> tuple[int, dict]:
+               attempt_fn, entire: bool = False,
+               timeout_s: float | None = None) -> tuple[int, dict]:
         """Admission-gated attach: quota check, one attempt, then (when
         queueing is enabled) park in the contention queue until capacity
         frees, the deadline passes, or — for ``high`` — a preemption
         makes room. Successful attaches are recorded as leases. The
         admitted chips are held as an in-flight reservation until this
-        call returns, so concurrent same-tenant arrivals see them."""
+        call returns, so concurrent same-tenant arrivals see them.
+        ``timeout_s`` overrides the configured queue deadline (adopted
+        waiters park for their REMAINING time, not a fresh window)."""
         with self.admission(tenant, chips, rid):
             gen0 = self._gen
             status, payload = attempt_fn()
@@ -279,12 +544,14 @@ class AttachBroker:
                 self._record_success(namespace, pod, tenant, priority,
                                      payload, node, rid)
                 return status, payload
-            if not self._is_insufficient(status, payload) \
-                    or self.config.queue_timeout_s <= 0:
+            timeout = (self.config.queue_timeout_s if timeout_s is None
+                       else timeout_s)
+            if not self._is_insufficient(status, payload) or timeout <= 0:
                 return status, payload
             return self._attach_queued(tenant, priority, namespace, pod,
                                        chips, node, rid, attempt_fn,
-                                       status, payload, gen0)
+                                       status, payload, gen0, entire,
+                                       timeout)
 
     def _record_success(self, namespace: str, pod: str, tenant: str,
                         priority: str, payload: dict, node: str,
@@ -304,7 +571,10 @@ class AttachBroker:
     def _attach_queued(self, tenant: str, priority: str, namespace: str,
                        pod: str, chips: int, node: str, rid: str,
                        attempt_fn, status: int, payload: dict,
-                       gen0: int) -> tuple[int, dict]:
+                       gen0: int, entire: bool,
+                       timeout: float) -> tuple[int, dict]:
+        # ``timeout`` was resolved (and gated > 0) by attach() — a second
+        # default-resolution here could silently diverge from that gate
         with self._lock:
             depth = sum(1 for w in self._waiters
                         if w.priority == priority)
@@ -313,9 +583,15 @@ class AttachBroker:
                                                  outcome="queue_full")
                 EVENTS.emit("queue_full", rid=rid, tenant=tenant,
                             chips=chips, priority=priority, depth=depth)
-                raise QueueFullError(priority, depth, retry_after_s=1.0)
+                # a slot frees at the latest when the oldest same-
+                # priority waiter times out — tell the client exactly
+                # that instead of a blind constant
+                raise QueueFullError(
+                    priority, depth,
+                    retry_after_s=self._queue_full_hint_locked(priority))
             waiter = _Waiter(tenant, priority, chips, node, rid,
-                             namespace, pod, gen=gen0)
+                             namespace, pod, gen=gen0, entire=entire,
+                             timeout_s=timeout)
             self._waiters.append(waiter)
             if self._gen != gen0:
                 # capacity freed between the failed attempt and the
@@ -324,7 +600,10 @@ class AttachBroker:
                 waiter.tried_gen = self._gen
                 waiter.event.set()
             self._refresh_queue_gauges_locked()
-        deadline = waiter.enqueued_at + self.config.queue_timeout_s
+        # persisted intent (master/store.py): the parked request now
+        # survives this process — a failed-over peer adopts and drains it
+        self._persist_waiter(waiter, timeout)
+        deadline = waiter.deadline
         EVENTS.emit("queue_enqueue", rid=rid, tenant=tenant, chips=chips,
                     node=node, namespace=namespace, pod=pod,
                     priority=priority, depth=depth + 1)
@@ -347,9 +626,25 @@ class AttachBroker:
                     payload = dict(payload)
                     payload["queued_s"] = round(waited, 3)
                     payload["queue_timeout"] = True
-                    payload.setdefault("retry_after_s", 1.0)
+                    # derived hint: the lease horizon says when chips can
+                    # actually free — a constant would either hammer a
+                    # full node or sit out a fresh detach
+                    payload["retry_after_s"] = round(
+                        self._capacity_hint(), 1)
                     return status, payload
                 waiter.event.clear()
+                if waiter.outcome == "moved":
+                    # shard hand-off mid-wait: this replica no longer
+                    # owns the keyspace — tell the client to re-route
+                    # (the retry lands anywhere and is forwarded to the
+                    # new leader; same rid keeps it idempotent)
+                    EVENTS.emit("queue_moved", rid=rid, tenant=tenant,
+                                chips=chips, priority=priority)
+                    return 503, {
+                        "result": "ShardMoved",
+                        "message": "admission shard moved to another "
+                                   "replica mid-queue; retry",
+                        "retry_after_s": 1.0}
                 status, payload = attempt_fn()
                 if status == 200:
                     # leave the queue BEFORE signalling: the success's
@@ -387,6 +682,11 @@ class AttachBroker:
                 # every remaining waiter sleeps to its deadline.
                 self._signal_next_locked()
                 self._refresh_queue_gauges_locked()
+            # the parked intent is resolved (grant, timeout, error or
+            # hand-off): remove its store record — crash is the ONLY
+            # path that leaves one behind, which is exactly the intent
+            # a surviving replica must adopt
+            self._unpersist_waiter(waiter)
 
     # -- capacity signalling / fair dequeue ------------------------------------
 
@@ -556,12 +856,37 @@ class AttachBroker:
 
     def tick(self, now: float | None = None) -> int:
         """One maintenance pass: reap expired leases (auto-detach through
-        the worker path), refresh gauges. Returns leases reaped."""
+        the worker path — OWNED shards only, a peer's leases are its
+        leader's to reap), flush dirty store writes, refresh gauges.
+        Returns leases reaped."""
         self.ensure_rederived()
+        if self.store is not None and self.election is not None:
+            # a rehydration deferred by apiserver trouble (boot or
+            # acquisition) is retried here — a dead leader's persisted
+            # waiters must not stay stranded just because the first
+            # read failed
+            for shard in self.election.owned():
+                with self._adopt_lock:
+                    hydrated = shard in self._rehydrated_shards
+                if not hydrated:
+                    self._rehydrate_shard(shard)
         reaped = 0
         for lease in self.leases.expired(now):
+            if not self._owns(lease.namespace):
+                continue
             if self._reap(lease, now):
                 reaped += 1
+        if self.store is not None:
+            try:
+                self.store.flush_dirty()
+                # batched heartbeat persistence (lease.py renew():
+                # one CAS per shard instead of one per renewal)
+                self.leases.flush_renewals()
+            except StoreFencedError as e:
+                # a dirty replay bounced off the fence: same recovery as
+                # a direct write — note the refused fence and demote,
+                # and DON'T abort the tick (gauge refresh must still run)
+                self._on_fenced(e)
         with self._lock:
             self._refresh_queue_gauges_locked()
         self.leases.export_gauges()
@@ -645,10 +970,22 @@ class AttachBroker:
                                  if quota else None),
             }
         oldest = max((w["waiting_s"] for w in waiters), default=0.0)
+        ha: dict = {"enabled": False}
+        if self.ring is not None or self.store is not None:
+            ha = {
+                "enabled": True,
+                "shards": self.ring.shards if self.ring else 1,
+                "election": (self.election.snapshot()
+                             if self.election is not None
+                             else {"enabled": False}),
+                "store": (self.store.snapshot()
+                          if self.store is not None else None),
+            }
         return {
             "enabled": bool(self.config.quotas
                             or self.config.lease_ttl_s > 0
                             or self.config.queue_timeout_s > 0),
+            "ha": ha,
             "config": {
                 "quotas": dict(self.config.quotas),
                 "quota_burst": self.config.quota_burst,

@@ -124,10 +124,15 @@ class FleetAggregator:
 
     def __init__(self, targets_fn, usage_fn=None, slo=None,
                  tick_interval_s: float = DEFAULT_TICK_INTERVAL_S,
-                 scrape_timeout_s: float = SCRAPE_TIMEOUT_S):
+                 scrape_timeout_s: float = SCRAPE_TIMEOUT_S,
+                 ha_fn=None):
         self.targets_fn = targets_fn
         self.usage_fn = usage_fn or (lambda: {})
         self.slo = slo
+        # ha_fn() -> this master replica's HA posture (role per shard,
+        # peers from the election lock records, store lag) — the /fleetz
+        # section that makes a stuck failover visible in one command.
+        self.ha_fn = ha_fn
         self.tick_interval_s = tick_interval_s
         self.scrape_timeout_s = scrape_timeout_s
         # wall budget for ONE node's whole scrape (several sequential
@@ -437,4 +442,9 @@ class FleetAggregator:
         }
         if self.slo is not None:
             out["slo"] = self.slo.snapshot()
+        if self.ha_fn is not None:
+            try:
+                out["masters"] = self.ha_fn()
+            except Exception as e:   # noqa: BLE001 — view must render
+                out["masters"] = {"enabled": True, "error": str(e)[:200]}
         return out

@@ -43,7 +43,10 @@ from gpumounter_tpu.k8s.client import KubeClient
 from gpumounter_tpu.master.admission import AttachBroker
 from gpumounter_tpu.master.discovery import (WorkerDirectory,
                                              WorkerNotFoundError)
+from gpumounter_tpu.master.election import NullElection, ShardElection
 from gpumounter_tpu.master.fleet import FleetAggregator
+from gpumounter_tpu.master.shardring import HAConfig, ShardRing
+from gpumounter_tpu.master.store import IntentStore
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.events import EVENTS
 from gpumounter_tpu.utils.errors import (CircuitOpenError, K8sApiError,
@@ -155,7 +158,8 @@ class MasterGateway:
 
     def __init__(self, kube: KubeClient, directory: WorkerDirectory,
                  worker_client_factory=WorkerClient,
-                 worker_tracez_base=None, broker: AttachBroker | None = None):
+                 worker_tracez_base=None, broker: AttachBroker | None = None,
+                 ha: HAConfig | None = None):
         self.kube = kube
         self.directory = directory
         self._worker_client_factory = worker_client_factory
@@ -167,6 +171,27 @@ class MasterGateway:
         # the normal traced, breaker-guarded worker path.
         self.broker = broker or AttachBroker(kube)
         self.broker.bind(self._broker_detach)
+        # HA plane (docs/guide/HA.md): namespace hash-ring sharding of
+        # admission, per-shard leader election, declarative intent store.
+        # The default HAConfig is single-master PR 7 semantics — one
+        # shard, no election, no store, zero configmap traffic.
+        self.ha = ha or HAConfig()
+        self.ring: ShardRing | None = None
+        self.election = None
+        if self.ha.enabled:
+            self.ring = ShardRing(self.ha.shards)
+            if self.ha.election:
+                self.election = ShardElection(
+                    kube, self.ha,
+                    on_acquire=self.broker.on_shard_acquired,
+                    on_lose=self.broker.on_shard_lost)
+            else:
+                self.election = NullElection(self.ha.shards)
+            store = (IntentStore(kube, self.ring, self.ha.namespace,
+                                 election=self.election)
+                     if self.ha.store else None)
+            self.broker.bind_ha(store, self.ring, self.election)
+            self.broker.bind_attempt_factory(self._adopted_attempt)
         # Telemetry plane: the SLO engine computes per-tenant burn rates
         # from this process's registry; the fleet aggregator scrapes every
         # worker's health port into the /fleetz cluster view and ticks the
@@ -189,7 +214,8 @@ class MasterGateway:
             targets_fn=self._fleet_targets,
             usage_fn=self.broker.leases.usage,
             slo=self.slo,
-            tick_interval_s=fleet_interval)
+            tick_interval_s=fleet_interval,
+            ha_fn=self._ha_view)
         # gRPC target "ip:port" -> base URL of that worker's health/tracez
         # HTTP endpoint. The default follows the worker's fixed convention
         # (health on grpc_port + 1, worker/main.py HEALTH_PORT_OFFSET);
@@ -301,6 +327,10 @@ class MasterGateway:
                                  or get(consts.TENANT_HEADER.lower()))
                 ctx["priority"] = (get(consts.PRIORITY_HEADER)
                                    or get(consts.PRIORITY_HEADER.lower()))
+                # one-hop forwarding guard (see _shard_gate): a request a
+                # peer already forwarded is never forwarded again
+                ctx["forwarded"] = bool(get("X-Tpu-Forwarded")
+                                        or get("x-tpu-forwarded"))
         if rid:
             if not _RID_RE.match(rid):
                 return 400, {
@@ -418,6 +448,10 @@ class MasterGateway:
                 return 400, {"result": "BadRequest",
                              "message": f"bad isEntireMount value "
                                         f"{match['entire']!r}"}
+            gate = self._shard_gate(match["ns"], method, path, body, rid,
+                                    ctx)
+            if gate is not None:
+                return gate
             return self._add(match["ns"], match["pod"], int(match["num"]),
                              entire, rid, query, ctx)
         match = _REMOVE_RE.match(p) or _REMOVE_GPU_RE.match(p)
@@ -429,6 +463,10 @@ class MasterGateway:
                 return 400, {"result": "BadRequest",
                              "message": f"bad force value "
                                         f"{match['force']!r}"}
+            gate = self._shard_gate(match["ns"], method, path, body, rid,
+                                    ctx)
+            if gate is not None:
+                return gate
             uuids = _parse_uuids(body, parsed.query)
             return self._remove(match["ns"], match["pod"], uuids,
                                 force, rid)
@@ -446,6 +484,10 @@ class MasterGateway:
         if match:
             if method != "POST":
                 return self._method_not_allowed("POST", method, p)
+            gate = self._shard_gate(match["ns"], method, path, body, rid,
+                                    ctx)
+            if gate is not None:
+                return gate
             return self._renew(match["ns"], match["pod"], query)
         if p == "/addtpuslice":
             if method != "POST":
@@ -454,7 +496,7 @@ class MasterGateway:
         if p == "/removetpuslice":
             if method != "POST":
                 return self._method_not_allowed("POST", method, p)
-            return self._slice_detach(body, rid)
+            return self._slice_detach(body, rid, ctx)
         if p == "/tracez":
             if method != "GET":
                 return self._method_not_allowed("GET", method, p)
@@ -635,6 +677,16 @@ class MasterGateway:
                     f"tpusPerHost must be a positive integer, got {tpus!r}")
         except ValueError as e:
             return 400, {"result": "BadRequest", "message": str(e)}
+        # Shard gate keyed on the FIRST pod's namespace (the slice's
+        # admission home): a slice spans hosts, not tenancy domains —
+        # and under sharding it must not span namespaces either, or the
+        # foreign-namespace leases would land on a shard this replica
+        # never persists, reaps, or survives a restart with.
+        gate = (self._slice_shard_guard(pods)
+                or self._shard_gate(pods[0][0], "POST", "/addtpuslice",
+                                    body, rid, ctx))
+        if gate is not None:
+            return gate
         # Tenant admission for the WHOLE slice (body "tenant"/"priority",
         # falling back to header then the first pod's namespace): one
         # aggregate quota check before any host is touched — over-quota
@@ -677,11 +729,17 @@ class MasterGateway:
             "tenant": tenant,
             "pods": [r.to_json() for r in results]}
 
-    def _slice_detach(self, body: bytes, rid: str = "-") -> tuple[int, dict]:
+    def _slice_detach(self, body: bytes, rid: str = "-",
+                      ctx: dict | None = None) -> tuple[int, dict]:
         try:
             pods, obj = self._parse_slice_body(body)
         except ValueError as e:
             return 400, {"result": "BadRequest", "message": str(e)}
+        gate = (self._slice_shard_guard(pods)
+                or self._shard_gate(pods[0][0], "POST", "/removetpuslice",
+                                    body, rid, ctx))
+        if gate is not None:
+            return gate
         force = bool(obj.get("force", False))
         ok, results = self._slice_coordinator().detach(pods, force,
                                                        request_id=rid)
@@ -772,6 +830,169 @@ class MasterGateway:
             breaker.record_success()
             return result
 
+    # -- HA: shard gate + forwarding (master/shardring.py) ---------------------
+
+    def _slice_shard_guard(self, pods) -> tuple[int, dict] | None:
+        """Sharded admission is keyed on namespace: a slice spanning
+        namespaces would record leases for shards this replica does not
+        own — never persisted (the store skips foreign shards), never
+        reaped (the tick skips them), and evicted by the next
+        re-derivation. Reject it up front; single-master (election off)
+        accepts multi-namespace slices unchanged."""
+        if self.ring is None or self.election is None \
+                or not self.election.enabled:
+            return None
+        namespaces = {ns for ns, _ in pods}
+        if len(namespaces) > 1:
+            return 400, {
+                "result": "BadRequest",
+                "message": f"slice pods span namespaces "
+                           f"{sorted(namespaces)}: admission sharding "
+                           "is keyed on namespace, so a slice must stay "
+                           "in one"}
+        return None
+
+    def _shard_gate(self, namespace: str, method: str, path: str,
+                    body: bytes, rid: str,
+                    ctx: dict | None) -> tuple[int, dict] | None:
+        """None = this replica owns the namespace's shard, handle
+        locally. Otherwise the forwarded answer: proxied to the leader
+        (default — clients stay dumb), a 307 + Location under
+        ``TPU_SHARD_FORWARD=redirect``, or 503 + Retry-After when the
+        shard is currently leaderless (failover in progress)."""
+        if self.ring is None or self.election is None \
+                or not self.election.enabled:
+            return None
+        shard = self.ring.shard_of(namespace)
+        if self.election.is_leader(shard):
+            return None
+        retry_hint = round(max(self.ha.renew_interval_s, 1.0), 1)
+        if (ctx or {}).get("forwarded"):
+            # one-hop guard: a forwarded request landing on another
+            # non-owner means the routing tables disagree mid-failover —
+            # bounce to the client rather than ping-pong between peers
+            REGISTRY.shard_forwards.inc(mode=self.ha.forward,
+                                        outcome="loop")
+            return 503, {
+                "result": "ShardLeaderUnknown",
+                "message": f"shard {shard} ownership is in flux "
+                           "(failover in progress)",
+                "retry_after_s": retry_hint}
+        info = self.election.leaders().get(shard)
+        url = (info or {}).get("url", "")
+        if not info or info.get("expired") or not url \
+                or info.get("holder") == self.ha.replica:
+            REGISTRY.shard_forwards.inc(mode=self.ha.forward,
+                                        outcome="no_leader")
+            return 503, {
+                "result": "ShardLeaderUnknown",
+                "message": f"no live leader for shard {shard} yet",
+                "retry_after_s": retry_hint}
+        if self.ha.forward == "redirect":
+            REGISTRY.shard_forwards.inc(mode="redirect", outcome="ok")
+            return 307, {
+                "result": "ShardRedirect",
+                "location": url.rstrip("/") + path,
+                "shard": shard,
+                "leader": info.get("holder", "")}
+        return self._proxy_to_leader(url, method, path, body, rid, ctx,
+                                     shard)
+
+    def _proxy_to_leader(self, base: str, method: str, path: str,
+                         body: bytes, rid: str, ctx: dict | None,
+                         shard: int) -> tuple[int, dict]:
+        url = base.rstrip("/") + path
+        req = urllib.request.Request(url, data=body or None,
+                                     method=method)
+        req.add_header("X-Request-Id", rid)
+        req.add_header("X-Tpu-Forwarded", "1")
+        for header, key in ((consts.TENANT_HEADER, "tenant"),
+                            (consts.PRIORITY_HEADER, "priority")):
+            value = (ctx or {}).get(key)
+            if value:
+                req.add_header(header, value)
+        # a queued attach legitimately holds the upstream connection for
+        # the whole queue deadline — the proxy must outwait it
+        timeout = max(30.0, self.broker.config.queue_timeout_s + 30.0)
+        try:
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    status, raw = resp.status, resp.read()
+            except urllib.error.HTTPError as e:
+                status, raw = e.code, e.read()
+        except (urllib.error.URLError, OSError) as e:
+            REGISTRY.shard_forwards.inc(mode="proxy", outcome="error")
+            return 502, {"result": "ShardForwardFailed",
+                         "message": f"shard {shard} leader at {base} "
+                                    f"unreachable: {e}",
+                         "retry_after_s": round(
+                             max(self.ha.renew_interval_s, 1.0), 1)}
+        try:
+            payload = json.loads(raw or b"{}")
+        except ValueError:
+            payload = {"result": "ShardForwardBadPayload",
+                       "message": raw.decode(errors="replace")[:200]}
+        REGISTRY.shard_forwards.inc(mode="proxy", outcome="ok")
+        if isinstance(payload, dict):
+            payload.setdefault("forwarded_shard", shard)
+        return status, payload
+
+    def _worker_attach_attempt(self, namespace: str, pod_name: str,
+                               chips: int, entire: bool, rid: str,
+                               node: str, adopted: bool = False):
+        """The one attach attempt_fn: the worker add_tpu RPC + result
+        accounting + HTTP mapping, shared by the live route (`_add`) and
+        adopted waiter re-runs so the two can never drift. Only invoked
+        from inside broker.attach, so admission, queueing and lease
+        recording all wrap it — the assert pins that wiring for the
+        admission lint."""
+        assert self.broker is not None
+
+        def attempt() -> tuple[int, dict]:
+            resp = self._call_node_worker(
+                node, lambda w: w.add_tpu(pod_name, namespace, chips,
+                                          entire, request_id=rid))
+            result = consts.AddResult(resp.result)
+            REGISTRY.attach_results.inc(result=f"master_{result.name}")
+            payload = {
+                "result": result.name,
+                "device_ids": list(resp.device_ids),
+                "device_paths": list(resp.device_paths),
+            }
+            if adopted:
+                payload["adopted"] = True
+            return _ADD_HTTP[result], payload
+
+        return attempt
+
+    def _adopted_attempt(self, namespace: str, pod_name: str, chips: int,
+                         entire: bool, rid: str, node: str):
+        """attempt_fn factory for a waiter rehydrated from the store:
+        the exact worker RPC `_add` would have run, under the ORIGINAL
+        request id (the worker's per-rid adoption makes the re-run
+        idempotent). Bound via bind_attempt_factory in __init__."""
+        return self._worker_attach_attempt(namespace, pod_name, chips,
+                                           entire, rid, node,
+                                           adopted=True)
+
+    def _ha_view(self) -> dict:
+        """This replica's HA posture for /fleetz + the fleet CLI: role
+        per shard, peers as the lock records name them, store lag.
+        Store-only (election off) still counts as enabled — a lagging
+        store is exactly what a restart would lose, and hiding it from
+        fleet/doctor because nobody is electing would bury the signal."""
+        if self.election is None:
+            return {"enabled": False}
+        enabled = bool(self.election.enabled
+                       or self.broker.store is not None)
+        view: dict = {"enabled": enabled,
+                      "replica": self.ha.replica,
+                      "shards": self.ha.shards,
+                      "election": self.election.snapshot()}
+        if self.broker.store is not None:
+            view["store"] = self.broker.store.snapshot()
+        return view
+
     def _add(self, namespace: str, pod_name: str, tpu_num: int,
              entire: bool, rid: str = "-", query: dict | None = None,
              ctx: dict | None = None) -> tuple[int, dict]:
@@ -802,22 +1023,12 @@ class MasterGateway:
                 raise PodNotFoundError(namespace, pod_name)
             annotate(node=node, tenant=tenant)
 
-        def attempt() -> tuple[int, dict]:
-            resp = self._call_node_worker(
-                node, lambda w: w.add_tpu(pod_name, namespace, tpu_num,
-                                          entire, request_id=rid))
-            result = consts.AddResult(resp.result)
-            REGISTRY.attach_results.inc(result=f"master_{result.name}")
-            return _ADD_HTTP[result], {
-                "result": result.name,
-                "device_ids": list(resp.device_ids),
-                "device_paths": list(resp.device_paths),
-            }
-
         return self.broker.attach(
             tenant=tenant, priority=priority, namespace=namespace,
             pod=pod_name, chips=tpu_num, node=node, rid=rid,
-            attempt_fn=attempt)
+            attempt_fn=self._worker_attach_attempt(
+                namespace, pod_name, tpu_num, entire, rid, node),
+            entire=entire)
 
     def _remove(self, namespace: str, pod_name: str, uuids: list[str],
                 force: bool, rid: str = "-") -> tuple[int, dict]:
@@ -982,6 +1193,12 @@ class MasterGateway:
                 allow = obj.get("allow")
                 if status == 405 and allow:
                     self.send_header("Allow", allow)
+                location = obj.get("location")
+                if location and status in (301, 302, 307, 308):
+                    # shard redirect (TPU_SHARD_FORWARD=redirect): the
+                    # payload names the owning replica; lift it into the
+                    # header a redirect-following client acts on
+                    self.send_header("Location", location)
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
@@ -1013,6 +1230,12 @@ class MasterGateway:
         # it exported).
         self.broker.start()
         self.fleet.start()
+        # HA: the election loop acquires/renews this replica's shard
+        # locks; its lifetime is tied to the server's like the loops
+        # above (a stopped master must release nothing by crashing — the
+        # locks simply expire and peers take over within one interval).
+        if self.election is not None:
+            self.election.start()
         # Flight-recorder bundles written by this master carry the broker
         # state (who held what when the anomaly fired). Registered HERE,
         # symmetric with the removal in shutdown: a gateway constructed
@@ -1026,6 +1249,8 @@ class MasterGateway:
         def shutdown_with_loops():
             self.fleet.stop()
             self.broker.stop()
+            if self.election is not None:
+                self.election.stop()
             # the process-global recorder must not snapshot a stopped
             # broker into later bundles (or retain this gateway forever)
             from gpumounter_tpu.utils.flight import RECORDER

@@ -32,14 +32,21 @@ def main() -> None:
     # TPU_LEASE_TTL_S, TPU_QUEUE_TIMEOUT_S (... all default-off). serve()
     # starts its lease-expiry loop.
     broker = AttachBroker(kube, BrokerConfig.from_settings(settings))
+    # HA plane: TPU_MASTER_SHARDS / TPU_ELECTION / TPU_INTENT_STORE —
+    # all default-off = single-master semantics (docs/guide/HA.md).
+    from gpumounter_tpu.master.shardring import HAConfig
+    ha = HAConfig.from_settings(settings)
     gateway = MasterGateway(
         kube, directory,
         worker_client_factory=lambda target: WorkerClient(target, tls=tls),
-        broker=broker)
+        broker=broker, ha=ha)
     server = gateway.serve(settings.master_http_port)
-    logger.info("master ready on :%d (quotas=%s lease_ttl=%gs queue=%gs)",
+    logger.info("master ready on :%d (quotas=%s lease_ttl=%gs queue=%gs "
+                "shards=%d election=%s store=%s replica=%s)",
                 settings.master_http_port, settings.tenant_quotas or "off",
-                settings.lease_ttl_s, settings.queue_timeout_s)
+                settings.lease_ttl_s, settings.queue_timeout_s,
+                ha.shards, "on" if ha.election else "off",
+                "on" if ha.store else "off", ha.replica)
     try:
         while True:
             time.sleep(3600)

@@ -1,0 +1,537 @@
+"""Declarative intent store: broker state as cluster ground truth.
+
+The Kubernetes Network Driver Model (PAPERS.md) argues that lifecycle
+state belonging to a controller must be *declaratively persisted* so any
+replica can re-derive it — not resident in one process's memory. Before
+this module the broker was exactly the anti-pattern: leases could be
+re-derived from slave-pod labels after a restart, but every parked queue
+entry (the *intent* to attach once capacity frees) died with the master.
+
+This store persists BOTH as annotation records on per-shard state
+ConfigMaps (``tpu-mounter-broker-state-<shard>`` in the pool namespace),
+written through the existing :class:`~gpumounter_tpu.k8s.client
+.KubeClient` (REST and fake alike) with resourceVersion compare-and-swap:
+
+- every record is one annotation — key ``tpumounter.io/l-<digest>`` /
+  ``tpumounter.io/w-<digest>`` (identity lives IN the record; annotation
+  names are length-capped), value the record's canonical JSON;
+- a write reads the shard map, checks the **fencing token**
+  (``tpumounter.io/fence``), and merge-patches with the observed
+  resourceVersion as precondition. A concurrent writer makes the CAS
+   409; we re-read and retry. A *deposed* leader (its token below the
+  recorded fence) gets :class:`StoreFencedError` and must demote — the
+  split-brain impossibility argument in docs/guide/HA.md;
+- a failed write (apiserver unreachable) parks the mutation in a dirty
+  queue retried by the broker tick; ``tpumounter_store_lag`` is the age
+  of the oldest unflushed mutation, and a torn record (crash mid-write)
+  fails JSON-parse on rehydrate and degrades to slave-pod re-derivation
+  instead of poisoning the table.
+
+Rehydration (:meth:`IntentStore.rehydrate`) returns the shard's lease
+and waiter records; the broker merges leases (in-process state wins) and
+adopts waiters — re-running each parked attach under its original
+request id, so the worker's per-rid idempotent adoption guarantees zero
+double-actuation even when the dead leader's attempt had already landed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import threading
+import time
+from typing import Any
+
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.errors import K8sApiError, StoreFencedError
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("master.store")
+
+# CAS attempts per write before the mutation parks in the dirty queue: a
+# conflict means another replica just wrote, so the retry re-reads and
+# almost always lands; more than a handful losing streaks means the
+# apiserver is the problem, not the race.
+CAS_ATTEMPTS = 6
+
+
+def _digest(identity: str) -> str:
+    return hashlib.sha256(identity.encode()).hexdigest()[:16]
+
+
+def _canonical(obj: dict) -> str:
+    """One byte-stable serialization (sorted keys, no whitespace): the
+    round-trip tests pin serialize→CAS-write→rehydrate byte-identity."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+@dataclasses.dataclass
+class LeaseRecord:
+    """A lease as persisted: wall-clock expiry (monotonic deadlines are
+    process-local and meaningless to the replica that rehydrates)."""
+
+    namespace: str
+    pod: str
+    tenant: str
+    priority: str = consts.DEFAULT_PRIORITY
+    chips: int = 0
+    uuids: list[str] = dataclasses.field(default_factory=list)
+    node: str = ""
+    rid: str = ""
+    created_unix: float = 0.0
+    expires_unix: float | None = None   # None = never expires
+    renewals: int = 0
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.namespace, self.pod)
+
+    @property
+    def annotation_key(self) -> str:
+        return (consts.STORE_LEASE_ANNOTATION_PREFIX
+                + _digest(f"{self.namespace}/{self.pod}"))
+
+    def to_json(self) -> str:
+        return _canonical(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, text: str) -> "LeaseRecord":
+        obj = json.loads(text)
+        record = cls(**obj)
+        if not record.namespace or not record.pod:
+            raise ValueError(f"lease record missing identity: {text!r}")
+        return record
+
+    @classmethod
+    def from_lease(cls, lease) -> "LeaseRecord":
+        remaining = lease.expires_in_s()
+        return cls(namespace=lease.namespace, pod=lease.pod,
+                   tenant=lease.tenant, priority=lease.priority,
+                   chips=lease.chips, uuids=sorted(lease.uuids),
+                   node=lease.node, rid=lease.rid,
+                   created_unix=round(lease.created_unix, 3),
+                   expires_unix=(None if remaining is None
+                                 else round(time.time() + remaining, 3)),
+                   renewals=lease.renewals)
+
+    def to_lease(self):
+        from gpumounter_tpu.master.lease import Lease
+        expires_at = None
+        if self.expires_unix is not None:
+            expires_at = time.monotonic() + (self.expires_unix
+                                             - time.time())
+        return Lease(self.namespace, self.pod, self.tenant, self.priority,
+                     chips=self.chips, uuids=set(self.uuids),
+                     node=self.node, rid=self.rid,
+                     created_unix=self.created_unix,
+                     expires_at=expires_at, renewals=self.renewals)
+
+
+@dataclasses.dataclass
+class WaiterRecord:
+    """A parked queue entry as persisted: everything a surviving replica
+    needs to re-run the attach — target pod, chip count, the entire-mount
+    flag, and the ORIGINAL request id (the idempotency key that makes the
+    re-run adopt rather than double-attach)."""
+
+    rid: str
+    namespace: str
+    pod: str
+    tenant: str
+    priority: str = consts.DEFAULT_PRIORITY
+    chips: int = 0
+    node: str = ""
+    entire: bool = False
+    enqueued_unix: float = 0.0
+    deadline_unix: float = 0.0
+
+    @property
+    def annotation_key(self) -> str:
+        return consts.STORE_WAITER_ANNOTATION_PREFIX + _digest(self.rid)
+
+    def to_json(self) -> str:
+        return _canonical(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, text: str) -> "WaiterRecord":
+        obj = json.loads(text)
+        record = cls(**obj)
+        if not record.rid or not record.pod:
+            raise ValueError(f"waiter record missing identity: {text!r}")
+        return record
+
+
+class IntentStore:
+    """Write-through persistence of broker intent, sharded by namespace.
+
+    ``token_fn(shard) -> int | None`` supplies the election fencing token
+    (None = election off, fence checks skipped). All writes are
+    best-effort durable: an apiserver outage parks mutations in a dirty
+    queue (flushed by the broker tick) rather than failing admissions —
+    losing a store write degrades to PR 7 semantics for that record,
+    never to a refused attach.
+    """
+
+    def __init__(self, kube, ring, namespace: str | None = None,
+                 election=None):
+        from gpumounter_tpu.master.election import NullElection
+        self.kube = kube
+        self.ring = ring
+        self.namespace = namespace or consts.DEFAULT_POOL_NAMESPACE
+        # Election supplies ownership + fencing tokens. NullElection
+        # (election off) owns everything with token None — fence checks
+        # are skipped, the single-master configuration.
+        self.election = election or NullElection(ring.shards)
+        self._lock = threading.Lock()
+        # last observed (resourceVersion, annotations) per shard map —
+        # the CAS fast path patches against this without a fresh GET
+        self._observed: dict[int, tuple[str, dict[str, str]]] = {}
+        # (shard, key, value-or-None, parked_monotonic): mutations that
+        # could not reach the apiserver, replayed oldest-first
+        self._dirty: list[tuple[int, str, str | None, float]] = []
+        self.torn_records = 0
+
+    # -- naming ----------------------------------------------------------------
+
+    def cm_name(self, shard: int) -> str:
+        return f"{consts.STORE_CONFIGMAP_PREFIX}{shard}"
+
+    def shard_of(self, namespace: str) -> int:
+        return self.ring.shard_of(namespace)
+
+    # -- write-through ---------------------------------------------------------
+
+    def put_lease(self, record: LeaseRecord) -> bool:
+        return self._write(self.shard_of(record.namespace),
+                           record.annotation_key, record.to_json())
+
+    def delete_lease(self, namespace: str, pod: str) -> bool:
+        key = (consts.STORE_LEASE_ANNOTATION_PREFIX
+               + _digest(f"{namespace}/{pod}"))
+        return self._write(self.shard_of(namespace), key, None)
+
+    def put_leases(self, records: list[LeaseRecord]) -> None:
+        """Batched write-through: all of one shard's records land in ONE
+        CAS merge-patch (re-derivation syncs N leases at once; N
+        sequential round-trips against the same ConfigMap would be
+        O(N) for what is one annotation merge). Falls back to per-record
+        writes — with their dirty-parking — when a batch cannot land."""
+        by_shard: dict[int, list[LeaseRecord]] = {}
+        for record in records:
+            by_shard.setdefault(self.shard_of(record.namespace),
+                                []).append(record)
+        for shard, group in by_shard.items():
+            if self.election.enabled and self.election.token(shard) is None:
+                continue
+            changes = {r.annotation_key: r.to_json() for r in group}
+            try:
+                self._cas(shard, changes)
+            except StoreFencedError:
+                raise
+            except K8sApiError:
+                for record in group:
+                    self._write(shard, record.annotation_key,
+                                record.to_json())
+                continue
+            REGISTRY.store_cas.inc(op="put", outcome="ok")
+            with self._lock:
+                # the batch supersedes any parked mutation for its keys
+                self._dirty = [d for d in self._dirty
+                               if not (d[0] == shard and d[1] in changes)]
+            self._export_records(shard)
+        self._export_lag_locked_free()
+
+    def put_waiter(self, record: WaiterRecord) -> bool:
+        return self._write(self.shard_of(record.namespace),
+                           record.annotation_key, record.to_json())
+
+    def delete_waiter(self, namespace: str, rid: str) -> bool:
+        key = consts.STORE_WAITER_ANNOTATION_PREFIX + _digest(rid)
+        return self._write(self.shard_of(namespace), key, None)
+
+    def _write(self, shard: int, key: str, value: str | None,
+               _from_dirty: bool = False) -> bool:
+        """CAS the annotation in (value=None deletes). True = landed;
+        False = parked dirty (apiserver trouble). Raises
+        :class:`StoreFencedError` when this replica's token is below the
+        shard's recorded fence — the caller has been deposed."""
+        op = "put" if value is not None else "delete"
+        if self.election.enabled and self.election.token(shard) is None:
+            # No live token: either the shard is a peer's (its leader
+            # owns persistence) or OUR leadership transiently decayed
+            # (renewals stalled past TTL). Writing would be unfenced, so
+            # don't — but PARK the mutation: a resumed leadership must
+            # replay it (flush_dirty keeps decayed-shard entries and
+            # drops them only on a REAL hand-off), or the store would
+            # silently disagree with memory forever.
+            logger.debug("store write %s %s parked: no live token for "
+                         "shard %d", op, key, shard)
+            if not _from_dirty:
+                self._park(shard, key, value)
+            self._export_lag_locked_free()
+            return False
+        try:
+            self._cas(shard, {key: value})
+        except StoreFencedError as e:
+            if e.token == -1 and not _from_dirty:
+                # the decay guard inside _cas (leadership lapsed between
+                # the precheck and the CAS): same treatment as above
+                self._park(shard, key, value)
+                self._export_lag_locked_free()
+                return False
+            raise
+        except K8sApiError as e:
+            REGISTRY.store_cas.inc(op=op, outcome="error")
+            if not _from_dirty:
+                self._park(shard, key, value)
+            self._export_lag_locked_free()
+            logger.warning("store write %s %s parked dirty: %s", op, key,
+                           e)
+            return False
+        REGISTRY.store_cas.inc(op=op, outcome="ok")
+        with self._lock:
+            # a LIVE write that landed supersedes any older parked
+            # mutation for the key — replaying it would resurrect a
+            # deleted record (or delete a re-recorded one)
+            self._dirty = [d for d in self._dirty
+                           if not (d[0] == shard and d[1] == key)]
+        self._export_records(shard)
+        self._export_lag_locked_free()
+        return True
+
+    def _park(self, shard: int, key: str, value: str | None) -> None:
+        """Queue a mutation for the dirty-flush, last-writer-wins per
+        key: a newer failed mutation REPLACES an older parked one
+        (keeping the older timestamp — lag measures the oldest
+        unpersisted state change); two parked mutations for one key
+        would replay the stale one over the fresh one."""
+        with self._lock:
+            for i, parked in enumerate(self._dirty):
+                if parked[0] == shard and parked[1] == key:
+                    self._dirty[i] = (shard, key, value, parked[3])
+                    return
+            self._dirty.append((shard, key, value, time.monotonic()))
+
+    def _cas(self, shard: int, changes: dict[str, str | None]) -> None:
+        """One annotation merge under resourceVersion CAS + fence check,
+        retried on conflict with a fresh read. The fence bump rides in
+        the same patch, so "check the token" and "write the record" are
+        one atomic step — a deposed leader cannot interleave."""
+        name = self.cm_name(shard)
+        token = self.election.token(shard)
+        if self.election.enabled and token is None:
+            # Leadership decayed between the caller's ownership check
+            # and here (paused process, missed renewals): writing now
+            # would be UNFENCED — the one hole in the split-brain
+            # argument. Refuse; the caller demotes and the shard's new
+            # leader owns the record. (token -1 = "no live token".)
+            raise StoreFencedError(shard, -1, 0)
+        last: K8sApiError | None = None
+        for _ in range(CAS_ATTEMPTS):
+            observed = self._observe(shard)
+            if observed is None:
+                # shard map does not exist yet: create IS the CAS
+                annotations = {k: v for k, v in changes.items()
+                               if v is not None}
+                if token is not None:
+                    annotations[consts.STORE_FENCE_ANNOTATION] = str(token)
+                try:
+                    created = self.kube.create_config_map(
+                        self.namespace,
+                        {"apiVersion": "v1", "kind": "ConfigMap",
+                         "metadata": {
+                             "name": name,
+                             "labels": {"app": "tpu-mounter-broker-state"},
+                             "annotations": annotations}})
+                except K8sApiError as e:
+                    if e.status == 409:     # lost the create race
+                        last = e
+                        REGISTRY.store_cas.inc(op="put",
+                                               outcome="conflict")
+                        continue
+                    raise
+                self._remember(shard, created)
+                return
+            rv, annotations = observed
+            fence = int(annotations.get(consts.STORE_FENCE_ANNOTATION)
+                        or 0)
+            if token is not None and token < fence:
+                raise StoreFencedError(shard, token, fence)
+            patch_ann: dict[str, Any] = dict(changes)
+            if token is not None and token > fence:
+                patch_ann[consts.STORE_FENCE_ANNOTATION] = str(token)
+            try:
+                updated = self.kube.patch_config_map(
+                    self.namespace, name,
+                    {"metadata": {"annotations": patch_ann}},
+                    resource_version=rv)
+            except K8sApiError as e:
+                if e.status in (404, 409):
+                    # 409: another replica wrote first; 404: deleted under
+                    # us — both mean "re-observe and retry"
+                    last = e
+                    REGISTRY.store_cas.inc(
+                        op="put" if any(v is not None
+                                        for v in changes.values())
+                        else "delete", outcome="conflict")
+                    with self._lock:
+                        self._observed.pop(shard, None)
+                    continue
+                raise
+            self._remember(shard, updated)
+            return
+        raise last or K8sApiError(409, "store CAS retries exhausted")
+
+    def _observe(self, shard: int) -> tuple[str, dict[str, str]] | None:
+        with self._lock:
+            cached = self._observed.get(shard)
+        if cached is not None:
+            return cached
+        try:
+            cm = self.kube.get_config_map(self.namespace,
+                                          self.cm_name(shard))
+        except K8sApiError as e:
+            if e.status == 404:
+                return None
+            raise
+        return self._remember(shard, cm)
+
+    def _remember(self, shard: int,
+                  cm: dict[str, Any]) -> tuple[str, dict[str, str]]:
+        meta = cm.get("metadata", {})
+        observed = (meta.get("resourceVersion", ""),
+                    dict(meta.get("annotations") or {}))
+        with self._lock:
+            self._observed[shard] = observed
+        return observed
+
+    # -- dirty-queue flush (driven by the broker tick) -------------------------
+
+    def flush_dirty(self) -> int:
+        """Replay parked mutations oldest-first; stops at the first one
+        that still fails (ordering matters: a delete must not land before
+        the put it supersedes). Returns mutations flushed."""
+        flushed = 0
+        while True:
+            with self._lock:
+                if not self._dirty:
+                    break
+                shard, key, value, _ = self._dirty[0]
+            if self.election.enabled \
+                    and self.election.token(shard) is None:
+                holder = (self.election.leaders().get(shard)
+                          or {}).get("holder", "")
+                replica = getattr(self.election, "replica", "")
+                if holder and replica and holder != replica:
+                    # REAL hand-off (the lock names a peer): the new
+                    # leader's rehydration owns the state — drop
+                    with self._lock:
+                        self._dirty.pop(0)
+                    continue
+                # leadership merely decayed (lock still names us, or
+                # unobserved): keep the mutation parked for the resume
+                break
+            if not self._write(shard, key, value, _from_dirty=True):
+                break
+            with self._lock:
+                # the success path already dropped every parked
+                # mutation for the key; this is a belt-and-braces guard
+                # against the head surviving (it must not loop forever)
+                if self._dirty and self._dirty[0][:2] == (shard, key):
+                    self._dirty.pop(0)
+            flushed += 1
+        self._export_lag_locked_free()
+        return flushed
+
+    def forget_shard(self, shard: int) -> None:
+        """Drop a lost shard's cached view and its parked mutations: the
+        new leader owns that state now, and replaying our stale writes
+        would only bounce off the fence."""
+        with self._lock:
+            self._observed.pop(shard, None)
+            self._dirty = [d for d in self._dirty if d[0] != shard]
+        # the records belong to the new leader now — freezing our last
+        # counts would double-count them in any cross-replica sum (same
+        # vanished-series discipline as lease.py's _known_tenants)
+        for kind in ("lease", "waiter"):
+            REGISTRY.store_records.set(0, kind=kind, shard=str(shard))
+        self._export_lag_locked_free()
+
+    def lag_s(self) -> float:
+        with self._lock:
+            if not self._dirty:
+                return 0.0
+            return time.monotonic() - self._dirty[0][3]
+
+    def _export_lag_locked_free(self) -> None:
+        REGISTRY.store_lag.set(round(self.lag_s(), 3))
+
+    def _export_records(self, shard: int) -> None:
+        with self._lock:
+            observed = self._observed.get(shard)
+        if observed is None:
+            return
+        _, annotations = observed
+        leases = sum(1 for k in annotations
+                     if k.startswith(consts.STORE_LEASE_ANNOTATION_PREFIX))
+        waiters = sum(
+            1 for k in annotations
+            if k.startswith(consts.STORE_WAITER_ANNOTATION_PREFIX))
+        # per-shard series: a replica owning several shards must not
+        # have the last-written shard's counts overwrite the others'
+        REGISTRY.store_records.set(leases, kind="lease", shard=str(shard))
+        REGISTRY.store_records.set(waiters, kind="waiter",
+                                   shard=str(shard))
+
+    # -- rehydration -----------------------------------------------------------
+
+    def rehydrate(self, shard: int
+                  ) -> tuple[list[LeaseRecord], list[WaiterRecord], int]:
+        """The shard's persisted intent: (leases, waiters, torn). A torn
+        record — a crash mid-annotation-write left unparseable JSON — is
+        counted, logged and dropped; the caller degrades that record to
+        slave-pod re-derivation (leases) or loses the intent (waiters),
+        never a poisoned table."""
+        try:
+            cm = self.kube.get_config_map(self.namespace,
+                                          self.cm_name(shard))
+        except K8sApiError as e:
+            if e.status == 404:
+                return [], [], 0
+            raise
+        self._remember(shard, cm)
+        annotations = dict(cm.get("metadata", {}).get("annotations") or {})
+        leases: list[LeaseRecord] = []
+        waiters: list[WaiterRecord] = []
+        torn = 0
+        for key, value in annotations.items():
+            try:
+                if key.startswith(consts.STORE_LEASE_ANNOTATION_PREFIX):
+                    leases.append(LeaseRecord.from_json(value))
+                elif key.startswith(
+                        consts.STORE_WAITER_ANNOTATION_PREFIX):
+                    waiters.append(WaiterRecord.from_json(value))
+            except (ValueError, TypeError) as e:
+                torn += 1
+                logger.warning(
+                    "torn store record %s dropped (%s); degrading to "
+                    "cluster re-derivation", key, e)
+        if torn:
+            self.torn_records += torn
+        self._export_records(shard)
+        return leases, waiters, torn
+
+    # -- introspection ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            dirty = len(self._dirty)
+        return {
+            "namespace": self.namespace,
+            "shards": self.ring.shards,
+            "dirty": dirty,
+            "lag_s": round(self.lag_s(), 3),
+            "torn_records": self.torn_records,
+        }

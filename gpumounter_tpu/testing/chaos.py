@@ -284,7 +284,7 @@ def _fixture_device_nodes(rig) -> set[str]:
     return nodes
 
 
-def assert_broker_invariants(broker, sim) -> None:
+def assert_broker_invariants(broker, sim, store=None) -> None:
     """The broker-layer contract after any contention / lease-race /
     preemption / master-restart plan (rides on top of
     :func:`assert_invariants`, which owns the node-local guarantees):
@@ -297,6 +297,11 @@ def assert_broker_invariants(broker, sim) -> None:
        would have desynced one side).
     2. **No queue residue**: every waiter has returned (completed, timed
        out, or errored) — a crash/restart plan must not strand a thread.
+    3. **Store mirrors the same truth** (``store`` given — the HA
+       cross-replica view): the persisted lease records across ALL
+       shards account exactly the cluster-ground-truth chips, and no
+       waiter record outlives its resolution — what a failed-over peer
+       would rehydrate is the truth, not a stale or doubled ledger.
     """
     from gpumounter_tpu.k8s import objects
     from gpumounter_tpu.utils import consts
@@ -326,6 +331,22 @@ def assert_broker_invariants(broker, sim) -> None:
         residue = list(broker._waiters)
     assert not residue, \
         f"{len(residue)} waiter(s) still parked in the broker queue"
+    if store is not None:
+        stored: dict[tuple[str, str], int] = {}
+        waiter_records = []
+        for shard in range(store.ring.shards):
+            lease_records, shard_waiters, torn = store.rehydrate(shard)
+            assert torn == 0, f"shard {shard}: {torn} torn record(s)"
+            for record in lease_records:
+                stored[record.key] = stored.get(record.key, 0) \
+                    + record.chips
+            waiter_records.extend(shard_waiters)
+        assert stored == held, \
+            f"intent-store lease records {stored} != cluster ground " \
+            f"truth {held} (a failed-over peer would rehydrate a lie)"
+        assert not waiter_records, \
+            f"{len(waiter_records)} waiter record(s) outlived their " \
+            f"resolution: {[w.rid for w in waiter_records]}"
 
 
 def assert_invariants(rig, expected_uuids: set[str],

@@ -78,7 +78,9 @@ class HttpApiserver:
                 parts = url.path.strip("/").split("/")
                 verb = ("WATCH" if q.get("watch") == "true"
                         else "GET" if len(parts) in (4, 6) else "LIST")
-                resource = "nodes" if parts[2:3] == ["nodes"] else "pods"
+                resource = ("nodes" if parts[2:3] == ["nodes"]
+                            else "configmaps"
+                            if parts[4:5] == ["configmaps"] else "pods")
                 if self._inject(verb, resource):
                     return
                 try:
@@ -86,6 +88,9 @@ class HttpApiserver:
                             parts[2:3] == ["nodes"] and len(parts) == 4:
                         return self._json(200, outer.kube.get_node(parts[3]))
                     ns = parts[3]
+                    if len(parts) == 6 and parts[4] == "configmaps":
+                        return self._json(200, outer.kube.get_config_map(
+                            ns, parts[5]))
                     if len(parts) == 6:         # single pod GET
                         return self._json(200, outer.kube.get_pod(
                             ns, parts[5]))
@@ -126,34 +131,49 @@ class HttpApiserver:
                 obj = json.loads(self.rfile.read(length) or b"{}")
                 parts = self.path.strip("/").split("/")
                 ns = parts[3]
-                if self._inject("POST", "events" if parts[4:5] == ["events"]
-                                else "pods"):
+                resource = (parts[4] if parts[4:5] in (["events"],
+                                                       ["configmaps"])
+                            else "pods")
+                if self._inject("POST", resource):
                     return
                 try:
-                    if parts[4:5] == ["events"]:
+                    if resource == "events":
                         return self._json(
                             201, outer.kube.create_event(ns, obj))
+                    if resource == "configmaps":
+                        return self._json(
+                            201, outer.kube.create_config_map(ns, obj))
                     return self._json(201, outer.kube.create_pod(ns, obj))
                 except K8sApiError as e:
                     return self._json(e.status or 500, {"message": str(e)})
 
             def do_DELETE(self):
                 parts = urlparse(self.path).path.strip("/").split("/")
-                if self._inject("DELETE", "pods"):
+                resource = ("configmaps" if parts[4:5] == ["configmaps"]
+                            else "pods")
+                if self._inject("DELETE", resource):
                     return
-                outer.kube.delete_pod(parts[3], parts[5])
+                if resource == "configmaps":
+                    outer.kube.delete_config_map(parts[3], parts[5])
+                else:
+                    outer.kube.delete_pod(parts[3], parts[5])
                 return self._json(200, {"status": "Success"})
 
             def do_PATCH(self):
                 length = int(self.headers.get("Content-Length") or 0)
                 patch = json.loads(self.rfile.read(length) or b"{}")
                 parts = urlparse(self.path).path.strip("/").split("/")
-                if self._inject("PATCH", "pods"):
+                resource = ("configmaps" if parts[4:5] == ["configmaps"]
+                            else "pods")
+                if self._inject("PATCH", resource):
                     return
                 # the rv precondition rides inside metadata, exactly as
                 # the REST client sends it (client.py patch_pod)
                 rv = (patch.get("metadata") or {}).get("resourceVersion")
                 try:
+                    if resource == "configmaps":
+                        return self._json(200, outer.kube.patch_config_map(
+                            parts[3], parts[5], patch, resource_version=rv))
                     return self._json(200, outer.kube.patch_pod(
                         parts[3], parts[5], patch, resource_version=rv))
                 except PodNotFoundError as e:

@@ -415,6 +415,128 @@ class LiveStack:
         self.rig.close()
 
 
+class MultiMasterStack:
+    """N master gateways — each a REAL HTTP front with its own broker,
+    election view and intent store — over ONE fake cluster and one live
+    gRPC worker: the HA control-plane topology (docs/guide/HA.md).
+
+    Every master shares the FakeKubeClient, so election locks and store
+    records written by one replica are cluster state the others observe —
+    exactly the production coordination medium, minus the network. The
+    chaos suite kills the leader mid-queue (:meth:`kill` = stop serving +
+    stop renewing, clean up NOTHING — crash semantics: lock and intent
+    records survive on the "cluster") and asserts the peer takes the
+    shard over and drains the persisted waiters.
+    """
+
+    def __init__(self, rig: WorkerRig, masters: int = 2,
+                 shards: int | None = None, broker_config=None,
+                 store: bool = True, election: bool = True,
+                 forward: str = "proxy",
+                 renew_interval_s: float = 0.15,
+                 lease_duration_s: float = 0.45):
+        import dataclasses
+
+        from gpumounter_tpu.master.admission import AttachBroker
+        from gpumounter_tpu.master.discovery import WorkerDirectory
+        from gpumounter_tpu.master.gateway import MasterGateway
+        from gpumounter_tpu.master.shardring import HAConfig, ShardRing
+        from gpumounter_tpu.worker.grpc_server import build_server
+
+        self.rig = rig
+        self.kube = rig.sim.kube
+        self.shards = shards or masters
+        self.ring = ShardRing(self.shards)
+        self.grpc_server, grpc_port = build_server(rig.service, port=0,
+                                                   address="127.0.0.1")
+        self.grpc_server.start()
+        self.kube.put_pod(worker_pod(rig.sim.node, "127.0.0.1",
+                                     grpc_port=grpc_port))
+        self.gateways = []
+        self.http_servers = []
+        self.bases: list[str] = []
+        self.dead: set[int] = set()
+        for i in range(masters):
+            ha = HAConfig(
+                shards=self.shards, election=election, store=store,
+                replica=f"master-{i}", forward=forward,
+                renew_interval_s=renew_interval_s,
+                lease_duration_s=lease_duration_s,
+                namespace=rig.sim.settings.pool_namespace)
+            config = (dataclasses.replace(
+                broker_config, quotas=dict(broker_config.quotas))
+                if broker_config is not None else None)
+            broker = AttachBroker(self.kube, config)
+            gateway = MasterGateway(
+                self.kube, WorkerDirectory(self.kube,
+                                           grpc_port=grpc_port),
+                # no per-worker health sidecars in this stack: disable
+                # the fleet scrape (and /tracez stitch) resolution
+                worker_tracez_base=lambda target: None,
+                broker=broker, ha=ha)
+            server = gateway.serve(port=0, address="127.0.0.1")
+            base = f"http://127.0.0.1:{server.server_port}"
+            # the ephemeral port exists only now: advertise it — the
+            # next renew writes it into the lock record peers route by
+            ha.advertise_url = base
+            self.gateways.append(gateway)
+            self.http_servers.append(server)
+            self.bases.append(base)
+
+    def live(self) -> list[int]:
+        return [i for i in range(len(self.gateways))
+                if i not in self.dead]
+
+    def wait_converged(self, timeout_s: float = 10.0) -> None:
+        """Block until every shard has a live leader whose advertised URL
+        has propagated into every live replica's routing view."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            owned = set()
+            views_ok = True
+            for i in self.live():
+                election = self.gateways[i].election
+                for shard in range(self.shards):
+                    if election.is_leader(shard):
+                        owned.add(shard)
+                leaders = election.leaders()
+                for shard in range(self.shards):
+                    info = leaders.get(shard)
+                    if not info or info.get("expired") \
+                            or not info.get("url"):
+                        views_ok = False
+            if views_ok and owned == set(range(self.shards)):
+                return
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"election never converged: owned={sorted(owned)} "
+                    f"of {self.shards} shard(s)")
+            time.sleep(0.03)
+
+    def leader_for(self, namespace: str) -> int:
+        """Index of the live master leading the namespace's shard."""
+        shard = self.ring.shard_of(namespace)
+        for i in self.live():
+            if self.gateways[i].election.is_leader(shard):
+                return i
+        raise AssertionError(f"no live leader for shard {shard}")
+
+    def kill(self, i: int) -> None:
+        """Crash master ``i``: stop serving and stop every loop (incl.
+        election renewal) but clean up NOTHING — its lock records simply
+        expire and its store records await the next leader, exactly like
+        a SIGKILL'd replica."""
+        self.dead.add(i)
+        self.http_servers[i].shutdown()
+
+    def close(self) -> None:
+        for i in self.live():
+            self.http_servers[i].shutdown()
+            self.dead.add(i)
+        self.grpc_server.stop(grace=0)
+        self.rig.close()
+
+
 class MultiNodeStack:
     """N simulated TPU nodes (one WorkerRig + live gRPC worker each) behind
     ONE master — the multi-host slice topology (BASELINE config 5). Node i

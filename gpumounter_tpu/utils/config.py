@@ -133,6 +133,19 @@ class Settings:
     lease_ttl_s: float = 0.0
     queue_timeout_s: float = 0.0
     queue_depth: int = 64
+    # HA control plane (master/shardring.py HAConfig.from_settings):
+    # admission sharding, per-shard leader election, and the declarative
+    # intent store. ALL defaults preserve single-master PR 7 semantics:
+    # one shard, no election (this replica owns everything), no store
+    # (state is process-resident + slave-pod re-derivation).
+    master_shards: int = 1
+    election_enabled: bool = False
+    election_renew_s: float = consts.DEFAULT_ELECTION_RENEW_S
+    election_ttl_s: float = consts.DEFAULT_ELECTION_TTL_S
+    intent_store_enabled: bool = False
+    replica_id: str = ""
+    advertise_url: str = ""
+    shard_forward: str = "proxy"            # "proxy" | "redirect"
     # Resident actuation agent (actuation/agent.py): cached namespace fds
     # + in-process batch execution on the attach/detach hot path, with
     # transparent fallback on any agent fault. Default ON in production;
@@ -185,6 +198,30 @@ class Settings:
             s.queue_timeout_s = float(t)
         if t := env.get(consts.ENV_QUEUE_DEPTH):
             s.queue_depth = int(t)
+        if t := env.get(consts.ENV_MASTER_SHARDS):
+            s.master_shards = int(t)
+            if s.master_shards < 1:
+                raise ValueError(
+                    f"{consts.ENV_MASTER_SHARDS} must be >= 1, got {t!r}")
+        s.election_enabled = env.get(consts.ENV_ELECTION, "0") == "1"
+        if t := env.get(consts.ENV_ELECTION_RENEW_S):
+            s.election_renew_s = float(t)
+        if t := env.get(consts.ENV_ELECTION_TTL_S):
+            s.election_ttl_s = float(t)
+        if s.election_ttl_s < s.election_renew_s:
+            raise ValueError(
+                f"{consts.ENV_ELECTION_TTL_S} ({s.election_ttl_s}) must be "
+                f">= {consts.ENV_ELECTION_RENEW_S} ({s.election_renew_s}): "
+                "a lock that expires between renewals flaps leadership")
+        s.intent_store_enabled = env.get(consts.ENV_INTENT_STORE, "0") == "1"
+        s.replica_id = env.get(consts.ENV_REPLICA_ID, "")
+        s.advertise_url = env.get(consts.ENV_ADVERTISE_URL, "")
+        forward = env.get(consts.ENV_SHARD_FORWARD, "proxy")
+        if forward not in ("proxy", "redirect"):
+            raise ValueError(
+                f"{consts.ENV_SHARD_FORWARD} must be proxy|redirect, "
+                f"got {forward!r}")
+        s.shard_forward = forward
         s.informer_enabled = env.get(consts.ENV_INFORMER, "1") != "0"
         s.agent_enabled = env.get(consts.ENV_AGENT, "1") != "0"
         if t := env.get(consts.ENV_ENUM_CACHE_TTL_S):

@@ -169,6 +169,56 @@ ENV_GATEWAY_MAX_CONNS = "TPU_GATEWAY_MAX_CONNS"
 # gRPC channels kept per worker target (round-robined per call).
 ENV_GATEWAY_WORKER_CHANNELS = "TPU_GATEWAY_WORKER_CHANNELS"
 
+# --- HA control plane (master/store.py, master/election.py, ------------------
+# master/shardring.py) ---------------------------------------------------------
+# Number of admission shards the tenant/namespace hash ring is divided
+# into. 1 (the default) = no sharding — every replica would own the whole
+# keyspace, exactly the single-master PR 7 semantics.
+ENV_MASTER_SHARDS = "TPU_MASTER_SHARDS"
+# "1" enables per-shard leader election over CAS'd renewable lock records
+# (ConfigMap annotations). Off (the default) = this replica considers
+# itself leader of every shard and never touches the lock objects —
+# exactly the single-master semantics.
+ENV_ELECTION = "TPU_ELECTION"
+# Election cadence: the leader re-CAS-renews each held lock every
+# renew interval; a lock unrenewed for the lease duration is dead and a
+# peer takes the shard over (failover time <= one renew interval past
+# the lease deadline).
+ENV_ELECTION_RENEW_S = "TPU_ELECTION_RENEW_S"
+ENV_ELECTION_TTL_S = "TPU_ELECTION_TTL_S"
+# "1" enables the declarative intent store (master/store.py): every
+# lease and parked queue entry is persisted as an annotation record on a
+# per-shard state ConfigMap, so a restarted or failed-over replica
+# rehydrates BOTH leases and waiters. Off (the default) = broker state
+# is process-resident, re-derived from slave-pod labels only (PR 7).
+ENV_INTENT_STORE = "TPU_INTENT_STORE"
+# This replica's identity in election lock records (default: hostname —
+# in a Deployment that is the pod name, unique per replica).
+ENV_REPLICA_ID = "TPU_REPLICA_ID"
+# Base URL peers use to reach THIS replica (Location target of shard
+# forwards), e.g. "http://$(POD_IP):8080". Empty = this replica cannot
+# be forwarded to (peers answer 503 + Retry-After instead).
+ENV_ADVERTISE_URL = "TPU_ADVERTISE_URL"
+# What a non-owning replica does with a request for a foreign shard:
+# "proxy" (default — re-issues the request against the owner and relays
+# the answer, clients stay dumb) or "redirect" (307 + Location).
+ENV_SHARD_FORWARD = "TPU_SHARD_FORWARD"
+DEFAULT_ELECTION_RENEW_S = 2.0
+DEFAULT_ELECTION_TTL_S = 6.0
+
+# Cluster objects the HA plane persists through (pool namespace):
+# per-shard broker state (lease/waiter annotation records) and per-shard
+# election locks. Both are ConfigMaps — the one declaratively-persisted,
+# CAS-able object kind the control plane needs beyond pods.
+STORE_CONFIGMAP_PREFIX = "tpu-mounter-broker-state-"
+ELECTION_CONFIGMAP_PREFIX = "tpu-mounter-election-"
+# Annotation key prefixes of the store's records ("l-"/"w-" + a stable
+# digest of the record identity; annotation names are length-capped, so
+# the identity lives IN the record, not the key) and the fencing token.
+STORE_LEASE_ANNOTATION_PREFIX = "tpumounter.io/l-"
+STORE_WAITER_ANNOTATION_PREFIX = "tpumounter.io/w-"
+STORE_FENCE_ANNOTATION = "tpumounter.io/fence"
+
 # Request headers naming the tenant/priority (query params ?tenant= /
 # ?priority= take precedence; both fall back to namespace / "normal").
 TENANT_HEADER = "X-Tpu-Tenant"

@@ -131,6 +131,21 @@ class QueueFullError(TPUMounterError):
         self.retry_after_s = retry_after_s
 
 
+class StoreFencedError(TPUMounterError):
+    """An intent-store write carried a fencing token below the shard's
+    recorded fence: this replica was deposed (a peer acquired the shard
+    with a higher token) and must demote instead of writing — the
+    mechanism that makes split-brain writes impossible (docs/guide/HA.md)."""
+
+    def __init__(self, shard: int, token: int, fence: int):
+        super().__init__(
+            f"store write fenced on shard {shard}: token {token} < "
+            f"recorded fence {fence} (a peer leads this shard now)")
+        self.shard = shard
+        self.token = token
+        self.fence = fence
+
+
 class CircuitOpenError(TPUMounterError):
     """A circuit breaker is open: the target has failed enough consecutive
     calls that further attempts are refused without dialing, until the

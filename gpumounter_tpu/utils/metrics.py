@@ -584,6 +584,47 @@ class Registry:
             "tpumounter_flight_suppressed_total",
             "Flight-recorder triggers suppressed by the rate limit")
         self.flight_suppressed.inc(0.0)  # pre-seed: see orphans_reclaimed
+        # HA control plane (master/store.py, master/election.py,
+        # master/shardring.py). store_cas counts every intent-store
+        # compare-and-swap by op (put/delete/fence) and outcome
+        # (ok/conflict/error); conflicts are normal CAS churn between
+        # replicas, errors mean records are parked dirty (see store_lag).
+        self.store_cas = Counter(
+            "tpumounter_store_cas_total",
+            "Intent-store ConfigMap compare-and-swap attempts by op and "
+            "outcome (conflict = lost an optimistic-concurrency race)")
+        for outcome in ("ok", "conflict", "error"):
+            # pre-seed: an incident's FIRST conflict/error must read as a
+            # non-zero increase() (see flight_dumps pre-seed rationale)
+            self.store_cas.inc(0.0, op="put", outcome=outcome)
+        self.store_records = Gauge(
+            "tpumounter_store_records",
+            "Intent records this replica has persisted in its owned "
+            "shards' state ConfigMaps, by kind (lease/waiter) and shard")
+        self.store_lag = Gauge(
+            "tpumounter_store_lag",
+            "Seconds since the oldest broker mutation that has not yet "
+            "reached the intent store (0 = store in sync)")
+        # Per-shard leadership, as THIS replica sees it (1 = this replica
+        # holds the shard's lock). max by (shard) across replicas == 0
+        # means nobody leads the shard — admission for it is down.
+        self.election_is_leader = Gauge(
+            "tpumounter_election_is_leader",
+            "Whether this replica currently leads the shard (1/0); "
+            "max over replicas == 0 means the shard is leaderless")
+        self.election_transitions = Counter(
+            "tpumounter_election_transitions_total",
+            "Shard leadership transitions observed by this replica, by "
+            "shard and outcome (acquired/lost) — a climbing rate is "
+            "leadership flapping")
+        self.election_transitions.inc(0.0, shard="0", outcome="acquired")
+        self.election_transitions.inc(0.0, shard="0", outcome="lost")
+        self.shard_forwards = Counter(
+            "tpumounter_shard_forwards_total",
+            "Requests that landed on a non-owning replica and were "
+            "forwarded to the shard leader, by mode (proxy/redirect) "
+            "and outcome")
+        self.shard_forwards.inc(0.0, mode="proxy", outcome="ok")
         # Fleet aggregator (master/fleet.py): workers by scrape health.
         self.fleet_nodes = Gauge(
             "tpumounter_fleet_nodes",

@@ -20,3 +20,18 @@ def test_contention_config_measures_wakeup_not_timeout():
     assert out["queued_attach_samples"] >= 2
     assert 0 < out["queued_attach_wait_p50_s"] < 30.0
     assert out["preemption_e2e_p50_s"] > 0
+
+
+def test_multimaster_config_scales_admission(monkeypatch):
+    """ISSUE 8 acceptance: the multi-master config's own selftest (the
+    >= 1.8x scaling assert) must hold on a short window too — and the
+    output contract carries both absolute throughputs and the ratio.
+    The window is shortened for suite time; the modeled RTT stays the
+    shipped one so the measured ratio is the real configuration's."""
+    out = bench.measure_multimaster(window_s=2.5)
+    assert out["multimaster_scaling_x"] >= 1.8
+    assert out["multimaster_admission_cps_2"] > \
+        out["multimaster_admission_cps_1"] > 0
+    assert out["multimaster_store_write_rtt_s"] == \
+        bench.MM_STORE_WRITE_RTT_S
+    assert out["multimaster_clients"] == 12

@@ -557,3 +557,45 @@ def test_slice_attach_is_quota_gated(stack_factory):
     status, payload = stack.gateway.handle("POST", "/addtpuslice", body)
     assert status == 200, payload
     assert stack.gateway.broker.leases.tenant_usage("sliceTeam") == 2
+
+
+def test_queue_hints_derive_from_waiters_and_lease_horizon(stack_factory):
+    """ISSUE 8 satellite: queue-full and queue-timeout shed responses
+    carry a DERIVED Retry-After — queue-full from the oldest
+    same-priority waiter's remaining deadline (a slot frees no later
+    than that), queue-timeout from the lease horizon (when chips can
+    actually expire free) — not the old blind 1-second constant."""
+    stack = stack_factory(
+        config=BrokerConfig(queue_timeout_s=20.0, queue_depth=1,
+                            lease_ttl_s=45.0),
+        extra_pods=("w2", "w3"))
+    gw = stack.gateway
+    assert add(gw, "workload", 4, entire=True)[0] == 200
+    done = {}
+    thread = threading.Thread(
+        target=lambda: done.update(res=add(gw, "w2", 2)))
+    thread.start()
+    _wait_until(lambda: len(gw.broker._waiters) == 1, what="enqueue")
+    status, body = add(gw, "w3", 2)               # FIFO at bound: shed
+    assert status == 429 and body["result"] == "QueueFull"
+    # the parked waiter dies in <= 20s, so the hint must say ~that —
+    # not 1s (hammering a full node) and never past the deadline
+    assert 10.0 <= body["retry_after_s"] <= 20.0
+    assert remove(gw, "workload")[0] == 200
+    thread.join(timeout=30)
+    assert done["res"][0] == 200
+    assert remove(gw, "w2")[0] == 200
+
+    # queue-timeout: the ONLY capacity signal is the 45s lease TTL on a
+    # fresh hold — the timed-out waiter's hint is the lease horizon
+    # (clamped to 60), not a constant
+    assert add(gw, "workload", 4, entire=True)[0] == 200
+    broker = gw.broker
+    status, body = broker.attach(
+        tenant="default", priority="normal", namespace="default",
+        pod="w3", chips=2, node="node-a", rid="hint-1",
+        attempt_fn=lambda: (503, {"result": "INSUFFICIENT_TPU"}),
+        timeout_s=0.2)
+    assert status == 503 and body["queue_timeout"]
+    assert 30.0 <= body["retry_after_s"] <= 45.0, body
+    stack.close()

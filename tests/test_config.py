@@ -105,3 +105,60 @@ def test_json_log_format(monkeypatch, capsys):
         for h in old_handlers:
             root.addHandler(h)
         monkeypatch.setattr(log_mod, "_configured", True)
+
+
+def test_ha_settings_from_env():
+    s = Settings.from_env({
+        consts.ENV_MASTER_SHARDS: "4",
+        consts.ENV_ELECTION: "1",
+        consts.ENV_ELECTION_RENEW_S: "0.5",
+        consts.ENV_ELECTION_TTL_S: "1.5",
+        consts.ENV_INTENT_STORE: "1",
+        consts.ENV_REPLICA_ID: "master-abc",
+        consts.ENV_ADVERTISE_URL: "http://10.0.0.7:8080",
+        consts.ENV_SHARD_FORWARD: "redirect",
+    })
+    assert s.master_shards == 4
+    assert s.election_enabled and s.intent_store_enabled
+    assert s.election_renew_s == 0.5 and s.election_ttl_s == 1.5
+    assert s.replica_id == "master-abc"
+    assert s.advertise_url == "http://10.0.0.7:8080"
+    assert s.shard_forward == "redirect"
+    # ALL defaults = single-master PR 7 semantics (docs/guide/HA.md)
+    s = Settings.from_env({})
+    assert s.master_shards == 1
+    assert not s.election_enabled and not s.intent_store_enabled
+    assert s.shard_forward == "proxy"
+    assert s.election_renew_s == consts.DEFAULT_ELECTION_RENEW_S
+    assert s.election_ttl_s == consts.DEFAULT_ELECTION_TTL_S
+    # misconfigurations that would flap leadership or split the ring
+    with pytest.raises(ValueError):
+        Settings.from_env({consts.ENV_MASTER_SHARDS: "0"})
+    with pytest.raises(ValueError):
+        # a lock that expires between renewals flaps every interval
+        Settings.from_env({consts.ENV_ELECTION_RENEW_S: "5",
+                           consts.ENV_ELECTION_TTL_S: "2"})
+    with pytest.raises(ValueError):
+        Settings.from_env({consts.ENV_SHARD_FORWARD: "broadcast"})
+
+
+def test_ha_config_maps_settings():
+    from gpumounter_tpu.master.shardring import HAConfig
+    s = Settings.from_env({
+        consts.ENV_MASTER_SHARDS: "2",
+        consts.ENV_ELECTION: "1",
+        consts.ENV_INTENT_STORE: "1",
+        consts.ENV_REPLICA_ID: "m-0",
+        consts.ENV_ADVERTISE_URL: "http://m-0:8080",
+        consts.ENV_POOL_NAMESPACE: "my-pool",
+    })
+    ha = HAConfig.from_settings(s)
+    assert ha.shards == 2 and ha.election and ha.store
+    assert ha.replica == "m-0"
+    assert ha.advertise_url == "http://m-0:8080"
+    assert ha.namespace == "my-pool"
+    assert ha.enabled
+    # defaults: disabled plane, replica falls back to the hostname
+    ha = HAConfig.from_settings(Settings.from_env({}))
+    assert not ha.enabled
+    assert ha.replica            # never empty — lock records need identity

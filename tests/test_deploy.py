@@ -20,6 +20,15 @@ def load(name):
         return list(yaml.safe_load_all(f))
 
 
+def _master_docs():
+    """(deployment, pdb) from the master manifest — two docs since the
+    HA control plane shipped replicas: 2 behind a disruption budget."""
+    docs = load("tpu-mounter-master.yaml")
+    deployment = next(d for d in docs if d["kind"] == "Deployment")
+    pdb = next(d for d in docs if d["kind"] == "PodDisruptionBudget")
+    return deployment, pdb
+
+
 def _production_manifests():
     # deploy/ top level = the production manifests deploy.sh applies;
     # subdirectories (e2e-kind/) are harness-specific overlays
@@ -48,7 +57,7 @@ def test_pool_namespace_consistent_with_code():
     env = {e["name"]: e.get("value")
            for e in worker["spec"]["template"]["spec"]["containers"][0]["env"]}
     assert env[consts.ENV_POOL_NAMESPACE] == consts.DEFAULT_POOL_NAMESPACE
-    (master,) = load("tpu-mounter-master.yaml")
+    master, _ = _master_docs()
     menv = {e["name"]: e.get("value")
             for e in master["spec"]["template"]["spec"]["containers"][0]["env"]}
     assert menv[consts.ENV_POOL_NAMESPACE] == consts.DEFAULT_POOL_NAMESPACE
@@ -100,10 +109,59 @@ def test_worker_lands_on_every_tpu_nodepool():
 def test_service_targets_master_port():
     (svc,) = load("tpu-mounter-svc.yaml")
     assert svc["spec"]["ports"][0]["targetPort"] == consts.MASTER_HTTP_PORT
-    (master,) = load("tpu-mounter-master.yaml")
+    master, _ = _master_docs()
     mlabels = master["spec"]["template"]["metadata"]["labels"]
     for k, v in svc["spec"]["selector"].items():
         assert mlabels.get(k) == v
+
+
+def test_master_ha_topology():
+    """replicas: 2 is only safe with the FULL HA triple on (shards +
+    election + store — docs/guide/HA.md); and two replicas need the
+    spread + disruption guards or they share one failure domain."""
+    master, pdb = _master_docs()
+    assert master["spec"]["replicas"] == 2
+    spec = master["spec"]["template"]["spec"]
+    env = {e["name"]: e.get("value", e.get("valueFrom"))
+           for e in spec["containers"][0]["env"]}
+    assert env[consts.ENV_MASTER_SHARDS] == "2"
+    assert env[consts.ENV_ELECTION] == "1"
+    assert env[consts.ENV_INTENT_STORE] == "1"
+    # replica identity = pod name; advertise URL = pod IP — both from the
+    # downward API, so no two replicas can collide or advertise the VIP
+    assert env[consts.ENV_REPLICA_ID]["fieldRef"]["fieldPath"] \
+        == "metadata.name"
+    assert "$(POD_IP)" in env[consts.ENV_ADVERTISE_URL]
+    assert env["POD_IP"]["fieldRef"]["fieldPath"] == "status.podIP"
+    terms = (spec["affinity"]["podAntiAffinity"]
+             ["preferredDuringSchedulingIgnoredDuringExecution"])
+    assert any(t["podAffinityTerm"]["topologyKey"]
+               == "kubernetes.io/hostname" for t in terms)
+    # the PDB must select these pods and keep one alive through drains
+    assert pdb["spec"]["maxUnavailable"] == 1
+    selector = pdb["spec"]["selector"]["matchLabels"]
+    labels = master["spec"]["template"]["metadata"]["labels"]
+    assert all(labels.get(k) == v for k, v in selector.items())
+
+
+def test_rbac_grants_ha_configmap_access_pool_scoped_only():
+    """The election locks and intent store live in pool-namespace
+    ConfigMaps; the grant must be namespaced (a cluster-wide configmap
+    write grant would let a compromised master poison any namespace)."""
+    docs = load("rbac.yaml")
+    for doc in docs:
+        if doc["kind"] == "ClusterRole":
+            for rule in doc["rules"]:
+                assert "configmaps" not in rule["resources"]
+    (role,) = [d for d in docs if d["kind"] == "Role"]
+    cm_rules = [r for r in role["rules"]
+                if "configmaps" in r["resources"]]
+    assert cm_rules, "pool-namespace Role grants no configmap access"
+    verbs = {v for r in cm_rules for v in r["verbs"]}
+    assert {"get", "create", "patch", "delete"} <= verbs
+    # patch (CAS merge) is the write primitive; update/replace would
+    # bypass the resourceVersion discipline the store depends on
+    assert "update" not in verbs
 
 
 def test_rbac_is_not_cluster_admin():

@@ -1,0 +1,209 @@
+"""Leader-election suite (master/election.py): acquisition of free and
+expired locks, renewal, CAS races producing exactly one winner, fencing
+token bumps on takeover, local-validity decay without apiserver access,
+demotion on observing a foreign holder, and the transition events +
+metrics doctor/alerts consume."""
+
+import time
+
+from gpumounter_tpu.k8s.client import FakeKubeClient
+from gpumounter_tpu.master.election import NullElection, ShardElection
+from gpumounter_tpu.master.shardring import HAConfig
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.events import EVENTS
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+
+def make_election(kube, replica, shards=1, url="", renew=0.5, ttl=1.5,
+                  **hooks):
+    ha = HAConfig(shards=shards, election=True, replica=replica,
+                  advertise_url=url or f"http://{replica}:8080",
+                  renew_interval_s=renew, lease_duration_s=ttl)
+    return ShardElection(kube, ha, **hooks)
+
+
+def test_acquires_free_shard_and_renews():
+    kube = FakeKubeClient()
+    acquired = []
+    election = make_election(kube, "m0",
+                             on_acquire=lambda s: acquired.append(s))
+    election.tick()
+    assert acquired == [0]
+    assert election.is_leader(0) and election.token(0) == 1
+    assert election.owned() == [0]
+    # renewal pushes the deadline and keeps the fence stable
+    election.tick()
+    assert election.token(0) == 1
+    assert REGISTRY.election_is_leader.value(shard="0") == 1
+    snap = election.snapshot()
+    assert snap["shards"]["0"]["holder"] == "m0"
+    assert snap["shards"]["0"]["leader"] is True
+
+
+def test_acquire_race_has_one_winner():
+    kube = FakeKubeClient()
+    a = make_election(kube, "m0")
+    b = make_election(kube, "m1")
+    a.tick()
+    b.tick()
+    assert a.is_leader(0) and not b.is_leader(0)
+    # the loser's routing view names the winner
+    assert b.leaders()[0]["holder"] == "m0"
+    assert b.leaders()[0]["url"] == "http://m0:8080"
+
+
+def test_dead_leader_fails_over_with_fence_bump():
+    kube = FakeKubeClient()
+    a = make_election(kube, "m0", ttl=0.2, renew=0.1)
+    b = make_election(kube, "m1", ttl=0.2, renew=0.1,
+                      url="http://m1:8080")
+    a.tick()
+    assert a.is_leader(0)
+    b.tick()
+    assert not b.is_leader(0)
+    # m0 "dies": no more renews; its LOCAL validity decays too
+    time.sleep(0.25)
+    assert not a.is_leader(0), "a non-renewing holder must stop acting"
+    lost = REGISTRY.election_transitions.value(shard="0", outcome="lost")
+    b.tick()                          # observes the expired deadline
+    assert b.is_leader(0)
+    assert b.token(0) == 2, "takeover must bump the fencing token"
+    # the zombie's next tick sees the foreign holder and demotes cleanly
+    a.tick()
+    assert not a.is_leader(0)
+    assert REGISTRY.election_transitions.value(
+        shard="0", outcome="lost") >= lost + 1
+
+
+def test_lost_shard_fires_on_lose_hook_and_event():
+    kube = FakeKubeClient()
+    lost = []
+    a = make_election(kube, "m0", ttl=0.2, renew=0.1,
+                      on_lose=lambda s: lost.append(s))
+    b = make_election(kube, "m1", ttl=0.2, renew=0.1)
+    a.tick()
+    time.sleep(0.25)
+    b.tick()
+    before = EVENTS.tail(256)
+    a.tick()
+    assert lost == [0]
+    kinds = [e["kind"] for e in EVENTS.tail(256)[len(before) - 256:]]
+    assert "election_lost" in [e["kind"] for e in EVENTS.tail(256)]
+    assert "election_acquired" in kinds or "election_acquired" in \
+        [e["kind"] for e in before]
+
+
+def test_demote_on_fenced_write():
+    kube = FakeKubeClient()
+    lost = []
+    a = make_election(kube, "m0", on_lose=lambda s: lost.append(s))
+    a.tick()
+    assert a.is_leader(0)
+    a.demote(0, "fenced store write")
+    assert not a.is_leader(0) and lost == [0]
+    assert a.token(0) is None
+
+
+def test_restart_within_own_ttl_resumes_without_fence_bump():
+    kube = FakeKubeClient()
+    a = make_election(kube, "m0")
+    a.tick()
+    assert a.token(0) == 1
+    # same replica identity, fresh process (a Deployment restart): the
+    # lock still names it, so it resumes instead of fencing itself out
+    a2 = make_election(kube, "m0")
+    a2.tick()
+    assert a2.is_leader(0) and a2.token(0) == 1
+
+
+def test_multi_shard_ownership_is_per_shard():
+    kube = FakeKubeClient()
+    a = make_election(kube, "m0", shards=2)
+    b = make_election(kube, "m1", shards=2, ttl=1.5)
+    a.tick()                      # grabs both free shards
+    assert set(a.owned()) == {0, 1}
+    b.tick()
+    assert b.owned() == []
+    # m0 releases nothing; only expiry hands shards over — b's view
+    # still routes every shard to m0
+    leaders = b.leaders()
+    assert {leaders[s]["holder"] for s in (0, 1)} == {"m0"}
+
+
+def test_null_election_owns_everything_with_no_traffic():
+    kube = FakeKubeClient()
+    null = NullElection(4)
+    assert null.is_leader(3) and null.token(0) is None
+    assert null.owned() == [0, 1, 2, 3]
+    null.tick()
+    null.start()
+    null.stop()
+    assert kube.cm_calls == 0
+    assert null.snapshot() == {"enabled": False, "shards": 4}
+
+
+def test_election_loop_start_stop():
+    kube = FakeKubeClient()
+    a = make_election(kube, "m0", renew=0.05, ttl=0.3)
+    a.start()
+    deadline = time.monotonic() + 5.0
+    while not a.is_leader(0):
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    a.stop()
+    # stopping does NOT release the lock — it expires, like a crash
+    cm = kube.get_config_map(consts.DEFAULT_POOL_NAMESPACE,
+                             a.lock_name(0))
+    holder = cm["metadata"]["annotations"]["tpumounter.io/holder"]
+    assert holder == "m0"
+
+
+def test_deleted_lock_object_cannot_livelock_below_the_store_fence():
+    """Review fix: an operator deleting the lock ConfigMap restarts
+    lock fences at 1 while the STORE still records a higher fence; the
+    refused fence is noted and the next acquisition (and even a resume
+    renew of a stale lock) clears it instead of livelocking
+    acquire → fenced write → demote forever."""
+    kube = FakeKubeClient()
+    election = make_election(kube, "m0")
+    election.tick()
+    assert election.token(0) == 1
+    # the store refused a write with recorded fence 7 (the broker's
+    # _on_fenced path calls exactly this before demoting)
+    election.note_fence(0, 7)
+    election.demote(0, "fenced store write")
+    assert not election.is_leader(0)
+    # the lock still NAMES m0 (demotion is local): the resume-renew
+    # must bump past the floor, not resume the dead token
+    election.tick()
+    assert election.is_leader(0)
+    assert election.token(0) == 8
+    # and a fresh lock object (deleted + recreated) also clears it
+    kube.delete_config_map(consts.DEFAULT_POOL_NAMESPACE,
+                           election.lock_name(0))
+    election.note_fence(0, 11)
+    election.demote(0, "fenced again")
+    election.tick()
+    assert election.is_leader(0)
+    assert election.token(0) == 12
+
+
+def test_validity_anchored_at_tick_start_not_patch_completion():
+    """Review fix: the lock's advertised deadline is tick-start + TTL,
+    so local validity must anchor there too — anchoring after the
+    apiserver round-trip would keep is_leader() True past the deadline
+    a peer is entitled to take over at (admission overlap)."""
+    from gpumounter_tpu.testing.chaos import Fault, FaultInjector
+    kube = FakeKubeClient()
+    election = make_election(kube, "m0", ttl=1.5)
+    rtt = 0.25
+    kube.faults = FaultInjector(
+        [Fault(op="GET", resource="configmaps", latency_s=rtt, times=50)])
+    t0 = time.monotonic()
+    election.tick()
+    kube.faults = None
+    held = election._held[0]
+    # validity ends within TTL of TICK START (+ scheduling slack), not
+    # TTL past the slow round-trip's completion
+    assert held.valid_until <= t0 + 1.5 + 0.05, \
+        "leadership validity extends past the advertised lock deadline"

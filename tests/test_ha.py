@@ -1,0 +1,647 @@
+"""HA control-plane suite (ISSUE 8 tentpole): multi-master forwarding
+(proxy + 307 redirect + leaderless 503), single-master restart
+rehydrating BOTH leases and parked waiters from the intent store, shard
+hand-off waking waiters to re-route, the /fleetz master-role section —
+and the acceptance chaos plan: kill the leading master with a non-empty
+queue, the surviving replica assumes the shard, rehydrates the persisted
+waiters and drains every one with zero double-actuation."""
+
+import http.client
+import json
+import threading
+import time
+import urllib.parse
+
+import pytest
+
+from gpumounter_tpu.master.admission import AttachBroker, BrokerConfig
+from gpumounter_tpu.master.discovery import WorkerDirectory
+from gpumounter_tpu.master.election import NullElection
+from gpumounter_tpu.master.gateway import MasterGateway
+from gpumounter_tpu.master.shardring import HAConfig, ShardRing
+from gpumounter_tpu.master.store import IntentStore
+from gpumounter_tpu.testing.chaos import (assert_broker_invariants,
+                                          assert_invariants,
+                                          wait_events_drained)
+from gpumounter_tpu.testing.sim import MultiMasterStack
+from gpumounter_tpu.utils import consts
+
+from tests.test_broker import BrokerStack
+from tests.helpers import WorkerRig
+
+
+def req(base, method, path, body=None, headers=None, timeout=30.0):
+    """One raw round-trip (no redirect following): (status, headers,
+    payload)."""
+    parsed = urllib.parse.urlparse(base)
+    conn = http.client.HTTPConnection(parsed.hostname, parsed.port,
+                                      timeout=timeout)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            payload = json.loads(raw)
+        except ValueError:
+            payload = {"raw": raw.decode(errors="replace")}
+        return resp.status, dict(resp.getheaders()), payload
+    finally:
+        conn.close()
+
+
+def add_path(pod, n, entire=False, ns="default"):
+    return (f"/addtpu/namespace/{ns}/pod/{pod}/tpu/{n}"
+            f"/isEntireMount/{'true' if entire else 'false'}")
+
+
+def remove_path(pod, force=False, ns="default"):
+    return (f"/removetpu/namespace/{ns}/pod/{pod}"
+            f"/force/{'true' if force else 'false'}")
+
+
+@pytest.fixture
+def mm_factory(fake_host):
+    stacks = []
+
+    def make(**kwargs) -> MultiMasterStack:
+        rig = kwargs.pop("rig", None) or WorkerRig(
+            fake_host, n_chips=kwargs.pop("n_chips", 4))
+        stack = MultiMasterStack(rig, **kwargs)
+        stacks.append(stack)
+        return stack
+
+    yield make
+    for stack in stacks:
+        stack.close()
+
+
+def wait_until(pred, timeout_s=10.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while not pred():
+        if time.monotonic() >= deadline:
+            raise TimeoutError(f"timed out waiting for {what}")
+        time.sleep(0.03)
+
+
+# -- forwarding ----------------------------------------------------------------
+
+def test_non_owner_proxies_to_leader(mm_factory):
+    stack = mm_factory(masters=2, shards=2)
+    stack.wait_converged()
+    leader = stack.leader_for("default")
+    follower = [i for i in stack.live() if i != leader][0]
+    status, _, payload = req(stack.bases[follower],
+                              "GET", add_path("workload", 2))
+    assert status == 200 and payload["result"] == "SUCCESS"
+    assert payload.get("forwarded_shard") == \
+        stack.ring.shard_of("default")
+    # the lease landed on the LEADER's broker, nowhere else
+    assert len(stack.gateways[leader].broker.leases.leases()) == 1
+    assert stack.gateways[follower].broker.leases.leases() == []
+    # detach through the follower too: same forwarding, full cycle
+    status, _, payload = req(stack.bases[follower], "POST",
+                              remove_path("workload"), body=b"{}")
+    assert status == 200 and payload["result"] == "SUCCESS"
+    assert stack.gateways[leader].broker.leases.leases() == []
+    assert_broker_invariants(stack.gateways[leader].broker,
+                             stack.rig.sim,
+                             store=stack.gateways[leader].broker.store)
+
+
+def test_redirect_mode_returns_307_with_location(mm_factory):
+    stack = mm_factory(masters=2, shards=2, forward="redirect")
+    stack.wait_converged()
+    leader = stack.leader_for("default")
+    follower = [i for i in stack.live() if i != leader][0]
+    path = add_path("workload", 2)
+    status, headers, payload = req(stack.bases[follower], "GET", path)
+    assert status == 307 and payload["result"] == "ShardRedirect"
+    location = headers.get("Location")
+    assert location == stack.bases[leader] + path
+    # following the redirect (as any HTTP client would) succeeds
+    parsed = urllib.parse.urlparse(location)
+    status, _, payload = req(f"http://{parsed.netloc}", "GET",
+                              parsed.path)
+    assert status == 200 and payload["result"] == "SUCCESS"
+
+
+def test_leaderless_shard_answers_503_with_retry_after(fake_host):
+    """A gateway whose shard lock is held by an unreachable ghost (live
+    deadline, no takeover possible) must shed with Retry-After, not hang
+    or handle a shard it does not own."""
+    stack = BrokerStack(fake_host)
+    ha = HAConfig(shards=1, election=True, store=False, replica="m-local",
+                  advertise_url="http://127.0.0.1:1",
+                  renew_interval_s=0.1, lease_duration_s=30.0)
+    # the ghost holds the lock with a far deadline and NO advertised url
+    stack.kube.create_config_map(consts.DEFAULT_POOL_NAMESPACE, {
+        "metadata": {
+            "name": f"{consts.ELECTION_CONFIGMAP_PREFIX}0",
+            "annotations": {
+                "tpumounter.io/holder": "ghost",
+                "tpumounter.io/url": "",
+                consts.STORE_FENCE_ANNOTATION: "7",
+                "tpumounter.io/renew-unix":
+                    f"{time.time() + 300:.3f}"}}})
+    gw = MasterGateway(stack.kube,
+                       WorkerDirectory(stack.kube, grpc_port=stack.port),
+                       broker=AttachBroker(stack.kube, BrokerConfig()),
+                       ha=ha)
+    gw.election.tick()
+    assert not gw.election.is_leader(0)
+    status, payload = gw.handle("GET", add_path("workload", 2))
+    assert status == 503 and payload["result"] == "ShardLeaderUnknown"
+    assert payload["retry_after_s"] >= 0.1
+    # a request a peer ALREADY forwarded must not ping-pong
+    status, payload = gw.handle("GET", add_path("workload", 2),
+                                headers={"X-Tpu-Forwarded": "1"})
+    assert status == 503 and payload["result"] == "ShardLeaderUnknown"
+    stack.close()
+
+
+# -- shard hand-off wakes waiters ----------------------------------------------
+
+def test_lost_shard_wakes_waiters_to_reroute(fake_host):
+    stack = BrokerStack(fake_host,
+                        config=BrokerConfig(queue_timeout_s=20.0),
+                        extra_pods=("w2",))
+    broker = stack.gateway.broker
+    ring = ShardRing(1)
+    broker.bind_ha(None, ring, NullElection(1))
+    from tests.test_broker import add
+    assert add(stack.gateway, "workload", 4, entire=True)[0] == 200
+    done = {}
+
+    def park():
+        done["res"] = add(stack.gateway, "w2", 2, rid="moved-1")
+
+    thread = threading.Thread(target=park, daemon=True)
+    thread.start()
+    wait_until(lambda: broker._waiters, what="waiter to park")
+    broker.on_shard_lost(0)
+    thread.join(timeout=10)
+    assert not thread.is_alive()
+    status, payload = done["res"]
+    assert status == 503 and payload["result"] == "ShardMoved"
+    assert payload["retry_after_s"] >= 0.1
+    stack.close()
+
+
+# -- restart rehydration (single master, store on) -----------------------------
+
+def test_restart_rehydrates_leases_and_parked_waiters(fake_host):
+    config = BrokerConfig(queue_timeout_s=4.0)
+    stack = BrokerStack(fake_host, config=config, extra_pods=("w2",))
+    kube = stack.kube
+    ring = ShardRing(1)
+    store = IntentStore(kube, ring, consts.DEFAULT_POOL_NAMESPACE)
+    old_gw = stack.gateway
+    old_gw.broker.bind_ha(store, ring, NullElection(1))
+    from tests.test_broker import add
+    status, body = add(old_gw, "workload", 4, entire=True, rid="hold-1")
+    assert status == 200
+    held_uuids = set(body["device_ids"])
+    done = {}
+
+    def park():
+        done["res"] = add(old_gw, "w2", 2, rid="park-1")
+
+    thread = threading.Thread(target=park, daemon=True)
+    thread.start()
+    wait_until(lambda: store.rehydrate(0)[1], what="waiter persisted")
+
+    # "restart": a fresh gateway + broker + store over the same cluster.
+    # The old process's memory is irrelevant from here on.
+    new_store = IntentStore(kube, ShardRing(1),
+                            consts.DEFAULT_POOL_NAMESPACE)
+    new_gw = stack.new_gateway(config)
+    new_gw.broker.bind_ha(new_store, ShardRing(1), NullElection(1))
+    new_gw.broker.bind_attempt_factory(new_gw._adopted_attempt)
+    new_gw.broker.tick()              # lazy boot pass: rehydrate + adopt
+    # the lease came back EXACT (uuids known, not a collapsed derivation)
+    lease = new_gw.broker.leases.get("default", "workload")
+    assert lease is not None and lease.uuids == held_uuids
+    wait_until(lambda: new_gw.broker._waiters,
+               what="adopted waiter to park")
+
+    # freeing capacity on the NEW master drains the adopted waiter
+    from tests.test_broker import remove
+    assert remove(new_gw, "workload")[0] == 200
+    wait_until(lambda: new_gw.broker.leases.get("default", "w2"),
+               what="adopted waiter to be granted")
+    thread.join(timeout=10)
+    assert not thread.is_alive()
+    # the original client (whose master "died") timed out cleanly; its
+    # intent was fulfilled server-side under the SAME rid, so a retry
+    # would adopt the attached chips instead of double-attaching
+    status, payload = done["res"]
+    assert status == 503 and payload.get("queue_timeout")
+    wait_events_drained(stack.rig.service)
+    assert_broker_invariants(new_gw.broker, stack.rig.sim,
+                             store=new_store)
+    w2_lease = new_gw.broker.leases.get("default", "w2")
+    assert_invariants(stack.rig, set(w2_lease.uuids), owner="w2",
+                      max_attached_events=2)
+    stack.close()
+
+
+# -- the acceptance chaos plan -------------------------------------------------
+
+def test_leader_killed_mid_queue_peer_drains_persisted_waiters(
+        mm_factory):
+    """Kill the leading master while its queue holds two persisted
+    waiters: the surviving replica assumes the shard within one renew
+    interval of lock expiry, rehydrates the parked intent from the
+    store, and every waiter resolves — both attaches land exactly once
+    (zero double-actuation, zero leaked reservations), pinned by the
+    node-local AND cross-replica broker invariants."""
+    stack = mm_factory(masters=2, shards=2,
+                       broker_config=BrokerConfig(queue_timeout_s=8.0),
+                       renew_interval_s=0.15, lease_duration_s=0.45)
+    rig = stack.rig
+    for name in ("w2", "w3"):
+        pod = rig.sim.add_target_pod(name=name)
+        rig.provision_container(pod)
+    stack.wait_converged()
+    leader = stack.leader_for("default")
+    survivor = [i for i in stack.live() if i != leader][0]
+    shard = stack.ring.shard_of("default")
+
+    status, _, body = req(stack.bases[leader], "GET",
+                           add_path("workload", 4, entire=True),
+                           headers={"X-Request-Id": "hold-1"})
+    assert status == 200
+
+    results = {}
+
+    def park(pod, rid):
+        try:
+            results[rid] = req(stack.bases[leader], "GET",
+                                add_path(pod, 2),
+                                headers={"X-Request-Id": rid},
+                                timeout=20.0)
+        except OSError as e:
+            # the master died under this client — in production it
+            # retries the SAME rid against the service VIP and adopts
+            results[rid] = ("dead-master", str(e))
+
+    threads = [threading.Thread(target=park, args=(pod, rid),
+                                daemon=True)
+               for pod, rid in (("w2", "park-a"), ("w3", "park-b"))]
+    for thread in threads:
+        thread.start()
+    leader_store = stack.gateways[leader].broker.store
+    wait_until(lambda: len(leader_store.rehydrate(shard)[1]) == 2,
+               what="both waiters persisted")
+
+    stack.kill(leader)
+    surv_gw = stack.gateways[survivor]
+    wait_until(lambda: surv_gw.election.is_leader(shard),
+               timeout_s=5.0, what="failover")
+    wait_until(lambda: len(surv_gw.broker._waiters) == 2,
+               what="adopted waiters to park on the survivor")
+
+    # free the chips through the SURVIVOR: the adopted waiters drain
+    status, _, _ = req(stack.bases[survivor], "POST",
+                        remove_path("workload"), body=b"{}")
+    assert status == 200
+    wait_until(lambda: (surv_gw.broker.leases.get("default", "w2")
+                        and surv_gw.broker.leases.get("default", "w3")),
+               what="both adopted waiters granted")
+
+    for thread in threads:
+        thread.join(timeout=20)
+        assert not thread.is_alive()
+
+    wait_events_drained(rig.service)
+    # zero double-actuation: one TPUAttached per logical attach
+    attached = [e for e in rig.sim.kube.events
+                if e.get("reason") == "TPUAttached"]
+    assert len(attached) == 3, [e.get("message") for e in attached]
+    # cross-replica view: the survivor's table AND the store both mirror
+    # cluster ground truth; no waiter record outlived its resolution
+    assert_broker_invariants(surv_gw.broker, rig.sim,
+                             store=surv_gw.broker.store)
+    expected = (set(surv_gw.broker.leases.get("default", "w2").uuids)
+                | set(surv_gw.broker.leases.get("default", "w3").uuids))
+    assert len(expected) == 4
+    assert_invariants(rig, expected, owner="w2", max_attached_events=3)
+
+
+# -- fleet view ----------------------------------------------------------------
+
+def test_fleetz_shows_master_roles_and_store_lag(mm_factory):
+    stack = mm_factory(masters=2, shards=2)
+    stack.wait_converged()
+    leader0 = stack.leader_for("default")
+    snap = stack.gateways[leader0].fleet.snapshot()
+    masters = snap["masters"]
+    assert masters["enabled"] is True
+    assert masters["replica"] == f"master-{leader0}"
+    shards = masters["election"]["shards"]
+    assert len(shards) == 2
+    assert any(s["leader"] for s in shards.values())
+    for s in shards.values():
+        assert s["holder"].startswith("master-")
+    assert masters["store"]["lag_s"] == 0.0
+    status, _, payload = req(stack.bases[leader0], "GET", "/fleetz")
+    assert status == 200 and "masters" in payload
+
+
+def test_cli_fleet_renders_master_roles_and_store_lag(mm_factory):
+    """`tpumounterctl fleet` shows the answering replica's role per
+    shard and its store lag — a stuck failover is one command away."""
+    import contextlib
+    import io
+
+    from gpumounter_tpu import cli
+
+    stack = mm_factory(masters=2, shards=2)
+    stack.wait_converged()
+    leader = stack.leader_for("default")
+    shard = stack.ring.shard_of("default")
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli.main(["--master", stack.bases[leader], "fleet"])
+    rendered = out.getvalue()
+    assert rc == 0, rendered
+    assert f"master master-{leader}:" in rendered
+    assert f"{shard}:LEADER" in rendered
+    assert "store lag 0s" in rendered
+    # a replica that leads NO shard still renders its follower view
+    follower = [i for i in stack.live() if i != leader][0]
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        cli.main(["--master", stack.bases[follower], "fleet"])
+    rendered = out.getvalue()
+    assert f"master master-{follower}:" in rendered
+    assert "LEADER" in rendered or "follower(" in rendered
+
+
+# -- defaults pin --------------------------------------------------------------
+
+def test_ha_defaults_off_preserve_single_master_semantics(fake_host):
+    """The acceptance pin: a default HAConfig builds NO ring, NO
+    election, NO store — a full attach + queue + detach cycle touches
+    ZERO ConfigMaps (cluster traffic identical to PR 7), and the broker
+    carries no HA section in /brokerz."""
+    stack = BrokerStack(fake_host,
+                        config=BrokerConfig(queue_timeout_s=0.3),
+                        extra_pods=("w2",))
+    gw = stack.gateway
+    assert gw.ring is None and gw.election is None
+    assert gw.broker.store is None
+    assert HAConfig().enabled is False
+    from tests.test_broker import add, remove
+    assert add(gw, "workload", 4, entire=True)[0] == 200
+    # exercise the queue path too (park + timeout): still no store write
+    status, payload = add(gw, "w2", 2)
+    assert status == 503 and payload.get("queue_timeout")
+    assert remove(gw, "workload")[0] == 200
+    assert stack.kube.cm_calls == 0, \
+        "HA-off master generated ConfigMap traffic"
+    snap = gw.broker.snapshot()
+    assert snap["ha"] == {"enabled": False}
+    # and the route gate is inert: no forwarded/redirect answers exist
+    assert gw._shard_gate("default", "GET", "/x", b"", "-", {}) is None
+    stack.close()
+
+
+def test_shard_acquired_without_store_still_rederives(fake_host):
+    """Review fix: TPU_ELECTION=1 with TPU_INTENT_STORE=0 is legal —
+    a failover must still force re-derivation of the dead leader's
+    leases from slave-pod ground truth (without a store that is the
+    ONLY source), not early-return before resetting the flag."""
+    stack = BrokerStack(fake_host)
+    broker = stack.gateway.broker
+    broker.bind_ha(None, ShardRing(1), NullElection(1))
+    broker.ensure_rederived()
+    assert broker._rederived is True
+    broker.on_shard_acquired(0)
+    assert broker._rederived is False, \
+        "store-less failover skipped lease re-derivation"
+    stack.close()
+
+
+def test_sharded_slice_rejects_mixed_namespaces(mm_factory):
+    """Review fix: sharded admission is keyed on namespace, so a slice
+    spanning namespaces would record foreign-shard leases this replica
+    never persists or reaps — it must be a 400, not a silent accept."""
+    stack = mm_factory(masters=2, shards=2)
+    stack.wait_converged()
+    body = json.dumps({"pods": [
+        {"namespace": "default", "pod": "a"},
+        {"namespace": "other", "pod": "b"}], "tpusPerHost": 2}).encode()
+    status, _, payload = req(stack.bases[0], "POST", "/addtpuslice",
+                             body=body)
+    assert status == 400 and "span namespaces" in payload["message"]
+    status, _, payload = req(stack.bases[0], "POST", "/removetpuslice",
+                             body=body)
+    assert status == 400 and "span namespaces" in payload["message"]
+
+
+# -- doctor --------------------------------------------------------------------
+
+def _fake_doctor_fetch(monkeypatch, fleetz_masters, metrics_scrapes=None):
+    """Route doctor's surface fetches: /healthz JSON, /metrics from the
+    scrape list (last entry repeats), /fleetz with the given masters
+    section; everything else 404s like a real single-binary target."""
+    from gpumounter_tpu import cli
+    scrapes = list(metrics_scrapes or [""])
+
+    def fake_fetch(master, path, timeout):
+        if path == "/healthz":
+            return '{"status": "ok"}'
+        if path == "/metrics":
+            return scrapes.pop(0) if len(scrapes) > 1 else scrapes[0]
+        if path.startswith("/fleetz"):
+            return json.dumps({"nodes": {}, "masters": fleetz_masters})
+        raise cli.TransportError(f"GET {path}: 404")
+
+    monkeypatch.setattr(cli, "_fetch_text", fake_fetch)
+    monkeypatch.setattr(cli.time, "sleep", lambda s: None)
+
+
+def test_doctor_crits_on_leaderless_shard(monkeypatch):
+    """A shard whose lock is expired with nobody local holding it means
+    admission for its keyspace is DOWN — that pages, it does not WARN."""
+    from gpumounter_tpu import cli
+    from tests.test_cli import run_cli
+    _fake_doctor_fetch(monkeypatch, {
+        "enabled": True, "replica": "master-0", "shards": 2,
+        "election": {"enabled": True, "shards": {
+            "0": {"holder": "master-0", "fence": 3, "expires_in_s": 4.0,
+                  "leader": True},
+            "1": {"holder": "master-dead", "fence": 2,
+                  "expires_in_s": -7.0, "leader": False}}},
+        "store": {"lag_s": 0.0, "dirty": 0, "torn_records": 0}})
+    rc, out = run_cli("http://unused", "doctor")
+    assert rc == cli.EXIT_DOCTOR_CRIT, out
+    assert "shard(s) 1 have NO live leader" in out
+
+
+def test_doctor_healthy_ha_and_store_lag_warn(monkeypatch):
+    from tests.test_cli import run_cli
+    masters = {
+        "enabled": True, "replica": "master-0", "shards": 1,
+        "election": {"enabled": True, "shards": {
+            "0": {"holder": "master-0", "fence": 1, "expires_in_s": 5.0,
+                  "leader": True}}},
+        "store": {"lag_s": 0.0, "dirty": 0, "torn_records": 0}}
+    _fake_doctor_fetch(monkeypatch, masters)
+    rc, out = run_cli("http://unused", "doctor")
+    assert rc == 0, out
+    assert "every shard has a live leader" in out
+    # a lagging store degrades what a failover would rehydrate: WARN
+    masters["store"] = {"lag_s": 12.5, "dirty": 3, "torn_records": 0}
+    rc, out = run_cli("http://unused", "doctor")
+    assert rc == 1, out
+    assert "intent store lagging 12.5s" in out
+
+
+def test_doctor_warns_on_leadership_flapping_in_window(monkeypatch):
+    """>FLAP_WARN transitions inside --window = the lock is bouncing;
+    the same lifetime total without a window only informs."""
+    from gpumounter_tpu import cli
+    from tests.test_cli import run_cli
+    masters = {
+        "enabled": True, "replica": "master-0", "shards": 1,
+        "election": {"enabled": True, "shards": {
+            "0": {"holder": "master-0", "fence": 9, "expires_in_s": 5.0,
+                  "leader": True}}},
+        "store": {"lag_s": 0.0, "dirty": 0, "torn_records": 0}}
+    family = "tpumounter_election_transitions_total"
+    first = (f'{family}{{shard="0",outcome="acquired"}} 2\n'
+             f'{family}{{shard="0",outcome="lost"}} 2\n')
+    second = (f'{family}{{shard="0",outcome="acquired"}} 4\n'
+              f'{family}{{shard="0",outcome="lost"}} 4\n')
+    _fake_doctor_fetch(monkeypatch, masters, [first, second])
+    rc, out = run_cli("http://unused", "doctor", "--window", "5")
+    assert rc == 1, out
+    assert "leadership flapping on shard(s) 0" in out
+    assert f"(> {cli.FLAP_WARN} transitions" in out
+    # lifetime totals: informational, exit 0
+    _fake_doctor_fetch(monkeypatch, masters, [first])
+    rc, out = run_cli("http://unused", "doctor")
+    assert rc == 0, out
+    assert "leadership transitions: 4 — lifetime" in out
+
+
+def test_tick_routes_flush_dirty_fence_to_demotion(fake_host):
+    """Review fix: a dirty-queue replay bouncing off the fence must run
+    the same note_fence+demote recovery as a direct write — and the
+    tick must survive it (gauges still refresh), not abort."""
+    from gpumounter_tpu.utils.errors import StoreFencedError
+
+    class _Recorder:
+        enabled = True
+
+        def __init__(self):
+            self.noted, self.demoted = [], []
+
+        def is_leader(self, shard):
+            return True
+
+        def owned(self):
+            return [0]
+
+        def token(self, shard):
+            return 1
+
+        def note_fence(self, shard, fence):
+            self.noted.append((shard, fence))
+
+        def demote(self, shard, reason=""):
+            self.demoted.append(shard)
+
+    stack = BrokerStack(fake_host)
+    broker = stack.gateway.broker
+    election = _Recorder()
+    broker.bind_ha(None, ShardRing(1), election)
+
+    class _FencingStore:
+        def flush_dirty(self):
+            raise StoreFencedError(0, 1, 7)
+
+        def rehydrate(self, shard):
+            return [], [], 0
+
+    broker.store = _FencingStore()
+    broker._rehydrated_shards.add(0)
+    broker.tick()                     # must not raise
+    assert election.noted == [(0, 7)]
+    assert election.demoted == [0]
+    stack.close()
+
+
+def test_lost_shard_prunes_adoption_history(fake_host):
+    """Review fix: adoption history is per-shard — a lose/reacquire
+    cycle must re-adopt records the interim leader never resolved, so
+    on_shard_lost prunes exactly the lost shard's rids."""
+    stack = BrokerStack(fake_host)
+    broker = stack.gateway.broker
+    ring = ShardRing(2)
+    broker.bind_ha(None, ring, NullElection(2))
+    broker._adopted_rids = {"rid-s0": 0, "rid-s1": 1}
+    broker.on_shard_lost(0)
+    assert broker._adopted_rids == {"rid-s1": 1}
+    stack.close()
+
+
+def test_doctor_clean_multishard_failover_is_not_flapping(monkeypatch):
+    """Review fix: one replica dying hands each of its 4 shards to the
+    survivor — 4 'acquired' increments in one window. That is ONE clean
+    failover, judged per shard (like the shipped alert rule), not 4
+    aggregate transitions reading as churn."""
+    from tests.test_cli import run_cli
+    masters = {
+        "enabled": True, "replica": "master-1", "shards": 4,
+        "election": {"enabled": True, "shards": {
+            str(s): {"holder": "master-1", "fence": 2,
+                     "expires_in_s": 5.0, "leader": True}
+            for s in range(4)}},
+        "store": {"lag_s": 0.0, "dirty": 0, "torn_records": 0}}
+    family = "tpumounter_election_transitions_total"
+    first = "".join(
+        f'{family}{{shard="{s}",outcome="acquired"}} 0\n'
+        for s in range(4))
+    second = "".join(
+        f'{family}{{shard="{s}",outcome="acquired"}} 1\n'
+        for s in range(4))
+    _fake_doctor_fetch(monkeypatch, masters, [first, second])
+    rc, out = run_cli("http://unused", "doctor", "--window", "5")
+    assert rc == 0, out
+    assert "flapping" not in out
+    assert "leadership transitions: 4" in out
+
+
+def test_store_only_config_still_surfaces_store_health(fake_host):
+    """Review fix: TPU_INTENT_STORE=1 with TPU_ELECTION=0 (the durable
+    single-master config) must still show store lag in /fleetz and
+    doctor — a lagging store is exactly what a restart would lose."""
+    stack = BrokerStack(fake_host)
+    ha = HAConfig(shards=1, election=False, store=True,
+                  replica="m-solo")
+    gw = MasterGateway(stack.kube,
+                       WorkerDirectory(stack.kube, grpc_port=stack.port),
+                       broker=AttachBroker(stack.kube, BrokerConfig()),
+                       ha=ha)
+    view = gw._ha_view()
+    assert view["enabled"] is True
+    assert view["store"]["lag_s"] == 0.0
+    assert view["election"]["enabled"] is False
+    stack.close()
+
+
+def test_cli_fleet_renders_store_only_masters_section(monkeypatch):
+    """Review fix: store-only HA (election off) reports election shards
+    as a COUNT, not a dict — the fleet CLI must render the store lag
+    line, not crash iterating an int."""
+    from tests.test_cli import run_cli
+    _fake_doctor_fetch(monkeypatch, {
+        "enabled": True, "replica": "m-solo", "shards": 1,
+        "election": {"enabled": False, "shards": 1},
+        "store": {"lag_s": 2.5, "dirty": 1, "torn_records": 0}})
+    rc, out = run_cli("http://unused", "fleet")
+    assert "master m-solo:" in out
+    assert "store lag 2.5s (1 dirty)" in out

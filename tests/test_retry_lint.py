@@ -153,10 +153,16 @@ def test_gateway_worker_rpcs_use_breaker_and_policy():
     assert "_breaker" in names, "worker RPCs bypass the circuit breaker"
     assert "rpc_retry_policy" in names, "worker RPCs bypass the policy"
     # every route reaches workers through the breaker-guarded path
+    # (_add via the shared attach-attempt builder, which both the live
+    # route and adopted waiter re-runs use)
     for route in ("_add", "_remove", "_status"):
         route_names = _names_used(funcs[f"MasterGateway.{route}"])
         assert "_call_worker" in route_names or \
-            "_call_node_worker" in route_names, route
+            "_call_node_worker" in route_names or \
+            "_worker_attach_attempt" in route_names, route
+    builder_names = _names_used(
+        funcs["MasterGateway._worker_attach_attempt"])
+    assert "_call_node_worker" in builder_names
 
 
 def _doc_or_comment_stripped(source: str) -> str:

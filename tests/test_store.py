@@ -1,0 +1,443 @@
+"""Intent-store suite (master/store.py): byte-identical record
+round-trips through the CAS write path, replica conflict handling,
+fencing, torn-record degradation to cluster re-derivation, the dirty
+queue, and the defaults-off pin (no HA knobs ⇒ zero configmap traffic —
+exactly PR 7 semantics)."""
+
+import json
+
+import pytest
+
+from gpumounter_tpu.k8s.client import FakeKubeClient
+from gpumounter_tpu.master.admission import AttachBroker, BrokerConfig
+from gpumounter_tpu.master.election import NullElection
+from gpumounter_tpu.master.shardring import HAConfig, ShardRing
+from gpumounter_tpu.master.store import (IntentStore, LeaseRecord,
+                                         WaiterRecord)
+from gpumounter_tpu.testing.chaos import Fault, FaultInjector
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.config import Settings
+from gpumounter_tpu.utils.errors import StoreFencedError
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+
+NS = consts.DEFAULT_POOL_NAMESPACE
+
+
+def make_store(kube=None, shards=1, election=None):
+    kube = kube or FakeKubeClient()
+    return kube, IntentStore(kube, ShardRing(shards), NS,
+                             election=election)
+
+
+def lease_record(**over):
+    fields = dict(namespace="default", pod="workload", tenant="teamA",
+                  priority="high", chips=3, uuids=["0", "2", "7"],
+                  node="node-a", rid="rid-1", created_unix=1234.5,
+                  expires_unix=99999.25, renewals=2)
+    fields.update(over)
+    return LeaseRecord(**fields)
+
+
+def waiter_record(**over):
+    fields = dict(rid="w-rid-1", namespace="default", pod="contender",
+                  tenant="teamB", priority="normal", chips=2,
+                  node="node-a", entire=True, enqueued_unix=1000.0,
+                  deadline_unix=1060.0)
+    fields.update(over)
+    return WaiterRecord(**fields)
+
+
+def raw_annotations(kube, shard=0):
+    cm = kube.get_config_map(NS, f"{consts.STORE_CONFIGMAP_PREFIX}{shard}")
+    return dict(cm["metadata"].get("annotations") or {})
+
+
+# -- round trips ---------------------------------------------------------------
+
+def test_lease_record_survives_cas_write_byte_identically():
+    kube, store = make_store()
+    record = lease_record()
+    original = record.to_json()
+    assert store.put_lease(record)
+    # the persisted annotation IS the canonical serialization
+    assert raw_annotations(kube)[record.annotation_key] == original
+    leases, waiters, torn = store.rehydrate(0)
+    assert torn == 0 and waiters == []
+    assert len(leases) == 1
+    assert leases[0] == record                      # field-identical
+    assert leases[0].to_json() == original          # byte-identical
+
+    # and the record materialises back into a working Lease
+    lease = leases[0].to_lease()
+    assert lease.key == ("default", "workload")
+    assert lease.uuids == {"0", "2", "7"}
+    assert lease.tenant == "teamA" and lease.priority == "high"
+    assert LeaseRecord.from_lease(lease).uuids == ["0", "2", "7"]
+
+
+def test_waiter_record_survives_cas_write_byte_identically():
+    kube, store = make_store()
+    record = waiter_record()
+    original = record.to_json()
+    assert store.put_waiter(record)
+    assert raw_annotations(kube)[record.annotation_key] == original
+    leases, waiters, torn = store.rehydrate(0)
+    assert torn == 0 and leases == []
+    assert waiters == [record]
+    assert waiters[0].to_json() == original
+    assert waiters[0].entire is True                # the re-run flag
+
+
+def test_eternal_lease_round_trips_none_expiry():
+    kube, store = make_store()
+    record = lease_record(expires_unix=None)
+    assert store.put_lease(record)
+    leases, _, _ = store.rehydrate(0)
+    assert leases[0].expires_unix is None
+    assert leases[0].to_lease().expires_at is None
+
+
+def test_delete_removes_the_record():
+    kube, store = make_store()
+    record = lease_record()
+    wrec = waiter_record()
+    store.put_lease(record)
+    store.put_waiter(wrec)
+    assert store.delete_lease("default", "workload")
+    assert store.delete_waiter("default", wrec.rid)
+    leases, waiters, _ = store.rehydrate(0)
+    assert leases == [] and waiters == []
+
+
+# -- CAS between replicas ------------------------------------------------------
+
+def test_concurrent_replicas_conflict_and_both_land():
+    kube = FakeKubeClient()
+    _, store_a = make_store(kube)
+    _, store_b = make_store(kube)
+    before = REGISTRY.store_cas.value(op="put", outcome="conflict")
+    assert store_a.put_lease(lease_record(pod="pod-a"))
+    # B writes through a fresh read; A's cached resourceVersion is now
+    # stale, so A's next write LOSES its first CAS and must re-read
+    assert store_b.put_lease(lease_record(pod="pod-b"))
+    assert store_a.put_lease(lease_record(pod="pod-c"))
+    leases, _, torn = store_a.rehydrate(0)
+    assert torn == 0
+    assert {r.pod for r in leases} == {"pod-a", "pod-b", "pod-c"}
+    assert REGISTRY.store_cas.value(op="put",
+                                    outcome="conflict") > before
+
+
+def test_create_race_one_winner_both_records_survive():
+    kube = FakeKubeClient()
+    _, store_a = make_store(kube)
+    _, store_b = make_store(kube)
+    # neither has observed the (absent) map: both take the create path;
+    # the loser's 409 degrades to patch-and-retry
+    assert store_a.put_lease(lease_record(pod="pod-a"))
+    assert store_b.put_lease(lease_record(pod="pod-b"))
+    leases, _, _ = store_b.rehydrate(0)
+    assert {r.pod for r in leases} == {"pod-a", "pod-b"}
+
+
+# -- fencing -------------------------------------------------------------------
+
+class _StubElection:
+    enabled = True
+
+    def __init__(self, token):
+        self._token = token
+
+    def token(self, shard):
+        return self._token
+
+
+def test_deposed_writer_is_fenced():
+    kube = FakeKubeClient()
+    _, old_leader = make_store(kube, election=_StubElection(1))
+    _, new_leader = make_store(kube, election=_StubElection(2))
+    assert old_leader.put_lease(lease_record(pod="pod-a"))
+    assert new_leader.put_lease(lease_record(pod="pod-b"))   # fence -> 2
+    with pytest.raises(StoreFencedError) as err:
+        old_leader.put_lease(lease_record(pod="pod-c"))
+    assert err.value.token == 1 and err.value.fence == 2
+    # the deposed replica wrote NOTHING
+    leases, _, _ = new_leader.rehydrate(0)
+    assert {r.pod for r in leases} == {"pod-a", "pod-b"}
+
+
+# -- torn records --------------------------------------------------------------
+
+def _slave_pod(name, owner, owner_ns="default", chips=2):
+    return {
+        "metadata": {
+            "name": name, "namespace": NS,
+            "labels": {
+                consts.SLAVE_POD_LABEL_KEY: consts.SLAVE_POD_LABEL_VALUE,
+                consts.OWNER_POD_LABEL_KEY: owner,
+                consts.OWNER_NAMESPACE_LABEL_KEY: owner_ns,
+            }},
+        "spec": {"containers": [{
+            "name": "c",
+            "resources": {"limits": {
+                consts.TPU_RESOURCE_NAME: str(chips)}}}]},
+        "status": {"phase": "Running"},
+    }
+
+
+def test_torn_record_is_dropped_and_counted():
+    kube, store = make_store()
+    store.put_lease(lease_record(pod="good"))
+    # crash mid-write: the annotation exists but holds half a record
+    good = lease_record(pod="good")
+    torn_key = consts.STORE_LEASE_ANNOTATION_PREFIX + "deadbeefdeadbeef"
+    kube.patch_config_map(
+        NS, store.cm_name(0),
+        {"metadata": {"annotations": {
+            torn_key: '{"namespace": "default", "pod": "torn-vic'}}})
+    leases, _, torn = store.rehydrate(0)
+    assert torn == 1
+    assert [r.pod for r in leases] == ["good"]
+    assert good.annotation_key in raw_annotations(kube)
+
+
+def test_torn_lease_degrades_to_cluster_rederivation():
+    """A broker whose store record for an attachment is torn still
+    recovers the lease — from slave-pod ground truth — and re-syncs the
+    store, so the NEXT failover rehydrates a whole record again."""
+    kube = FakeKubeClient()
+    kube.put_pod(_slave_pod("victim-slave-pod-1", "victim", chips=2))
+    _, store = make_store(kube)
+    torn_key = (consts.STORE_LEASE_ANNOTATION_PREFIX
+                + "feedfacefeedface")
+    # the torn write happened before the crash...
+    kube.create_config_map(NS, {
+        "metadata": {"name": store.cm_name(0),
+                     "annotations": {torn_key: '{"namespace": "defau'}}})
+    broker = AttachBroker(kube, BrokerConfig())
+    broker.bind_ha(store, store.ring, NullElection(1))
+    broker.ensure_rederived()
+    # ...the replacement replica re-derived the lease from the cluster
+    leases = broker.leases.leases()
+    assert [(le.namespace, le.pod, le.chips) for le in leases] == \
+        [("default", "victim", 2)]
+    # and wrote it through, so the store is whole again
+    records, _, _ = store.rehydrate(0)
+    assert [(r.namespace, r.pod, r.chips) for r in records] == \
+        [("default", "victim", 2)]
+
+
+# -- dirty queue ---------------------------------------------------------------
+
+def test_failed_write_parks_dirty_and_flushes():
+    kube, store = make_store()
+    store.put_lease(lease_record(pod="seed"))    # map exists
+    injector = FaultInjector([Fault(op="PATCH", resource="configmaps",
+                                    status=500, times=50)])
+    kube.faults = injector
+    assert store.put_lease(lease_record(pod="parked")) is False
+    assert store.lag_s() > 0.0
+    assert store.snapshot()["dirty"] == 1
+    kube.faults = None
+    assert store.flush_dirty() == 1
+    assert store.lag_s() == 0.0
+    leases, _, _ = store.rehydrate(0)
+    assert {r.pod for r in leases} == {"seed", "parked"}
+
+
+# -- defaults-off pin ----------------------------------------------------------
+
+def test_defaults_are_single_master_pr7_semantics():
+    settings = Settings()
+    ha = HAConfig.from_settings(settings)
+    assert not ha.enabled
+    assert ha.shards == 1 and not ha.election and not ha.store
+    env_ha = HAConfig.from_settings(Settings.from_env({}))
+    assert not env_ha.enabled
+
+
+def test_broker_without_ha_never_touches_configmaps():
+    kube = FakeKubeClient()
+    kube.put_pod(_slave_pod("w-slave-pod-1", "workload", chips=1))
+    broker = AttachBroker(kube, BrokerConfig())
+    broker.ensure_rederived()
+    broker.leases.record("default", "workload", "default", "normal",
+                         ["0"], node="node-a", rid="r1", ttl_s=0.0)
+    broker.leases.release("default", "workload")
+    broker.tick()
+    assert kube.cm_calls == 0
+
+
+def test_shard_ring_is_stable_and_uniformish():
+    ring = ShardRing(4)
+    assert ring.shard_of("default") == ring.shard_of("default")
+    spread = {ring.shard_of(f"ns-{i}") for i in range(64)}
+    assert spread == {0, 1, 2, 3}
+    assert ShardRing(1).shard_of("anything") == 0
+
+
+def test_parked_put_never_resurrects_a_newer_live_delete():
+    """Review fix: a put that parked dirty during an outage must not be
+    replayed over the SAME key's newer live delete — last writer wins
+    per key, whether the later write lands live or parks too."""
+    kube, store = make_store()
+    store.put_lease(lease_record(pod="seed"))    # map exists
+    kube.faults = FaultInjector([Fault(op="PATCH", resource="configmaps",
+                                       status=500, times=50)])
+    assert store.put_lease(lease_record(pod="ghost")) is False
+    assert store.snapshot()["dirty"] == 1
+    kube.faults = None
+    # apiserver recovers; the client detaches: the delete lands LIVE
+    assert store.delete_lease("default", "ghost") is True
+    # the parked put is now stale and must be gone — flushing replays
+    # nothing and the record stays deleted
+    assert store.snapshot()["dirty"] == 0
+    assert store.flush_dirty() == 0
+    leases, _, _ = store.rehydrate(0)
+    assert {r.pod for r in leases} == {"seed"}, \
+        "a stale parked put resurrected a deleted lease"
+
+
+def test_dirty_queue_keeps_one_mutation_per_key_newest_value():
+    """Two failed writes for one key collapse to ONE parked mutation
+    carrying the NEWEST value (and the oldest timestamp, for lag)."""
+    kube, store = make_store()
+    store.put_lease(lease_record(pod="seed"))
+    kube.faults = FaultInjector([Fault(op="PATCH", resource="configmaps",
+                                       status=500, times=50)])
+    assert store.put_lease(lease_record(pod="p", chips=1,
+                                        uuids=["0"])) is False
+    assert store.put_lease(lease_record(pod="p", chips=3,
+                                        uuids=["0", "1", "2"])) is False
+    assert store.snapshot()["dirty"] == 1
+    kube.faults = None
+    assert store.flush_dirty() == 1
+    leases, _, _ = store.rehydrate(0)
+    by_pod = {r.pod: r for r in leases}
+    assert by_pod["p"].chips == 3, "the stale parked value won"
+
+
+class _DecayedElection:
+    """Election whose token just expired: enabled, owns nothing."""
+
+    enabled = True
+
+    def __init__(self, shards=1):
+        self.shards = shards
+
+    def token(self, shard):
+        return None
+
+
+def test_decayed_token_refuses_unfenced_write():
+    """Review fix: leadership can expire between the caller's ownership
+    check and the CAS — writing then would be UNFENCED (the one hole in
+    the split-brain argument). The store must refuse, not write."""
+    kube = FakeKubeClient()
+    store = IntentStore(kube, ShardRing(1), NS,
+                        election=_DecayedElection())
+    with pytest.raises(StoreFencedError):
+        store._cas(0, {"tpumounter.io/l-x": "{}"})
+    # nothing reached the cluster
+    with pytest.raises(Exception):
+        kube.get_config_map(NS, f"{consts.STORE_CONFIGMAP_PREFIX}0")
+
+
+def test_put_leases_batches_one_cas_per_shard():
+    """Review fix: the re-derivation sync lands ALL of a shard's lease
+    records in one merge-patch, not one round-trip per lease."""
+    kube, store = make_store()
+    records = [lease_record(pod=f"p{i}") for i in range(5)]
+    before = kube.cm_calls
+    store.put_leases(records)
+    # one create (map absent) — NOT 5 observe+patch cycles
+    assert kube.cm_calls - before <= 2
+    leases, _, _ = store.rehydrate(0)
+    assert {r.pod for r in leases} == {f"p{i}" for i in range(5)}
+    # and a second sync patches once against the cached observation
+    before = kube.cm_calls
+    store.put_leases(records)
+    assert kube.cm_calls - before == 1
+
+
+def test_forget_shard_zeroes_its_record_gauges():
+    """Review fix: a deposed replica must stop exporting the lost
+    shard's record counts — frozen gauges double-count against the new
+    leader's in any cross-replica sum."""
+    kube, store = make_store()
+    store.put_lease(lease_record(pod="a"))
+    store.put_lease(lease_record(pod="b"))
+    assert REGISTRY.store_records.value(kind="lease", shard="0") == 2
+    store.forget_shard(0)
+    assert REGISTRY.store_records.value(kind="lease", shard="0") == 0
+    assert REGISTRY.store_records.value(kind="waiter", shard="0") == 0
+
+
+def test_decayed_token_parks_instead_of_dropping():
+    """Review fix: a mutation issued while leadership validity has
+    transiently decayed (lock still names us) must be PARKED, not
+    silently dropped — a resumed leadership replays it; only a real
+    hand-off (the lock naming a peer) discards it."""
+    kube = FakeKubeClient()
+
+    class _Flappy:
+        """Election that decayed but whose lock still names us."""
+
+        enabled = True
+        replica = "m0"
+
+        def __init__(self):
+            self.live = False
+
+        def token(self, shard):
+            return 3 if self.live else None
+
+        def leaders(self):
+            return {0: {"holder": "m0", "url": "", "fence": 3,
+                        "expired": True}}
+
+    election = _Flappy()
+    store = IntentStore(kube, ShardRing(1), NS, election=election)
+    assert store.put_lease(lease_record(pod="held")) is False
+    assert store.snapshot()["dirty"] == 1
+    # flush during decay: mutation stays parked (lock still names us)
+    assert store.flush_dirty() == 0
+    assert store.snapshot()["dirty"] == 1
+    # leadership resumes: the parked mutation replays
+    election.live = True
+    assert store.flush_dirty() == 1
+    leases, _, _ = store.rehydrate(0)
+    assert {r.pod for r in leases} == {"held"}
+    # a REAL hand-off instead: parked mutations are dropped
+    election.live = False
+    assert store.put_lease(lease_record(pod="late")) is False
+    election.leaders = lambda: {0: {"holder": "peer", "url": "",
+                                    "fence": 4, "expired": False}}
+    assert store.flush_dirty() == 0
+    assert store.snapshot()["dirty"] == 0
+
+
+def test_renew_heartbeats_batch_through_flush_not_per_call():
+    """Review fix: renewals are the highest-frequency lease mutation —
+    they must NOT issue one synchronous CAS each (a shard's leases all
+    share one ConfigMap write stream); the broker tick flushes them as
+    one batch per shard."""
+    from gpumounter_tpu.master.lease import LeaseTable
+    kube, store = make_store()
+    table = LeaseTable()
+    table.store = store
+    for i in range(3):
+        table.record("default", f"p{i}", "teamA", "normal",
+                     [str(i)], node="node-a", ttl_s=60.0)
+    before = kube.cm_calls
+    for i in range(3):
+        table.renew("default", f"p{i}", 60.0)
+    assert kube.cm_calls == before, \
+        "renew wrote through synchronously"
+    flushed = table.flush_renewals()
+    assert flushed == 3
+    # ONE patch for the whole batch (plus no extra observes — cached)
+    assert kube.cm_calls - before == 1
+    leases, _, _ = store.rehydrate(0)
+    assert all(r.renewals == 1 for r in leases)

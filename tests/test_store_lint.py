@@ -1,0 +1,169 @@
+"""Intent-store lint (pattern of test_admission_lint / test_events_lint):
+broker state is cluster ground truth ONLY if every mutation writes
+through the store layer (master/store.py). Structurally: every
+LeaseTable method that mutates the lease dict must reference a store
+seam, every waiter park/resolve site must persist/unpersist its intent
+record, and no module outside the store/election pair may touch the
+ConfigMap CAS primitives. A new mutation path added without store wiring
+fails here instead of shipping state a failed-over peer cannot see.
+"""
+
+import ast
+
+from gpumounter_tpu.master import (admission, election, fleet, gateway,
+                                   lease, store)
+
+from tests.test_retry_lint import (_functions, _names_used,
+                                   _referencing_functions)
+
+# LeaseTable methods that mutate self._leases WITHOUT a store write, by
+# design — each exemption is the point of the method, not an oversight:
+#   evict_where   — shard hand-off: the records now belong to the new
+#                   leader; deleting them would destroy the state it is
+#                   about to rehydrate
+#   merge_records — rehydration INTO memory FROM the store; writing back
+#                   would be a no-op echo
+SANCTIONED_MEMORY_ONLY = {"LeaseTable.evict_where",
+                          "LeaseTable.merge_records"}
+
+STORE_SEAMS = {"_store_put", "_store_del", "_store_sync"}
+
+
+def _mutates_leases(funcdef) -> bool:
+    """True when the function writes the lease dict: subscript
+    assignment/deletion, .pop()/.clear()/.update(), or rebinding
+    self._leases wholesale."""
+    for node in ast.walk(funcdef):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for target in targets:
+                if isinstance(target, ast.Subscript) and \
+                        isinstance(target.value, ast.Attribute) and \
+                        target.value.attr == "_leases":
+                    return True
+                if isinstance(target, ast.Attribute) and \
+                        target.attr == "_leases":
+                    return True
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                if isinstance(target, ast.Subscript) and \
+                        isinstance(target.value, ast.Attribute) and \
+                        target.value.attr == "_leases":
+                    return True
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("pop", "clear", "update", "setdefault"):
+            inner = node.func.value
+            if isinstance(inner, ast.Attribute) and \
+                    inner.attr == "_leases":
+                return True
+    return False
+
+
+def test_every_lease_mutation_writes_through_the_store():
+    """No LeaseTable mutation site escapes the store layer: any method
+    that touches the lease dict either references a store seam or is on
+    the sanctioned memory-only list (with its reason documented above)."""
+    for qual, funcdef in _functions(lease).items():
+        if not qual.startswith("LeaseTable.") or "." in \
+                qual[len("LeaseTable."):]:
+            continue
+        if not _mutates_leases(funcdef):
+            continue
+        if qual in SANCTIONED_MEMORY_ONLY:
+            continue
+        names = _names_used(funcdef)
+        assert names & STORE_SEAMS, \
+            f"{qual} mutates the lease table without a store write — " \
+            "a failed-over peer would rehydrate stale state"
+
+
+def test_sanctioned_exemptions_still_exist():
+    """The exemption list must not rot: every sanctioned name is a real
+    mutating method (a rename would silently re-arm the lint on the old
+    name and skip the new one)."""
+    funcs = _functions(lease)
+    for qual in SANCTIONED_MEMORY_ONLY:
+        assert qual in funcs, f"{qual} no longer exists"
+        assert _mutates_leases(funcs[qual]), f"{qual} no longer mutates"
+        # and they must NOT write the store — if one starts writing,
+        # remove it from the list so the lint covers it
+        assert not (_names_used(funcs[qual]) & STORE_SEAMS), qual
+
+
+def test_store_seams_are_the_only_record_writers_in_lease():
+    """LeaseRecord construction (the serialize half of the round-trip)
+    is confined to the store seams — no method hand-rolls a record."""
+    hits = _referencing_functions(lease, "LeaseRecord")
+    assert hits <= {"LeaseTable._store_put", "LeaseTable._store_sync",
+                    "LeaseTable.flush_renewals"}, hits
+
+
+def test_waiter_park_and_resolve_sites_persist_intent():
+    """The queue path persists on park and unpersists on EVERY exit
+    (grant, timeout, error, hand-off — the finally block), and the
+    adoption drain resolves its record no matter how the re-run ends."""
+    funcs = _functions(admission)
+    queued = _names_used(funcs["AttachBroker._attach_queued"])
+    assert "_persist_waiter" in queued, \
+        "_attach_queued parks a waiter without persisting its intent"
+    assert "_unpersist_waiter" in queued, \
+        "_attach_queued resolves a waiter without removing its record"
+    adopted = _names_used(funcs["AttachBroker._run_adopted"])
+    assert "_unpersist_rid" in adopted, \
+        "_run_adopted can leave a resolved intent record behind"
+    # parking happens in exactly one place — the persist/unpersist pair
+    # above therefore covers every waiter
+    appenders = {
+        qual.split(".", 1)[0] + "." + qual.split(".")[1]
+        for qual, funcdef in funcs.items()
+        if qual.startswith("AttachBroker.")
+        and any(isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "append"
+                and isinstance(n.func.value, ast.Attribute)
+                and n.func.value.attr == "_waiters"
+                for n in ast.walk(funcdef))}
+    assert appenders == {"AttachBroker._attach_queued"}, appenders
+
+
+def test_configmap_cas_is_confined_to_store_and_election():
+    """Only the store (state records) and the election (lock records)
+    may write ConfigMaps; a broker/gateway/fleet mutation that bypasses
+    them would dodge both the fence check and the CAS discipline."""
+    for module in (admission, lease, gateway, fleet):
+        for qual, funcdef in _functions(module).items():
+            names = _names_used(funcdef)
+            bad = names & {"patch_config_map", "create_config_map",
+                           "delete_config_map"}
+            assert not bad, \
+                f"{module.__name__}.{qual} writes ConfigMaps directly " \
+                f"({bad}) — all broker state goes through the store"
+
+
+def test_store_cas_is_one_seam_with_the_fence_check_inside():
+    """Every store write funnels through _cas, where the fence token
+    check and the annotation patch are ONE atomic step — the split-brain
+    impossibility argument (docs/guide/HA.md) depends on no second
+    write path existing."""
+    assert _referencing_functions(store, "patch_config_map") == \
+        {"IntentStore._cas"}
+    assert _referencing_functions(store, "create_config_map") == \
+        {"IntentStore._cas"}
+    cas = _functions(store)["IntentStore._cas"]
+    names = _names_used(cas)
+    assert "StoreFencedError" in names, \
+        "_cas no longer enforces the fencing token"
+    # and the public write path reaches it
+    assert "_cas" in _names_used(_functions(store)["IntentStore._write"])
+
+
+def test_election_lock_writes_carry_the_full_annotation_set():
+    """Lock mutations (create/renew/takeover) all build their
+    annotations through _lock_annotations — holder, url, fence and
+    deadline move together, so an observer can never read a lock with a
+    new fence but a stale holder."""
+    hits = _referencing_functions(election, "_lock_annotations")
+    assert hits == {"ShardElection._try_create", "ShardElection._renew",
+                    "ShardElection._takeover"}, hits

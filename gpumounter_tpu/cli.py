@@ -240,14 +240,36 @@ def cmd_node(args) -> int:
 
 
 def cmd_slice(args) -> int:
+    if args.slice_action == "status":
+        return _slice_status(args)
+    if not args.pod:
+        print("slice add|remove|resize needs at least one --pod",
+              file=sys.stderr)
+        return EXIT_OTHER
     try:
         pods = _parse_slice_pods(args.pod)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return EXIT_OTHER
     if args.slice_action == "add":
-        body = {"pods": pods, "tpusPerHost": args.tpus_per_host}
+        body = {"pods": pods, "tpusPerHost": args.tpus_per_host or 4}
+        if args.strict:
+            body["strict"] = True
         path = "/addtpuslice"
+    elif args.slice_action == "resize":
+        # target membership: the master computes the delta against the
+        # group's current members, runs it as a slice txn, and bumps the
+        # mesh generation once the new chip set is fully actuated
+        body = {"pods": pods}
+        if args.tpus_per_host:
+            body["tpusPerHost"] = args.tpus_per_host
+        if args.group:
+            body["group"] = args.group
+        if args.strict:
+            body["strict"] = True
+        if args.force:
+            body["force"] = True
+        path = "/slice/resize"
     else:
         body = {"pods": pods, "force": args.force}
         path = "/removetpuslice"
@@ -256,13 +278,56 @@ def cmd_slice(args) -> int:
         args.master, "POST", path, json.dumps(body).encode(), rid,
         args.retries + 1, args.timeout)
     lines = [f"{payload.get('result')}: {len(pods)} host(s)"]
+    if args.slice_action == "resize" and "generation" in payload:
+        lines[0] += (f"  group {payload.get('group')} -> generation "
+                     f"{payload.get('generation')} "
+                     f"(+{len(payload.get('added') or [])} host(s), "
+                     f"-{len(payload.get('removed') or [])})")
     for r in payload.get("pods", []):
         lines.append(f"  {r.get('namespace')}/{r.get('pod')}: "
                      f"{r.get('result')} "
                      f"{[d for d in r.get('device_ids', [])]}")
+    if payload.get("queued_s") is not None:
+        lines.append(f"  (gang-queued {payload['queued_s']}s)")
     if payload.get("rolled_back"):
         lines.append("  (rolled back cleanly)")
     return _finish(status, payload, args.json, "\n".join(lines))
+
+
+def _slice_status(args) -> int:
+    """``tpumounterctl slice status`` — the master's /slicez view: every
+    slice group (members, chips, mesh generation) and in-flight slice
+    transactions. Non-zero exit when a transaction is stranded."""
+    status, payload = _request(args.master, "GET", "/slicez",
+                               timeout=args.timeout)
+    groups = payload.get("groups") or {}
+    txns = payload.get("txns") or {}
+    lines = [f"{len(groups)} slice group(s), "
+             f"{txns.get('pending', 0)} txn(s) in flight, "
+             f"{txns.get('stranded', 0)} stranded"]
+    for group, info in sorted(groups.items()):
+        lines.append(
+            f"  group {group}: tenant={info.get('tenant')} "
+            f"generation={info.get('generation')} "
+            f"chips={info.get('chips')}")
+        for member in info.get("members", []):
+            expires = member.get("expires_in_s")
+            lines.append(
+                f"    {member.get('namespace')}/{member.get('pod')}: "
+                f"{member.get('chips')} chip(s)"
+                + (f" on {member['node']}" if member.get("node") else "")
+                + (f", lease expires in {expires}s"
+                   if expires is not None else ""))
+    for txn in (txns.get("in_flight") or []):
+        lines.append(
+            f"  txn {txn.get('txn_id')}: {txn.get('state')} "
+            f"{len(txn.get('committed') or [])}/"
+            f"{len(txn.get('pods') or [])} host(s) committed, "
+            f"age {txn.get('age_s')}s rid={txn.get('rid')}")
+    rc = _finish(status, payload, args.json, "\n".join(lines))
+    if rc == 0 and int(txns.get("stranded") or 0) > 0:
+        return 1
+    return rc
 
 
 def _render_waterfall(trace: dict) -> list[str]:
@@ -931,6 +996,33 @@ def cmd_doctor(args) -> int:
                   f"lease(s) auto-detached, {int(preemptions)} "
                   f"preemption(s) — {scope}")
 
+    # Elastic slice subsystem: a STRANDED slice transaction (intent
+    # record older than its deadline that nothing is driving) is a
+    # half-attached slice nobody will resolve — chips held on some hosts
+    # with no lease, no client, no adopter. That is the one state the
+    # crash-safe protocol exists to prevent, so it pages CRIT.
+    try:
+        slicez = json.loads(_fetch_text(args.master, "/slicez",
+                                        args.timeout))
+    except (TransportError, ValueError):
+        slicez = None
+    if isinstance(slicez, dict) and "txns" in slicez:
+        txns = slicez.get("txns") or {}
+        stranded = int(txns.get("stranded") or 0)
+        pending = int(txns.get("pending") or 0)
+        groups = slicez.get("groups") or {}
+        gangs = int(slicez.get("gang_queue_depth") or 0)
+        if stranded:
+            check("crit",
+                  f"{stranded} STRANDED slice txn(s) past their "
+                  "deadline with no resolver — half-attached slices; "
+                  "`tpumounterctl slice status` for the records")
+        elif pending or groups or gangs:
+            check("ok",
+                  f"slices: {len(groups)} group(s) live, {pending} "
+                  f"txn(s) in flight, {gangs} gang(s) queued, 0 "
+                  "stranded")
+
     # SLO burn rates (utils/slo.py, ticked by the master's fleet loop):
     # CURRENT state — a fast 5m burn means a tenant is eating its error
     # budget ~14x the sustainable rate RIGHT NOW and pages CRIT; a slow
@@ -1283,11 +1375,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_node)
     _add_common(p, suppress=True)
 
-    p = sub.add_parser("slice", help="multi-host slice transactions")
-    p.add_argument("slice_action", choices=["add", "remove"])
-    p.add_argument("-p", "--pod", action="append", required=True,
-                   metavar="NS/POD", help="repeatable: one entry per host")
-    p.add_argument("--tpus-per-host", type=int, default=4)
+    p = sub.add_parser(
+        "slice",
+        help="multi-host slice transactions: add/remove a slice, "
+             "resize a live one (elastic mesh reshaping), or show "
+             "groups + in-flight txns (/slicez)")
+    p.add_argument("slice_action",
+                   choices=["add", "remove", "resize", "status"])
+    p.add_argument("-p", "--pod", action="append", default=[],
+                   metavar="NS/POD",
+                   help="repeatable: one entry per host (for resize: "
+                        "the full TARGET membership)")
+    p.add_argument("--tpus-per-host", type=int, default=None,
+                   help="chips per host (add default: 4; resize "
+                        "default: the group's recorded size)")
+    p.add_argument("--group", default="",
+                   help="slice group id for resize (default: derived "
+                        "from the target pods' leases)")
+    p.add_argument("--strict", action="store_true",
+                   help="reject a pod set that does not span the "
+                        "advertised topology's full host count (412)")
     p.add_argument("--force", action="store_true")
     p.add_argument("--request-id", default="")
     p.add_argument("--retries", type=int, default=2)

@@ -1,0 +1,273 @@
+"""Elastic mesh harness: a JAX training loop that survives live slice
+resizes (the jaxcheck half of the elastic slice subsystem).
+
+The control plane's ``POST /slice/resize`` (master/slicetxn.py) attaches
+or detaches whole hosts of a running slice and bumps the slice's **mesh
+generation** only once the new chip set is fully actuated. This module
+is the in-job counterpart: between training steps the harness polls a
+generation signal, and on a bump runs the safe reshape sequence the
+drain module documents —
+
+    1. ``drain(state, ckpt)``      — device arrays → host, checkpointed
+    2. backend re-init             — ``probe.reinitialize_backend`` (real
+                                     TPU; a CPU sim skips it — its
+                                     virtual devices never change)
+    3. rebuild mesh + train step   — over the CURRENT device set
+    4. ``restore(ckpt, shardings)``— resharded onto the new mesh
+
+so the loss trajectory continues across a 2→4 or 4→2 host resize with
+no reset: same parameters, same optimizer moments, same step counter —
+just laid out over a different number of chips.
+
+Generation signals (pick one):
+
+- :class:`MasterSliceSignal` — poll the master's ``/slicez`` for the
+  slice group's generation + chip count (the informer-path analog; a
+  pod can also watch its own ``tpumounter.io/mesh-generation``
+  annotation).
+- :func:`read_generation_file` — the per-pod notification file the
+  worker stamps on every actuation (``TPU_MESH_GEN_DIR``, mounted via
+  hostPath): zero apiserver traffic, node-local latency.
+
+Resharding uses a **template**: the state's shardings on the new mesh
+are derived by re-running ``init_state`` (cheap — init is tiny next to
+one training step) and mapping each leaf to its template's sharding, so
+parameters AND optimizer state land exactly where a fresh init would
+put them, with the checkpoint's values.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import urllib.request
+from typing import Any, Callable
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from gpumounter_tpu.jaxcheck import drain as drain_lib
+from gpumounter_tpu.jaxcheck import model as model_lib
+from gpumounter_tpu.jaxcheck import train as train_lib
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("jaxcheck.elastic")
+
+
+# -- generation signals --------------------------------------------------------
+
+
+def read_generation_file(path: str) -> dict | None:
+    """The worker-stamped notification file: {"generation": <unix>,
+    "chips": [...]}, or None when it does not exist yet (no actuation
+    has touched this pod)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class FileSignal:
+    """Generation + chip count from the worker's notification file."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def generation(self):
+        payload = read_generation_file(self.path)
+        return None if payload is None else payload.get("generation")
+
+    def chips(self) -> int:
+        payload = read_generation_file(self.path) or {}
+        return len(payload.get("chips") or [])
+
+
+class MasterSliceSignal:
+    """Generation + chip count for one slice group from the master's
+    ``/slicez`` view. ``None`` generation = the group is unknown (not
+    attached yet, or the master is unreachable) — the harness treats
+    that as "no change"."""
+
+    def __init__(self, master_base: str, group: str,
+                 timeout_s: float = 5.0):
+        self.base = master_base.rstrip("/")
+        self.group = group
+        self.timeout_s = timeout_s
+
+    def _fetch(self) -> dict | None:
+        try:
+            with urllib.request.urlopen(f"{self.base}/slicez",
+                                        timeout=self.timeout_s) as resp:
+                payload = json.loads(resp.read())
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+        return (payload.get("groups") or {}).get(self.group)
+
+    def generation(self):
+        group = self._fetch()
+        return None if group is None else group.get("generation")
+
+    def chips(self) -> int:
+        group = self._fetch() or {}
+        return int(group.get("chips") or 0)
+
+
+# -- resharding ----------------------------------------------------------------
+
+
+def state_shardings(cfg: model_lib.ModelConfig, mesh,
+                    optimizer=None, seed: int = 0):
+    """The full TrainState's shardings on ``mesh``, via a throwaway
+    template init: every leaf (params, optimizer moments, step counter)
+    gets exactly the placement a fresh init would give it — the shape
+    ``drain.restore`` reshards a checkpoint onto."""
+    template = train_lib.init_state(jax.random.PRNGKey(seed), cfg, mesh,
+                                    optimizer)
+    replicated = NamedSharding(mesh, P())
+
+    def sharding_of(leaf):
+        if not isinstance(leaf, jax.Array):
+            return None
+        sharding = leaf.sharding
+        # scalar leaves (optimizer count, step counter) come out of init
+        # committed to ONE device; restoring them there would clash with
+        # mesh-spanning params under jit — replicate them over the mesh,
+        # which is where a sharded step wants them anyway
+        if not isinstance(sharding, NamedSharding):
+            return replicated
+        return sharding
+
+    return jax.tree.map(sharding_of, template)
+
+
+# -- the harness ---------------------------------------------------------------
+
+
+class ElasticHarness:
+    """Owns a train state + jitted step over the current slice mesh and
+    reshapes both when the generation signal moves.
+
+    ``generation_fn`` / ``chips_fn``: the signal (see FileSignal /
+    MasterSliceSignal). ``step_factory(cfg, mesh, optimizer)`` builds
+    the jitted step (default: the flagship sharded ring-attention step;
+    inject a different factory for other attention impls).
+    ``reinitialize``: backend re-init between drain and restore —
+    ``probe.reinitialize_backend`` on real TPU, None on a CPU sim whose
+    virtual devices never change. ``data``/``model`` fix those mesh
+    axes; "seq" absorbs the chip count (model_lib.make_mesh).
+    """
+
+    def __init__(self, cfg: model_lib.ModelConfig,
+                 generation_fn: Callable[[], Any],
+                 chips_fn: Callable[[], int], *,
+                 optimizer=None,
+                 step_factory: Callable | None = None,
+                 reinitialize: Callable[[], None] | None = None,
+                 checkpoint_path: str | None = None,
+                 data: int = 1, model: int = 1, seed: int = 0):
+        self.cfg = cfg
+        self.generation_fn = generation_fn
+        self.chips_fn = chips_fn
+        self.optimizer = optimizer or train_lib.make_optimizer()
+        self.step_factory = step_factory or (
+            lambda c, mesh, opt: train_lib.make_train_step(
+                c, mesh, optimizer=opt))
+        self.reinitialize = reinitialize
+        if checkpoint_path is None:
+            fd, checkpoint_path = tempfile.mkstemp(suffix=".elastic.ckpt")
+            os.close(fd)
+        self.checkpoint_path = checkpoint_path
+        self.data = data
+        self.model = model
+        self.seed = seed
+        self.mesh = None
+        self.state = None
+        self.step_fn = None
+        self.generation = None
+        self.reshapes = 0
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "ElasticHarness":
+        """Initialise state + step over the current chip set; records
+        the current generation as the baseline."""
+        self.generation = self.generation_fn()
+        self._build(fresh=True)
+        return self
+
+    def _current_mesh(self):
+        chips = int(self.chips_fn())
+        devices = jax.devices()
+        if chips <= 0 or chips > len(devices):
+            raise RuntimeError(
+                f"slice reports {chips} chips but this process sees "
+                f"{len(devices)} devices — attach/visibility mismatch")
+        return model_lib.make_mesh(devices[:chips], data=self.data,
+                                   model=self.model)
+
+    def _build(self, fresh: bool) -> None:
+        self.mesh = self._current_mesh()
+        self.step_fn = self.step_factory(self.cfg, self.mesh,
+                                         self.optimizer)
+        if fresh:
+            self.state = train_lib.init_state(
+                jax.random.PRNGKey(self.seed), self.cfg, self.mesh,
+                self.optimizer)
+        else:
+            shardings = state_shardings(self.cfg, self.mesh,
+                                        self.optimizer, self.seed)
+            self.state = drain_lib.restore(self.checkpoint_path,
+                                           shardings)
+        size = self.mesh.devices.size
+        logger.info("elastic mesh %s over %d device(s)%s",
+                    dict(zip(self.mesh.axis_names, self.mesh.devices.shape)),
+                    size, "" if fresh else " (restored from checkpoint)")
+
+    # -- reshape ---------------------------------------------------------------
+
+    def poll(self) -> bool:
+        """Between-steps check: if the generation moved, run the drain →
+        reinit → rebuild → restore sequence. Returns True when a reshape
+        happened."""
+        generation = self.generation_fn()
+        if generation is None or generation == self.generation:
+            return False
+        self.reshape(generation)
+        return True
+
+    def reshape(self, generation=None) -> None:
+        old = self.mesh.devices.size if self.mesh is not None else 0
+        drain_lib.drain(self.state, self.checkpoint_path)
+        # release every reference into the old backend BEFORE dropping
+        # it — live arrays on dead backends are the classic reshape bug
+        self.state = None
+        self.step_fn = None
+        if self.reinitialize is not None:
+            self.reinitialize()
+        self._build(fresh=False)
+        self.generation = (self.generation_fn()
+                           if generation is None else generation)
+        self.reshapes += 1
+        logger.info("reshaped %d -> %d devices at generation %r", old,
+                    self.mesh.devices.size, self.generation)
+
+    # -- training --------------------------------------------------------------
+
+    def place_tokens(self, host_tokens) -> jax.Array:
+        """Host token batch → sharded over the CURRENT mesh (data, seq)."""
+        return jax.device_put(
+            host_tokens, NamedSharding(self.mesh, P("data", "seq")))
+
+    def train_step(self, host_tokens) -> float:
+        """One step over the current mesh (poll() first if reshapes
+        should be picked up between steps — kept separate so callers
+        control when a reshape may interrupt)."""
+        self.state, loss = self.step_fn(self.state,
+                                        self.place_tokens(host_tokens))
+        return float(loss)
+
+    def close(self) -> None:
+        if os.path.exists(self.checkpoint_path):
+            os.unlink(self.checkpoint_path)

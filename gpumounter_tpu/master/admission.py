@@ -82,6 +82,9 @@ class BrokerConfig:
     lease_ttl_s: float = 0.0
     queue_timeout_s: float = 0.0
     queue_depth: int = 64
+    # Gang (whole-slice) waiters: how long partially reserved hosts may
+    # be held before hand-back (master/slicetxn.py anti-deadlock).
+    gang_hold_s: float = consts.DEFAULT_GANG_HOLD_S
     tick_interval_s: float = 1.0
     pool_namespace: str = consts.DEFAULT_POOL_NAMESPACE
     resource_name: str = consts.TPU_RESOURCE_NAME
@@ -93,6 +96,7 @@ class BrokerConfig:
                    lease_ttl_s=settings.lease_ttl_s,
                    queue_timeout_s=settings.queue_timeout_s,
                    queue_depth=settings.queue_depth,
+                   gang_hold_s=settings.gang_hold_s,
                    pool_namespace=settings.pool_namespace,
                    resource_name=settings.resource_name)
 
@@ -108,7 +112,7 @@ class _Waiter:
 
     __slots__ = ("tenant", "priority", "chips", "node", "rid",
                  "namespace", "pod", "enqueued_at", "event", "tried_gen",
-                 "preempted", "entire", "deadline", "outcome")
+                 "preempted", "entire", "deadline", "outcome", "gang")
 
     def __init__(self, tenant: str, priority: str, chips: int, node: str,
                  rid: str, namespace: str, pod: str, gen: int,
@@ -127,6 +131,10 @@ class _Waiter:
         self.entire = entire
         self.deadline = self.enqueued_at + timeout_s
         self.outcome: str | None = None
+        # Gang waiter (a parked whole-slice attach, master/slicetxn.py):
+        # node-less (any host freeing chips may complete it) and
+        # persisted as a slice txn record instead of a waiter record.
+        self.gang = False
 
 
 class AttachBroker:
@@ -184,6 +192,14 @@ class AttachBroker:
         self._adopt_lock = threading.Lock()
         self._adopted_rids: dict[str, int] = {}
         self._rehydrated_shards: set[int] = set()
+        # Slice transaction manager (bind_slice): group-lease expiry and
+        # preemption detach whole slices through it; rehydration hands
+        # it stranded txn records. None = single-host semantics only.
+        self._slice = None
+        # A release/expiry/hand-back freed chips since the last tick:
+        # the tick stamps the peer shards' capacity poke (request
+        # threads never pay the ConfigMap round trip).
+        self._poke_pending = False
 
     def bind(self, detach_fn) -> None:
         """``detach_fn(lease, cause, force) -> result name`` — the
@@ -203,6 +219,12 @@ class AttachBroker:
 
     def bind_attempt_factory(self, factory) -> None:
         self._attempt_factory = factory
+
+    def bind_slice(self, manager) -> None:
+        """Wire the slice transaction manager (master/slicetxn.py):
+        group-lease expiry/preemption detach whole slices through it,
+        and shard rehydration hands it stranded txn records to adopt."""
+        self._slice = manager
 
     # -- sharding / ownership --------------------------------------------------
 
@@ -289,6 +311,20 @@ class AttachBroker:
                         "waiter(s) to adopt, %d torn record(s)", shard,
                         merged, len(waiters), torn)
         self._adopt_waiters(waiters)
+        if self._slice is not None:
+            # unresolved slice transactions (a dead leader's mid-fan-out
+            # state): the manager completes or rolls each back under its
+            # original rid/txn — the zero-half-attached-slices guarantee
+            try:
+                slice_records, _ = self.store.rehydrate_slice_txns(shard)
+            except K8sApiError as e:
+                logger.warning("shard %d slice-txn rehydration deferred: "
+                               "%s (tick retries)", shard, e)
+                slice_records = []
+            if slice_records:
+                adopted = self._slice.adopt(slice_records)
+                logger.info("shard %d: adopted %d stranded slice txn(s)",
+                            shard, adopted)
 
     # -- recovered-waiter adoption ---------------------------------------------
 
@@ -576,19 +612,8 @@ class AttachBroker:
         # ``timeout`` was resolved (and gated > 0) by attach() — a second
         # default-resolution here could silently diverge from that gate
         with self._lock:
-            depth = sum(1 for w in self._waiters
-                        if w.priority == priority)
-            if depth >= self.config.queue_depth:
-                REGISTRY.admission_decisions.inc(tenant=tenant,
-                                                 outcome="queue_full")
-                EVENTS.emit("queue_full", rid=rid, tenant=tenant,
-                            chips=chips, priority=priority, depth=depth)
-                # a slot frees at the latest when the oldest same-
-                # priority waiter times out — tell the client exactly
-                # that instead of a blind constant
-                raise QueueFullError(
-                    priority, depth,
-                    retry_after_s=self._queue_full_hint_locked(priority))
+            depth = self._check_queue_full_locked(tenant, priority,
+                                                  chips, rid, gang=False)
             waiter = _Waiter(tenant, priority, chips, node, rid,
                              namespace, pod, gen=gen0, entire=entire,
                              timeout_s=timeout)
@@ -688,6 +713,103 @@ class AttachBroker:
             # a surviving replica must adopt
             self._unpersist_waiter(waiter)
 
+    # -- gang waiters (whole-slice attaches, master/slicetxn.py) ---------------
+
+    def current_gen(self) -> int:
+        """The capacity generation right now — callers snapshot it
+        before an attempt so an enqueue can self-arm against a signal
+        that fired in between (see ``_check_queue_full_locked``'s
+        companion logic in ``_attach_queued`` and ``park_gang``)."""
+        with self._lock:
+            return self._gen
+
+    def _check_queue_full_locked(self, tenant: str, priority: str,
+                                 chips: int, rid: str,
+                                 gang: bool) -> int:
+        """The one queue-full gate (single waiters and gangs share it):
+        returns the current same-priority depth, or raises
+        :class:`QueueFullError` with the derived hint."""
+        depth = sum(1 for w in self._waiters if w.priority == priority)
+        if depth >= self.config.queue_depth:
+            REGISTRY.admission_decisions.inc(tenant=tenant,
+                                             outcome="queue_full")
+            EVENTS.emit("queue_full", rid=rid, tenant=tenant,
+                        chips=chips, priority=priority, depth=depth,
+                        gang=gang)
+            raise QueueFullError(
+                priority, depth,
+                retry_after_s=self._queue_full_hint_locked(priority))
+        return depth
+
+    def park_gang(self, *, tenant: str, priority: str, chips: int,
+                  rid: str, namespace: str, label: str,
+                  timeout_s: float, gen0: int | None = None) -> _Waiter:
+        """Park a whole-slice attach in the contention queue. The gang
+        rides the SAME priority-then-weighted-fair wakeup as single
+        waiters (its chips weigh its tenant's fair share), but is
+        node-less — any host freeing chips may complete some member —
+        and its durable intent is the slice txn record the manager
+        persists, not a waiter record. ``gen0`` is the capacity
+        generation sampled BEFORE the failed attempt: a signal that
+        fired in between already went to someone else (or nobody), so
+        the gang self-arms instead of sleeping next to free chips —
+        the same race ``_attach_queued`` closes. Raises
+        :class:`QueueFullError` at the per-priority bound like any
+        other enqueue."""
+        with self._lock:
+            depth = self._check_queue_full_locked(tenant, priority,
+                                                  chips, rid, gang=True)
+            waiter = _Waiter(tenant, priority, chips, node="", rid=rid,
+                             namespace=namespace, pod=label,
+                             gen=self._gen if gen0 is None else gen0,
+                             entire=True, timeout_s=timeout_s)
+            waiter.gang = True
+            self._waiters.append(waiter)
+            if gen0 is not None and self._gen != gen0:
+                waiter.tried_gen = self._gen
+                waiter.event.set()
+            self._refresh_queue_gauges_locked()
+        EVENTS.emit("queue_enqueue", rid=rid, tenant=tenant, chips=chips,
+                    namespace=namespace, pod=label, priority=priority,
+                    depth=depth + 1, gang=True)
+        return waiter
+
+    def unpark_gang(self, waiter: _Waiter) -> None:
+        """Remove a resolved gang from the queue and hand any
+        outstanding wakeup on (the departing-waiter baton discipline of
+        ``_attach_queued``'s finally block)."""
+        with self._lock:
+            if waiter in self._waiters:
+                self._waiters.remove(waiter)
+            self._signal_next_locked()
+            self._refresh_queue_gauges_locked()
+
+    def gang_baton(self, waiter: _Waiter) -> None:
+        """A woken gang retried and is still short: mark its generation
+        consumed and wake the next untried waiter."""
+        self._signal_next(exclude=waiter)
+
+    def try_preempt_for(self, waiter: _Waiter) -> bool:
+        """Preemption entry for gang waiters (the single-attach queue
+        loop calls ``_try_preempt`` directly)."""
+        return self._try_preempt(waiter)
+
+    def poke_peers(self) -> bool:
+        """Cross-shard capacity nudge: chips freed on this replica's
+        shards may be what a PEER shard's parked waiters (gangs
+        especially — multi-node demand) are sleeping on. The request
+        thread only MARKS the nudge; the broker tick sends it — a peer
+        ConfigMap patch is an apiserver round trip that must never ride
+        (or stall) the detach hot path, and batching to tick cadence
+        caps poke traffic regardless of release rate. No-op outside the
+        sharded-store configuration."""
+        if self.store is None or self.ring is None \
+                or self.ring.shards < 2 or self.election is None \
+                or not self.election.enabled:
+            return False
+        self._poke_pending = True
+        return True
+
     # -- capacity signalling / fair dequeue ------------------------------------
 
     def signal_capacity(self) -> None:
@@ -730,6 +852,8 @@ class AttachBroker:
             REGISTRY.queue_depth.set(
                 sum(1 for w in self._waiters if w.priority == priority),
                 priority=priority)
+        REGISTRY.gang_queue_depth.set(
+            sum(1 for w in self._waiters if w.gang))
         oldest = min((w.enqueued_at for w in self._waiters), default=None)
         REGISTRY.queue_oldest_age.set(
             0.0 if oldest is None else round(now - oldest, 3))
@@ -754,6 +878,8 @@ class AttachBroker:
         if victim is None:
             return False
         cause = f"preempted:{waiter.tenant}:{waiter.rid or '-'}"
+        if victim.group and self._slice is not None:
+            return self._preempt_group(victim, waiter, cause)
         logger.warning("preempting %s/%s (tenant=%s priority=%s chips=%d)"
                        " for high-priority rid=%s of tenant=%s",
                        victim.namespace, victim.pod, victim.tenant,
@@ -761,6 +887,12 @@ class AttachBroker:
                        waiter.tenant)
         result = self._detach_fn(victim, cause, True)
         if result in _DETACH_GONE:
+            # count toward the damping bound whether or not the drop
+            # lands — this waiter consumed a preemption attempt (the
+            # bound was documented but never incremented before: one
+            # high-priority waiter could serially drain every over-quota
+            # lease on a node whose freed chips were slow to attach)
+            waiter.preempted += 1
             if self.leases.drop(victim.namespace, victim.pod) is not None:
                 REGISTRY.preemptions.inc()
                 # emitted only when the drop landed: a lease released
@@ -775,6 +907,41 @@ class AttachBroker:
             return True
         logger.warning("preemption of %s/%s did not free chips: %s",
                        victim.namespace, victim.pod, result)
+        return False
+
+    def _preempt_group(self, victim: Lease, waiter: _Waiter,
+                       cause: str) -> bool:
+        """Preempt a slice group as a unit: detaching one member would
+        leave the victim's JAX world broken AND keep most of its chips
+        — the group goes together, through the coordinator's fan-out."""
+        members = self.leases.group_leases(victim.group)
+        pods = [(member.namespace, member.pod) for member in members]
+        logger.warning("preempting slice group %s (%d hosts, tenant=%s) "
+                       "for high-priority rid=%s of tenant=%s",
+                       victim.group, len(pods), victim.tenant,
+                       waiter.rid, waiter.tenant)
+        ok, results = self._slice.detach_members(
+            pods, cause=f"{cause}:group:{victim.group}", force=True)
+        freed_chips = 0
+        freed_members = 0
+        for result in results:
+            if result.result in _DETACH_GONE:
+                dropped = self.leases.drop(result.namespace, result.pod)
+                if dropped is not None:
+                    freed_chips += dropped.chips
+                    freed_members += 1
+        if freed_members:
+            REGISTRY.preemptions.inc()
+            EVENTS.emit("preempt", rid=waiter.rid, tenant=waiter.tenant,
+                        namespace=victim.namespace, pod=victim.pod,
+                        chips=freed_chips, victim_tenant=victim.tenant,
+                        victim_priority=victim.priority,
+                        group=victim.group,
+                        result="SUCCESS" if ok else "PARTIAL")
+            waiter.preempted += freed_members
+            self.signal_capacity()
+            self.poke_peers()
+            return True
         return False
 
     def _pick_victim(self, waiter: _Waiter) -> Lease | None:
@@ -816,18 +983,27 @@ class AttachBroker:
     def renew(self, namespace: str, pod: str,
               ttl_s: float | None = None) -> Lease:
         """Extend a lease (``POST /renew``). Raises KeyError for unknown
-        leases — a renew can't resurrect an expired-and-reaped attach."""
+        leases — a renew can't resurrect an expired-and-reaped attach.
+        A slice-group member renews the WHOLE group: the slice lives and
+        dies as a unit, so one member's heartbeat is the slice's."""
         self.ensure_rederived()
         ttl = self.config.lease_ttl_s if ttl_s is None else ttl_s
-        return self.leases.renew(namespace, pod, ttl)
+        lease = self.leases.renew(namespace, pod, ttl)
+        if lease.group:
+            for member in self.leases.group_leases(lease.group):
+                if member.key != lease.key:
+                    self.leases.renew(member.namespace, member.pod, ttl)
+        return lease
 
     def release(self, namespace: str, pod: str,
                 uuids: list[str] | None = None) -> None:
         """Account an owner-initiated detach and wake the queue — even
         without a lease on record (pre-broker attach), freed chips are
-        freed chips."""
+        freed chips. Peer shards get a capacity poke too: their parked
+        gangs may span the node these chips just freed on."""
         self.leases.release(namespace, pod, uuids)
         self.signal_capacity()
+        self.poke_peers()
 
     # -- expiry loop -----------------------------------------------------------
 
@@ -887,6 +1063,30 @@ class AttachBroker:
                 # a direct write — note the refused fence and demote,
                 # and DON'T abort the tick (gauge refresh must still run)
                 self._on_fenced(e)
+            # cross-shard capacity pokes (first half of ROADMAP open
+            # item 1): send any nudge the request paths marked pending
+            # (one stamp per tick regardless of release rate), then one
+            # fresh read per owned shard for INBOUND nudges —
+            # edge-triggered on the stamp
+            if self.ring is not None and self.ring.shards > 1 \
+                    and self.election is not None \
+                    and self.election.enabled:
+                if self._poke_pending:
+                    self._poke_pending = False
+                    self.store.poke_peers(set(self.election.owned()))
+                # inbound check only while someone is actually parked:
+                # with an empty queue the signal would be a no-op, and
+                # one GET per owned shard per tick is real idle-state
+                # apiserver load on a many-shard replica
+                with self._lock:
+                    parked = bool(self._waiters)
+                if parked:
+                    for shard in self.election.owned():
+                        if self.store.check_poke(shard):
+                            self.signal_capacity()
+        if self._slice is not None:
+            # stranded slice-txn adoption + slice gauges
+            self._slice.tick()
         with self._lock:
             self._refresh_queue_gauges_locked()
         self.leases.export_gauges()
@@ -915,6 +1115,11 @@ class AttachBroker:
         remaining = lease.expires_in_s(now)
         if remaining is None or remaining > 0:
             return False
+        if lease.group and self._slice is not None:
+            # slice-group expiry: the WHOLE slice detaches as a unit —
+            # one expired member means the group's heartbeat stopped,
+            # and a partial slice is useless to the JAX world over it
+            return self._reap_group(lease)
         cause = f"lease-expired:{lease.rid or '-'}"
         result = self._detach_fn(lease, cause, False)
         if result in _DETACH_GONE:
@@ -937,6 +1142,46 @@ class AttachBroker:
         logger.warning("lease-expiry detach of %s/%s deferred (%s), "
                        "attempt %d", lease.namespace, lease.pod, result,
                        lease.reap_failures)
+        return False
+
+    def _reap_group(self, lease: Lease) -> bool:
+        """Expire a whole slice group through the coordinator's fan-out
+        (master/slicetxn.py ``detach_members``) — every member host, the
+        cause stamped into each worker's audit trail."""
+        members = self.leases.group_leases(lease.group)
+        if not members:
+            return False
+        cause = f"lease-expired:{lease.rid or '-'}:group:{lease.group}"
+        pods = [(member.namespace, member.pod) for member in members]
+        ok, results = self._slice.detach_members(pods, cause=cause)
+        gone = [r for r in results if r.result in _DETACH_GONE]
+        dropped = 0
+        for result in gone:
+            if self.leases.drop(result.namespace,
+                                result.pod) is not None:
+                dropped += 1
+        if dropped:
+            REGISTRY.lease_expirations.inc(float(dropped))
+            logger.info("slice group %s expired: detached %d member "
+                        "host(s)", lease.group, dropped)
+        EVENTS.emit("lease_expired", rid=lease.rid, tenant=lease.tenant,
+                    namespace=lease.namespace, pod=lease.pod,
+                    chips=sum(member.chips for member in members),
+                    group=lease.group,
+                    result="SUCCESS" if ok else "PARTIAL")
+        if dropped:
+            self.signal_capacity()
+            self.poke_peers()
+        if ok:
+            return True
+        # some member deferred (busy devices): back EVERY surviving
+        # member off and retry next tick — the dropped ones are gone for
+        # real, so the group shrinks toward resolved instead of
+        # hammering the busy host once per member per tick
+        for member in self.leases.group_leases(lease.group):
+            member.reap_failures += 1
+            member.expires_at = time.monotonic() + min(
+                30.0, 2.0 * member.reap_failures)
         return False
 
     # -- introspection (/brokerz) ----------------------------------------------

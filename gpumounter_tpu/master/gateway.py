@@ -136,12 +136,14 @@ _PLAIN_ROUTES = {"/healthz": "healthz", "/version": "version",
                  "/tracez": "tracez", "/brokerz": "brokerz",
                  "/eventz": "eventz", "/fleetz": "fleetz",
                  "/addtpuslice": "addtpuslice",
-                 "/removetpuslice": "removetpuslice"}
+                 "/removetpuslice": "removetpuslice",
+                 "/slice/resize": "sliceresize",
+                 "/slicez": "slicez"}
 # Pure introspection requests (and renew heartbeats) would drown the
 # mount traces in the ring buffer; they are measured (histogram) but not
 # stored.
 _UNTRACED_ROUTES = {"healthz", "version", "tracez", "brokerz", "eventz",
-                    "fleetz", "renew", "unknown"}
+                    "fleetz", "renew", "slicez", "unknown"}
 
 
 def _route_label(path: str) -> str:
@@ -192,6 +194,14 @@ class MasterGateway:
                      if self.ha.store else None)
             self.broker.bind_ha(store, self.ring, self.election)
             self.broker.bind_attempt_factory(self._adopted_attempt)
+        # Elastic slice subsystem (master/slicetxn.py): crash-safe slice
+        # transactions, gang admission, slice-group leases and the
+        # /slice/resize reshaping route. With the defaults (no store, no
+        # queue timeout, no lease TTL) it degenerates to exactly the
+        # PR 8 in-memory fan-out + rollback.
+        from gpumounter_tpu.master.slicetxn import SliceTxnManager
+        self.slices = SliceTxnManager(self)
+        self.broker.bind_slice(self.slices)
         # Telemetry plane: the SLO engine computes per-tenant burn rates
         # from this process's registry; the fleet aggregator scrapes every
         # worker's health port into the /fleetz cluster view and ticks the
@@ -497,6 +507,14 @@ class MasterGateway:
             if method != "POST":
                 return self._method_not_allowed("POST", method, p)
             return self._slice_detach(body, rid, ctx)
+        if p == "/slice/resize":
+            if method != "POST":
+                return self._method_not_allowed("POST", method, p)
+            return self._slice_resize(body, rid, ctx)
+        if p == "/slicez":
+            if method != "GET":
+                return self._method_not_allowed("GET", method, p)
+            return 200, self.slices.snapshot()
         if p == "/tracez":
             if method != "GET":
                 return self._method_not_allowed("GET", method, p)
@@ -664,7 +682,25 @@ class MasterGateway:
             raise ValueError(
                 'body must be {"pods": [{"namespace": ..., "pod": ...}, '
                 '...], ...}')
+        # A duplicated (namespace, pod) entry would fan out TWO attaches
+        # to the same pod — double slave pods, a double-counted lease,
+        # and a rollback that only targets one of them. Reject precisely
+        # rather than silently dedupe: the caller's host list is wrong.
+        seen: set[tuple[str, str]] = set()
+        for entry in pods:
+            if entry in seen:
+                raise ValueError(
+                    f"duplicate pod {entry[0]}/{entry[1]} in pods[]: "
+                    "each slice member must be listed exactly once")
+            seen.add(entry)
         return pods, obj
+
+    @staticmethod
+    def _parse_strict(obj: dict) -> bool:
+        strict = obj.get("strict", False)
+        if not isinstance(strict, bool):
+            raise ValueError(f'"strict" must be a boolean, got {strict!r}')
+        return strict
 
     def _slice_attach(self, body: bytes, rid: str = "-",
                       ctx: dict | None = None) -> tuple[int, dict]:
@@ -675,6 +711,7 @@ class MasterGateway:
                     or tpus < 1:
                 raise ValueError(
                     f"tpusPerHost must be a positive integer, got {tpus!r}")
+            strict = self._parse_strict(obj)
         except ValueError as e:
             return 400, {"result": "BadRequest", "message": str(e)}
         # Shard gate keyed on the FIRST pod's namespace (the slice's
@@ -687,11 +724,12 @@ class MasterGateway:
                                     body, rid, ctx))
         if gate is not None:
             return gate
-        # Tenant admission for the WHOLE slice (body "tenant"/"priority",
-        # falling back to header then the first pod's namespace): one
-        # aggregate quota check before any host is touched — over-quota
-        # raises QuotaExceededError → 429 + Retry-After, no fan-out.
-        # Slices never queue: a half-arrived slice holds nothing.
+        # Tenant resolution for the WHOLE slice (body "tenant"/"priority",
+        # falling back to header then the first pod's namespace). The
+        # slice txn manager runs the reservation-scoped quota admission
+        # for the aggregate chip count (over-quota → 429 before any host
+        # is touched), the crash-safe transaction itself, and — with the
+        # queue enabled — gang parking instead of the old fail-fast.
         tenant = str(obj.get("tenant") or (ctx or {}).get("tenant")
                      or pods[0][0])
         priority = str(obj.get("priority") or (ctx or {}).get("priority")
@@ -703,31 +741,56 @@ class MasterGateway:
             return 400, {"result": "BadRequest",
                          "message": f"bad priority {priority!r}: want "
                                     f"{'|'.join(consts.PRIORITIES)}"}
-        # reservation-scoped admission: the whole-slice chip count stays
-        # counted as in-flight usage until the leases are recorded, so a
-        # concurrent same-tenant attach cannot stampede past the cap
-        # between this check and the fan-out finishing
-        with self.broker.admission(tenant, tpus * len(pods), rid):
-            try:
-                ok, results, rollback_clean = \
-                    self._slice_coordinator().attach(pods, tpus,
-                                                     request_id=rid)
-            except TopologyError as e:
-                # pre-fan-out rejection: no host was touched
-                return 412, {"result": "TopologyMismatch",
-                             "message": str(e)}
-            if ok:
-                for r in results:
-                    self.broker.leases.record(
-                        r.namespace, r.pod, tenant, priority,
-                        list(r.device_ids), chips=len(r.device_ids),
-                        rid=rid, ttl_s=self.broker.config.lease_ttl_s)
-                self.broker.signal_capacity()
-        return (200 if ok else 503), {
-            "result": "SUCCESS" if ok else "SliceAttachFailed",
-            "rolled_back": (not ok) and rollback_clean,
-            "tenant": tenant,
-            "pods": [r.to_json() for r in results]}
+        try:
+            return self.slices.attach(pods, tpus, tenant=tenant,
+                                      priority=priority, rid=rid,
+                                      strict=strict)
+        except TopologyError as e:
+            # pre-fan-out rejection: no host was touched
+            return 412, {"result": "TopologyMismatch",
+                         "message": str(e)}
+
+    def _slice_resize(self, body: bytes, rid: str = "-",
+                      ctx: dict | None = None) -> tuple[int, dict]:
+        """``POST /slice/resize`` — reshape a live slice to the body's
+        target membership: the grow half runs as a crash-safe slice txn
+        joining the existing group, the shrink half detaches through the
+        normal path, and the mesh generation bumps only once the new
+        chip set is fully actuated (docs/guide/Elasticity.md)."""
+        try:
+            pods, obj = self._parse_slice_body(body)
+            tpus = obj.get("tpusPerHost")
+            if tpus is not None and (not isinstance(tpus, int)
+                                     or isinstance(tpus, bool)
+                                     or tpus < 1):
+                raise ValueError(
+                    f"tpusPerHost must be a positive integer, got {tpus!r}")
+            strict = self._parse_strict(obj)
+        except ValueError as e:
+            return 400, {"result": "BadRequest", "message": str(e)}
+        gate = (self._slice_shard_guard(pods)
+                or self._shard_gate(pods[0][0], "POST", "/slice/resize",
+                                    body, rid, ctx))
+        if gate is not None:
+            return gate
+        tenant = obj.get("tenant") or (ctx or {}).get("tenant")
+        priority = obj.get("priority") or (ctx or {}).get("priority")
+        if tenant is not None and not _RID_RE.match(str(tenant)):
+            return 400, {"result": "BadRequest",
+                         "message": f"bad tenant {tenant!r}"}
+        if priority is not None and priority not in consts.PRIORITIES:
+            return 400, {"result": "BadRequest",
+                         "message": f"bad priority {priority!r}: want "
+                                    f"{'|'.join(consts.PRIORITIES)}"}
+        try:
+            return self.slices.resize(
+                pods, tpus, rid=rid,
+                tenant=str(tenant) if tenant else None,
+                priority=str(priority) if priority else None,
+                group=(str(obj["group"]) if obj.get("group") else None),
+                strict=strict, force=bool(obj.get("force", False)))
+        except TopologyError as e:
+            return 412, {"result": "TopologyMismatch", "message": str(e)}
 
     def _slice_detach(self, body: bytes, rid: str = "-",
                       ctx: dict | None = None) -> tuple[int, dict]:

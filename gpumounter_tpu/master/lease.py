@@ -63,6 +63,11 @@ class Lease:
     # the lease as stuck rather than silently immortal.
     reap_failures: int = 0
     rederived: bool = False
+    # Slice-group membership (master/slicetxn.py): leases sharing a group
+    # id form ONE multi-host slice and renew/expire/preempt as a unit —
+    # a half-expired slice is useless to the JAX world spanning it.
+    # "" = a plain single-host attachment.
+    group: str = ""
 
     @property
     def key(self) -> tuple[str, str]:
@@ -96,6 +101,8 @@ class Lease:
             out["reap_failures"] = self.reap_failures
         if self.rederived:
             out["rederived"] = True
+        if self.group:
+            out["group"] = self.group
         return out
 
 
@@ -155,17 +162,20 @@ class LeaseTable:
 
     def record(self, namespace: str, pod: str, tenant: str, priority: str,
                uuids: list[str], chips: int = 0, node: str = "",
-               rid: str = "", ttl_s: float = 0.0) -> Lease:
+               rid: str = "", ttl_s: float = 0.0,
+               group: str = "") -> Lease:
         """Record a successful attach; merges into the pod's existing
         lease (chips union, refreshed expiry, the NEW tenant/priority win
-        — the latest grant is who the pod answers to now)."""
+        — the latest grant is who the pod answers to now). ``group``
+        stamps slice-group membership (master/slicetxn.py)."""
         deadline = (time.monotonic() + ttl_s) if ttl_s > 0 else None
         with self._lock:
             lease = self._leases.get((namespace, pod))
             if lease is None:
                 lease = Lease(namespace, pod, tenant, priority,
                               chips=chips or len(uuids), uuids=set(uuids),
-                              node=node, rid=rid, expires_at=deadline)
+                              node=node, rid=rid, expires_at=deadline,
+                              group=group)
                 self._leases[(namespace, pod)] = lease
             else:
                 lease.tenant = tenant
@@ -181,6 +191,7 @@ class LeaseTable:
                 lease.rid = rid or lease.rid
                 lease.expires_at = deadline
                 lease.rederived = False
+                lease.group = group or lease.group
             self._known_tenants.add(tenant)
         self._store_put(lease)
         self.export_gauges()
@@ -277,6 +288,29 @@ class LeaseTable:
     def leases(self) -> list[Lease]:
         with self._lock:
             return list(self._leases.values())
+
+    def group_leases(self, group: str) -> list[Lease]:
+        """Every member lease of a slice group — the unit renewal,
+        expiry and preemption operate on (ordered for stable output)."""
+        if not group:
+            return []
+        with self._lock:
+            return sorted((lease for lease in self._leases.values()
+                           if lease.group == group),
+                          key=lambda le: (le.namespace, le.pod))
+
+    def groups(self) -> dict[str, list[Lease]]:
+        """{group id: member leases} across the table (the /slicez
+        view's source of truth — membership IS the lease table, so a
+        detached member leaves its group with no bookkeeping to desync)."""
+        with self._lock:
+            out: dict[str, list[Lease]] = {}
+            for lease in self._leases.values():
+                if lease.group:
+                    out.setdefault(lease.group, []).append(lease)
+        for members in out.values():
+            members.sort(key=lambda le: (le.namespace, le.pod))
+        return out
 
     def usage(self) -> dict[str, int]:
         """Live chips per tenant — the quantity quotas are checked

@@ -19,6 +19,7 @@ pure orchestration, so node accounting stays exact on every host.
 from __future__ import annotations
 
 import concurrent.futures
+import contextvars
 import dataclasses
 import time
 import uuid as uuid_mod
@@ -60,25 +61,40 @@ class PodResult:
 
 
 class SliceCoordinator:
-    """Runs slice transactions through a MasterGateway's worker plumbing."""
+    """Runs slice transactions through a MasterGateway's worker plumbing.
 
-    def __init__(self, gateway, max_parallel: int = 16):
+    ``on_host_done(PodResult)`` fires from the fan-out thread the moment
+    one host's attach resolves — the crash-safe transaction layer
+    (master/slicetxn.py) persists per-host commit markers there, so a
+    master death mid-fan-out leaves a record naming exactly the hosts
+    that hold chips. ``before_host_attach(namespace, pod)`` is a test
+    seam (chaos crash points between hosts)."""
+
+    def __init__(self, gateway, max_parallel: int = 16,
+                 on_host_done=None, before_host_attach=None):
         self.gateway = gateway
         self.max_parallel = max_parallel
+        self.on_host_done = on_host_done
+        self.before_host_attach = before_host_attach
 
     # -- attach ----------------------------------------------------------------
 
     def attach(self, pods: list[tuple[str, str]],
                tpus_per_host: int,
-               request_id: str | None = None
+               request_id: str | None = None,
+               txn_id: str | None = None,
+               validate: bool = True,
+               strict: bool = False,
+               rollback: bool = True
                ) -> tuple[bool, list[PodResult], bool]:
         """Entire-mount ``tpus_per_host`` chips to every (namespace, pod).
         Returns (ok, per-pod results, rollback_clean).
 
         The whole transaction carries a txn id that workers stamp on the
-        slave pods they create. On any failure, EVERY pod gets a
-        txn-targeted detach — this is exactly right regardless of what we
-        observed per pod:
+        slave pods they create (callers running the crash-safe protocol
+        supply their own so recovery can target it). On any failure —
+        with ``rollback=True`` — EVERY pod gets a txn-targeted detach;
+        this is exactly right regardless of what we observed per pod:
 
         - attach succeeded (reply seen or lost in transit): its slave pods
           carry the txn label and are removed; chips from other
@@ -87,20 +103,26 @@ class SliceCoordinator:
           down): no pod carries the txn label, the detach returns
           TPU_NOT_FOUND, which counts as clean.
 
-        ``rollback_clean`` is False only if a rollback detach itself failed
-        (chips may be leaked; the per-pod results say where to look).
+        ``rollback=False`` leaves successful hosts attached (the slice
+        txn manager owns resolution: gang waiters keep them as
+        incremental reservations). ``rollback_clean`` is False only if a
+        rollback detach itself failed (chips may be leaked; the per-pod
+        results say where to look).
 
         Raises :class:`TopologyError` before any fan-out when the target
         hosts cannot form one valid slice (mixed accelerator/topology,
-        two pods sharing a host, or a per-host chip count that isn't the
-        hosts' whole-host size).
+        two pods sharing a host, a per-host chip count that isn't the
+        hosts' whole-host size, or — under ``strict`` — a pod set that
+        does not span the advertised topology's full host count).
         """
         trace = Trace("slice_attach", request_id or "-")
         result_name = "EXCEPTION"
         try:
-            with trace.span("validate"):
-                self.validate_slice_topology(pods, tpus_per_host)
-            txn_id = "txn-" + uuid_mod.uuid4().hex[:12]
+            if validate:
+                with trace.span("validate"):
+                    self.validate_slice_topology(pods, tpus_per_host,
+                                                 strict=strict)
+            txn_id = txn_id or ("txn-" + uuid_mod.uuid4().hex[:12])
             with trace.span("fanout"):
                 results = self._fan_out(
                     pods,
@@ -108,23 +130,13 @@ class SliceCoordinator:
                         ns, name, tpus_per_host, request_id, txn_id))
             ok = all(r.result == "SUCCESS" for r in results)
             rollback_clean = True
-            if not ok:
+            if not ok and rollback:
                 logger.warning(
                     "slice %s attach failed; rolling back %d hosts",
                     txn_id, len(pods))
                 with trace.span("rollback"):
-                    rollback = self._fan_out(
-                        pods,
-                        lambda ns, name: self._detach_one(
-                            ns, name, force=True, txn_id=txn_id,
-                            request_id=request_id))
-                for r in rollback:
-                    if r.result not in ("SUCCESS", "TPU_NOT_FOUND",
-                                        "POD_NOT_FOUND"):
-                        rollback_clean = False
-                        logger.error(
-                            "slice rollback left %s/%s attached: %s",
-                            r.namespace, r.pod, r.message)
+                    rollback_clean, _ = self.rollback(pods, txn_id,
+                                                      request_id)
             slowest = max(results, key=lambda r: r.elapsed_ms, default=None)
             if slowest is not None and slowest.elapsed_ms:
                 logger.info("slice %s straggler: %s/%s at %.1fms", txn_id,
@@ -141,9 +153,33 @@ class SliceCoordinator:
             trace.finish(result_name, REGISTRY.attach_phase)
         return ok, results, rollback_clean
 
+    def rollback(self, pods: list[tuple[str, str]], txn_id: str,
+                 request_id: str | None = None
+                 ) -> tuple[bool, list[PodResult]]:
+        """Txn-targeted detach of every pod — the abort direction of a
+        slice transaction, also run standalone by the txn manager (gang
+        hand-backs, adopted-transaction aborts). Returns (clean, per-pod
+        results); hosts the txn never touched answer TPU_NOT_FOUND,
+        which counts as clean."""
+        results = self._fan_out(
+            pods,
+            lambda ns, name: self._detach_one(
+                ns, name, force=True, txn_id=txn_id,
+                request_id=request_id))
+        clean = True
+        for r in results:
+            if r.result not in ("SUCCESS", "TPU_NOT_FOUND",
+                                "POD_NOT_FOUND"):
+                clean = False
+                logger.error("slice rollback left %s/%s attached: %s",
+                             r.namespace, r.pod, r.message)
+        return clean, results
+
     def _attach_one(self, namespace: str, pod: str, tpu_num: int,
                     request_id: str | None = None,
                     txn_id: str = "") -> PodResult:
+        if self.before_host_attach is not None:
+            self.before_host_attach(namespace, pod)
         t0 = time.monotonic()
         try:
             resp = self.gateway._call_worker(
@@ -157,17 +193,31 @@ class SliceCoordinator:
             out = PodResult(namespace, pod, "ERROR", message=str(e))
         out.elapsed_ms = (time.monotonic() - t0) * 1e3
         REGISTRY.attach_results.inc(result=f"slice_{out.result}")
+        # per-host latency: the straggler that sets the slice's wall time
+        # was previously only a log line; the exemplar names the request
+        REGISTRY.slice_host_attach.observe(
+            out.elapsed_ms / 1e3,
+            exemplar={"rid": request_id or txn_id,
+                      "pod": f"{namespace}/{pod}"})
+        if self.on_host_done is not None:
+            self.on_host_done(out)
         return out
 
     # -- slice topology validation (SURVEY.md §7 hard part 5) ------------------
 
     def validate_slice_topology(self, pods: list[tuple[str, str]],
-                                tpus_per_host: int) -> None:
+                                tpus_per_host: int,
+                                strict: bool = False) -> None:
         """All target hosts must advertise ONE slice topology for the
         attached chips to form a usable multi-host ICI mesh. Pods/nodes
         that cannot be resolved are left for the per-pod attach to report
         precisely; pods on label-less nodes (test/non-GKE) are
-        unconstrained. Raises :class:`TopologyError` on any violation."""
+        unconstrained. Raises :class:`TopologyError` on any violation.
+
+        ``strict``: a pod set that does not span the advertised
+        topology's full host count (a PARTIAL mesh — valid chips, but
+        not the slice the nodepool was built for) is an error instead of
+        a log warning. Body ``"strict": true`` on the slice routes."""
         node_of: dict[tuple[str, str], str] = {}
         topos: dict[str, topology.NodeTopology] = {}
         for ns, name in pods:
@@ -223,6 +273,11 @@ class SliceCoordinator:
                     f"{tpus_per_host}")
         topo = next(iter(topos.values()))
         if topo.multi_host and len(pods) != topo.num_hosts:
+            if strict:
+                raise TopologyError(
+                    f"slice attach targets {len(pods)} pods but topology "
+                    f"{topo.topology} spans {topo.num_hosts} hosts — the "
+                    "resulting mesh would be partial (strict mode)")
             logger.warning(
                 "slice attach targets %d pods but topology %s spans %d "
                 "hosts — the resulting mesh will be partial",
@@ -231,11 +286,11 @@ class SliceCoordinator:
     # -- detach ----------------------------------------------------------------
 
     def detach(self, pods: list[tuple[str, str]], force: bool = False,
-               request_id: str | None = None
-               ) -> tuple[bool, list[PodResult]]:
+               request_id: str | None = None,
+               cause: str = "") -> tuple[bool, list[PodResult]]:
         results = self._fan_out(
             pods, lambda ns, name: self._detach_one(
-                ns, name, force, request_id=request_id))
+                ns, name, force, request_id=request_id, cause=cause))
         # TPU_NOT_FOUND counts as done: retrying a completed detach must
         # converge to success, not 409 forever.
         ok = all(r.result in ("SUCCESS", "TPU_NOT_FOUND") for r in results)
@@ -244,14 +299,14 @@ class SliceCoordinator:
     def _detach_one(self, namespace: str, pod: str, force: bool,
                     uuids: list[str] | None = None,
                     request_id: str | None = None,
-                    txn_id: str = "") -> PodResult:
+                    txn_id: str = "", cause: str = "") -> PodResult:
         t0 = time.monotonic()
         try:
             resp = self.gateway._call_worker(
                 namespace, pod,
                 lambda w: w.remove_tpu(pod, namespace, uuids or [], force,
                                        request_id=request_id,
-                                       txn_id=txn_id))
+                                       txn_id=txn_id, cause=cause))
             result = consts.RemoveResult(resp.result)
             out = PodResult(namespace, pod, result.name)
         except Exception as e:
@@ -263,6 +318,15 @@ class SliceCoordinator:
     # -- plumbing --------------------------------------------------------------
 
     def _fan_out(self, pods: list[tuple[str, str]], fn) -> list[PodResult]:
+        # Each host runs under a COPY of the caller's contextvars
+        # context: the per-host resolve/dial/rpc spans then attach under
+        # the slice trace's fanout span (span objects are shared across
+        # the copies; child appends are GIL-atomic) instead of
+        # vanishing into the executor threads' empty contexts — without
+        # this, slice traces have no children and the waterfall (and
+        # doctor's dominant-span line) can't say which host was slow.
+        parent = contextvars.copy_context()
         with concurrent.futures.ThreadPoolExecutor(
                 max_workers=min(self.max_parallel, max(1, len(pods)))) as ex:
-            return list(ex.map(lambda p: fn(p[0], p[1]), pods))
+            return list(ex.map(
+                lambda p: parent.copy().run(fn, p[0], p[1]), pods))

@@ -1,0 +1,822 @@
+"""Crash-safe slice transactions, gang admission, slice-group leases.
+
+PR 8 left three seams in the multi-host story this module closes:
+
+1. **Crash safety.** ``master/slice.py`` fans out per-host attaches with
+   only an in-memory best-effort rollback — a master SIGKILL mid-fan-out
+   leaked a half-attached slice no surviving replica knew about. Every
+   slice attach now writes a transaction intent record
+   (:class:`~gpumounter_tpu.master.store.SliceTxnRecord`: txn id, member
+   pods, chips per host, tenant, deadline) to the per-shard intent store
+   BEFORE any host is touched, appends a per-host commit marker as each
+   host lands, and deletes the record only at terminal commit/abort. A
+   record found at rehydration is therefore exactly a transaction its
+   writer never resolved: the adopting leader re-runs the fan-out under
+   the ORIGINAL request id while the deadline holds (worker per-rid
+   idempotency turns re-runs of landed hosts into adoptions — zero
+   double-actuation) or rolls every member back through the existing
+   txn-targeted detach once it has passed. Zero half-attached slices,
+   provable against the cross-replica store view
+   (``testing/chaos.assert_broker_invariants``).
+
+2. **Gang admission.** "Slices never queue" was PR 5's simplification: a
+   slice over capacity failed fast even with the contention queue on.
+   With ``TPU_QUEUE_TIMEOUT_S`` > 0 an insufficient slice now parks as a
+   **gang waiter** that reserves per-node capacity incrementally — hosts
+   that attach stay attached (they ARE the reservation; the txn record's
+   commit markers persist them) while the gang waits for the rest.
+   Reservations carry a hold deadline (``TPU_GANG_HOLD_S``): a gang that
+   cannot complete hands its hosts back and keeps waiting, so two gangs
+   competing for overlapping nodes cannot deadlock — one of them always
+   releases, and the priority-then-weighted-fair wakeup hands the freed
+   capacity to exactly one waiter. Timing out returns the familiar 503
+   with ``queued_s``.
+
+3. **Slice-group leases + live reshaping.** A committed slice records
+   one lease per member pod, all stamped with the slice's ``group`` id —
+   and the broker treats the group as ONE lease: renewing any member
+   renews all, expiry detaches the whole slice, preemption takes the
+   whole slice (a half-expired slice is useless to the JAX world
+   spanning it). ``POST /slice/resize`` computes the host delta against
+   the group's current membership, runs the grow half as a slice txn and
+   the shrink half through the normal detach path, and bumps the
+   slice's **mesh generation** (an annotation on every member pod plus
+   the /slicez view) only once the new chip set is fully actuated — the
+   signal ``jaxcheck/elastic.py`` polls to drain → reinit → restore
+   resharded. See docs/guide/Elasticity.md.
+
+All of it is off by default: without the intent store there are no txn
+records (zero ConfigMap traffic), without a queue timeout gangs never
+park, without a lease TTL groups never expire — exactly PR 8 semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid as uuid_mod
+
+from gpumounter_tpu.master.slice import PodResult, SliceCoordinator
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.errors import (QueueFullError, StoreFencedError,
+                                         TopologyError)
+from gpumounter_tpu.utils.events import EVENTS
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+from gpumounter_tpu.utils.trace import Trace
+
+logger = get_logger("master.slicetxn")
+
+# Per-pod results that mean "the host holds no chips from this txn" —
+# the rollback direction's success vocabulary (slice.py rollback()).
+_GONE = ("SUCCESS", "TPU_NOT_FOUND", "POD_NOT_FOUND")
+
+
+def _pod_key(namespace: str, pod: str) -> str:
+    return f"{namespace}/{pod}"
+
+
+class _LiveTxn:
+    """One in-flight transaction, as this replica drives it."""
+
+    __slots__ = ("record", "started", "state", "adopted")
+
+    def __init__(self, record, adopted: bool = False):
+        self.record = record
+        self.started = time.monotonic()
+        self.state = "fanout"            # "fanout" | "parked"
+        self.adopted = adopted
+
+
+class SliceTxnManager:
+    """Owns every slice transaction a gateway runs (attach, resize,
+    adoption, group detach). One per gateway; the broker binds it
+    (``bind_slice``) for group-lease expiry/preemption and failover
+    adoption."""
+
+    def __init__(self, gateway):
+        self.gateway = gateway
+        self.broker = gateway.broker
+        self._lock = threading.Lock()
+        self._txns: dict[str, _LiveTxn] = {}
+        # txn ids an adoption thread currently drives (pre-registration:
+        # the window between "decided to adopt" and "txn registered")
+        self._adopting: set[str] = set()
+        # group id -> {"generation", "tpus_per_host"} — the mesh
+        # generation the resize route bumps; membership itself lives in
+        # the lease table (a detached member leaves its group with no
+        # bookkeeping to desync)
+        self._groups: dict[str, dict] = {}
+        # test seam: chaos crash points between hosts of one fan-out
+        self.before_host_attach = None
+
+    # -- plumbing --------------------------------------------------------------
+
+    def _coordinator(self, txn: _LiveTxn | None = None) -> SliceCoordinator:
+        on_host_done = self._marker_callback(txn) if txn is not None \
+            else None
+        return SliceCoordinator(self.gateway, on_host_done=on_host_done,
+                                before_host_attach=self.before_host_attach)
+
+    def _marker_callback(self, txn: _LiveTxn):
+        def mark(result: PodResult) -> None:
+            if result.result != "SUCCESS":
+                return
+            key = _pod_key(result.namespace, result.pod)
+            with self._lock:
+                if key not in txn.record.committed:
+                    txn.record.committed.append(key)
+            # the marker is the crash-recovery breadcrumb: persisted the
+            # moment the host lands, from the fan-out thread itself
+            self._persist_txn(txn.record)
+        return mark
+
+    def _persist_txn(self, record) -> None:
+        store = self.broker.store
+        if store is None:
+            return
+        try:
+            store.put_slice_txn(record)
+        except StoreFencedError as e:
+            self.broker._on_fenced(e)
+
+    def _unpersist_txn(self, record) -> None:
+        store = self.broker.store
+        if store is None:
+            return
+        try:
+            store.delete_slice_txn(record.namespace, record.txn_id)
+        except StoreFencedError as e:
+            self.broker._on_fenced(e)
+
+    def _rollback(self, pods, txn_id: str, rid: str):
+        """Txn-targeted rollback with its own trace: the span feeds
+        phase="rollback" into the shared attach_phase family, so the
+        TPUMounterRollbacks alert keeps seeing multi-host rollbacks now
+        that they run outside the attach fan-out's trace."""
+        trace = Trace("slice_rollback", rid or "-")
+        result = "EXCEPTION"
+        try:
+            with trace.span("rollback"):
+                clean, results = self._coordinator().rollback(
+                    pods, txn_id, rid)
+            result = "CLEAN" if clean else "PARTIAL"
+        finally:
+            trace.finish(result, REGISTRY.attach_phase)
+        return clean, results
+
+    def _register(self, txn: _LiveTxn) -> None:
+        with self._lock:
+            self._txns[txn.record.txn_id] = txn
+        self.export_gauges()
+
+    def _unregister(self, txn: _LiveTxn) -> None:
+        with self._lock:
+            self._txns.pop(txn.record.txn_id, None)
+        self.export_gauges()
+
+    # -- attach (the crash-safe transaction) -----------------------------------
+
+    def attach(self, pods: list[tuple[str, str]], tpus_per_host: int, *,
+               tenant: str, priority: str, rid: str,
+               strict: bool = False, txn_id: str | None = None,
+               lease_group: str | None = None,
+               timeout_s: float | None = None,
+               adopted: bool = False,
+               committed: list[str] | None = None) -> tuple[int, dict]:
+        """The whole slice attach: admission (reservation-scoped for the
+        full chip count), intent record, fan-out with per-host commit
+        markers, gang parking on contention, terminal commit/abort.
+        Raises :class:`TopologyError` pre-fan-out (→ 412) and the
+        broker's admission errors (→ 429). ``timeout_s`` overrides the
+        configured queue deadline (adopted transactions park for their
+        REMAINING time)."""
+        from gpumounter_tpu.master.store import SliceTxnRecord
+        total = tpus_per_host * len(pods)
+        txn_id = txn_id or ("txn-" + uuid_mod.uuid4().hex[:12])
+        lease_group = lease_group or txn_id
+        timeout = (self.broker.config.queue_timeout_s
+                   if timeout_s is None else timeout_s)
+        with self.broker.admission(tenant, total, rid):
+            record = SliceTxnRecord(
+                txn_id=txn_id, rid=rid, tenant=tenant, priority=priority,
+                pods=[_pod_key(ns, pod) for ns, pod in pods],
+                tpus_per_host=tpus_per_host,
+                committed=list(committed or []),
+                created_unix=round(time.time(), 3),
+                deadline_unix=round(time.time() + max(timeout, 0.0), 3),
+                group="" if lease_group == txn_id else lease_group)
+            txn = _LiveTxn(record, adopted=adopted)
+            self._register(txn)
+            # intent BEFORE fan-out: a crash from here on leaves a record
+            # a surviving leader resolves — never a silent half-slice
+            self._persist_txn(record)
+            try:
+                return self._run(txn, pods, tpus_per_host, tenant,
+                                 priority, rid, timeout, lease_group,
+                                 strict=strict)
+            except TopologyError:
+                # pre-fan-out rejection (validation runs inside the
+                # first fan-out's trace): no host was touched — the
+                # intent record must not outlive the refusal
+                self._unpersist_txn(record)
+                raise
+            finally:
+                self._unregister(txn)
+
+    def _run(self, txn: _LiveTxn, pods, tpus_per_host, tenant, priority,
+             rid, timeout, lease_group,
+             strict: bool = False) -> tuple[int, dict]:
+        coordinator = self._coordinator(txn)
+        config = self.broker.config
+        deadline = time.monotonic() + max(timeout, 0.0)
+        attached: dict[str, PodResult] = {}
+        waiter = None
+        hold_deadline: float | None = None
+        enqueued_at: float | None = None
+        # validate inside the FIRST fan-out's trace only (adopted re-runs
+        # passed validation when the original request arrived; the
+        # cluster may have drifted, but the per-host attach then reports
+        # precisely) — a TopologyError propagates before any host RPC
+        first = not txn.adopted
+        try:
+            while True:
+                # capacity generation BEFORE the attempt: a signal that
+                # fires during the fan-out must not be lost if we park
+                gen_before = self.broker.current_gen()
+                missing = [(ns, pod) for ns, pod in pods
+                           if _pod_key(ns, pod) not in attached]
+                _, results, _ = coordinator.attach(
+                    missing, tpus_per_host, request_id=rid,
+                    txn_id=txn.record.txn_id, validate=first,
+                    strict=strict, rollback=False)
+                first = False
+                for result in results:
+                    if result.result == "SUCCESS":
+                        attached[_pod_key(result.namespace,
+                                          result.pod)] = result
+                failures = [r for r in results if r.result != "SUCCESS"]
+                if not failures:
+                    return self._commit(txn, pods, attached, tenant,
+                                        priority, rid, tpus_per_host,
+                                        lease_group, waiter, enqueued_at)
+                hard = [r for r in failures
+                        if r.result != "INSUFFICIENT_TPU"]
+                if hard or timeout <= 0:
+                    # a host that can never join (pod gone, policy
+                    # denial, worker down) — or gang queueing disabled:
+                    # fail fast, exactly the pre-gang behavior
+                    return self._abort(txn, pods, attached, failures,
+                                       tenant, rid, waiter, enqueued_at)
+                # every failure is InsufficientTPU and queueing is on:
+                # park as a gang — successes stay attached as the
+                # incremental reservation, protected by a hold deadline
+                if waiter is None:
+                    try:
+                        waiter = self.broker.park_gang(
+                            tenant=tenant, priority=priority,
+                            chips=tpus_per_host * len(pods), rid=rid,
+                            namespace=pods[0][0],
+                            label=f"slice:{txn.record.txn_id}",
+                            timeout_s=max(deadline - time.monotonic(),
+                                          0.0),
+                            gen0=gen_before)
+                    except QueueFullError:
+                        # the queue refused the gang: resolve the txn
+                        # NOW (rollback any landed hosts, delete the
+                        # record) before the 429 reaches the client —
+                        # reserved chips must not outlive the refusal
+                        self._abort(txn, pods, attached, failures,
+                                    tenant, rid, None, None)
+                        raise
+                    enqueued_at = time.monotonic()
+                    txn.state = "parked"
+                    EVENTS.emit("gang_enqueue", rid=rid, tenant=tenant,
+                                txn=txn.record.txn_id, hosts=len(pods),
+                                held=len(attached), priority=priority)
+                    logger.info(
+                        "[rid=%s] slice %s parked as gang: %d/%d hosts "
+                        "reserved", rid, txn.record.txn_id, len(attached),
+                        len(pods))
+                else:
+                    # still contended after a wakeup: hand the baton on
+                    self.broker.gang_baton(waiter)
+                if attached and hold_deadline is None:
+                    hold_deadline = time.monotonic() + config.gang_hold_s
+                if not attached:
+                    hold_deadline = None
+                while True:
+                    if waiter.priority == "high":
+                        self.broker.try_preempt_for(waiter)
+                    now = time.monotonic()
+                    if now >= deadline:
+                        waited = now - (enqueued_at or now)
+                        REGISTRY.queue_wait.observe(waited, tenant=tenant)
+                        REGISTRY.admission_decisions.inc(
+                            tenant=tenant, outcome="queue_timeout")
+                        EVENTS.emit("queue_timeout", rid=rid,
+                                    tenant=tenant, gang=True,
+                                    waited_s=round(waited, 3))
+                        status, payload = self._abort(
+                            txn, pods, attached, failures, tenant, rid,
+                            waiter, enqueued_at, timed_out=True)
+                        payload["queued_s"] = round(waited, 3)
+                        payload["queue_timeout"] = True
+                        payload["retry_after_s"] = round(
+                            self.broker._capacity_hint(), 1)
+                        return status, payload
+                    if hold_deadline is not None and now >= hold_deadline:
+                        # anti-deadlock hand-back: return the partial
+                        # reservation so a competing gang can complete;
+                        # keep waiting for our own deadline
+                        self._hand_back(txn, attached, rid)
+                        attached.clear()
+                        hold_deadline = None
+                    wait_for = deadline - now
+                    if hold_deadline is not None:
+                        wait_for = min(wait_for, hold_deadline - now)
+                    if waiter.event.wait(max(wait_for, 0.01)):
+                        waiter.event.clear()
+                        if waiter.outcome == "moved":
+                            # shard hand-off mid-wait: the record (and
+                            # any reserved hosts) now belong to the new
+                            # leader's adoption — resolve NOTHING here
+                            EVENTS.emit("queue_moved", rid=rid,
+                                        tenant=tenant, gang=True)
+                            return 503, {
+                                "result": "ShardMoved",
+                                "message": "admission shard moved to "
+                                           "another replica mid-gang; "
+                                           "retry",
+                                "retry_after_s": 1.0}
+                        break           # capacity signal: retry missing
+        finally:
+            if waiter is not None:
+                self.broker.unpark_gang(waiter)
+
+    def _hand_back(self, txn: _LiveTxn, attached: dict, rid: str) -> None:
+        pods = [tuple(key.split("/", 1)) for key in attached]
+        logger.info("[rid=%s] gang hold deadline passed: handing back "
+                    "%d reserved host(s)", rid, len(pods))
+        clean, _ = self._rollback(pods, txn.record.txn_id, rid)
+        with self._lock:
+            txn.record.committed = [] if clean else list(
+                txn.record.committed)
+        if clean:
+            self._persist_txn(txn.record)
+        REGISTRY.slice_txns.inc(outcome="handback")
+        EVENTS.emit("gang_handback", rid=rid, txn=txn.record.txn_id,
+                    hosts=len(pods), clean=clean)
+        # the freed chips are what some OTHER waiter is sleeping on
+        self.broker.signal_capacity()
+        self.broker.poke_peers()
+
+    def _commit(self, txn: _LiveTxn, pods, attached, tenant, priority,
+                rid, tpus_per_host, lease_group, waiter,
+                enqueued_at) -> tuple[int, dict]:
+        for result in attached.values():
+            self.broker.leases.record(
+                result.namespace, result.pod, tenant, priority,
+                list(result.device_ids), chips=len(result.device_ids),
+                rid=rid, ttl_s=self.broker.config.lease_ttl_s,
+                group=lease_group)
+        if lease_group != txn.record.txn_id or txn.adopted:
+            # the group may predate this process (resize delta, adopted
+            # txn after failover): recover its generation from the
+            # member annotations before touching the registry
+            self._ensure_group_info(
+                lease_group, self.broker.leases.group_leases(lease_group))
+        with self._lock:
+            group = self._groups.setdefault(
+                lease_group, {"generation": 1,
+                              "tpus_per_host": tpus_per_host})
+            group["tpus_per_host"] = tpus_per_host
+        self._unpersist_txn(txn.record)
+        outcome = "adopted_commit" if txn.adopted else "commit"
+        REGISTRY.slice_txns.inc(outcome=outcome)
+        EVENTS.emit("slice_commit", rid=rid, txn=txn.record.txn_id,
+                    tenant=tenant, hosts=len(pods),
+                    chips=tpus_per_host * len(pods),
+                    group=lease_group, adopted=txn.adopted)
+        payload: dict = {
+            "result": "SUCCESS",
+            "rolled_back": False,
+            "tenant": tenant,
+            "group": lease_group,
+            "pods": [attached[_pod_key(ns, pod)].to_json()
+                     for ns, pod in pods],
+        }
+        if waiter is not None and enqueued_at is not None:
+            waited = time.monotonic() - enqueued_at
+            REGISTRY.queue_wait.observe(waited, tenant=tenant)
+            REGISTRY.admission_decisions.inc(tenant=tenant,
+                                             outcome="granted_queued")
+            EVENTS.emit("queue_granted", rid=rid, tenant=tenant,
+                        gang=True, waited_s=round(waited, 3))
+            payload["queued_s"] = round(waited, 3)
+        self.broker.signal_capacity()
+        return 200, payload
+
+    def _abort(self, txn: _LiveTxn, pods, attached, failures, tenant,
+               rid, waiter, enqueued_at,
+               timed_out: bool = False) -> tuple[int, dict]:
+        clean, _ = self._rollback(pods, txn.record.txn_id, rid)
+        if clean:
+            self._unpersist_txn(txn.record)
+        else:
+            # an unclean rollback IS a stranded condition: keep the
+            # record so the tick (or a failed-over peer) re-aborts it —
+            # doctor CRITs on it meanwhile
+            self._persist_txn(txn.record)
+        outcome = "adopted_abort" if txn.adopted else "abort"
+        REGISTRY.slice_txns.inc(outcome=outcome)
+        EVENTS.emit("slice_abort", rid=rid, txn=txn.record.txn_id,
+                    tenant=tenant, hosts=len(pods),
+                    rolled_back=clean, timed_out=timed_out,
+                    adopted=txn.adopted)
+        if attached or any(r.result != "INSUFFICIENT_TPU"
+                           for r in failures):
+            self.broker.signal_capacity()
+            self.broker.poke_peers()
+        by_key = {_pod_key(r.namespace, r.pod): r for r in failures}
+        by_key.update(attached)
+        results = [by_key.get(_pod_key(ns, pod),
+                              PodResult(ns, pod, "INSUFFICIENT_TPU"))
+                   for ns, pod in pods]
+        return 503, {
+            "result": "SliceAttachFailed",
+            "rolled_back": clean,
+            "tenant": tenant,
+            "pods": [r.to_json() for r in results],
+        }
+
+    # -- failover adoption -----------------------------------------------------
+
+    def adopt(self, records) -> int:
+        """Resolve slice txn records a dead (or deposed) leader left
+        behind: complete the fan-out under the original rid while the
+        deadline holds, roll back once it has passed. Each record runs in
+        its own thread — adoption must not block the election callback."""
+        adopted = 0
+        for record in records:
+            with self._lock:
+                if record.txn_id in self._txns \
+                        or record.txn_id in self._adopting:
+                    continue
+                self._adopting.add(record.txn_id)
+            adopted += 1
+            threading.Thread(
+                target=self._run_adopted, args=(record,), daemon=True,
+                name=f"tpumounter-slice-adopt-{record.txn_id}").start()
+        return adopted
+
+    def _run_adopted(self, record) -> None:
+        remaining = record.deadline_unix - time.time()
+        EVENTS.emit("slice_adopted", rid=record.rid, txn=record.txn_id,
+                    tenant=record.tenant, hosts=len(record.pods),
+                    committed=len(record.committed),
+                    remaining_s=round(max(0.0, remaining), 3))
+        try:
+            if remaining <= 0:
+                # its client's deadline passed while nobody owned the
+                # shard: abort — txn-targeted detach of EVERY member is
+                # exact whatever subset actually landed
+                clean, _ = self._rollback(record.members(),
+                                          record.txn_id, record.rid)
+                if clean:
+                    self._unpersist_txn(record)
+                REGISTRY.slice_txns.inc(outcome="adopted_abort")
+                EVENTS.emit("slice_abort", rid=record.rid,
+                            txn=record.txn_id, tenant=record.tenant,
+                            hosts=len(record.pods), rolled_back=clean,
+                            timed_out=True, adopted=True)
+                self.broker.signal_capacity()
+                return
+            status, payload = self.attach(
+                record.members(), record.tpus_per_host,
+                tenant=record.tenant, priority=record.priority,
+                rid=record.rid, txn_id=record.txn_id,
+                lease_group=record.group or record.txn_id,
+                timeout_s=remaining, adopted=True,
+                committed=record.committed)
+            logger.info("[rid=%s] adopted slice txn %s resolved: %s / %s",
+                        record.rid, record.txn_id, status,
+                        payload.get("result", "-"))
+        except Exception as e:     # noqa: BLE001 — a dead adoption
+            # thread would strand the record; the tick re-adopts it
+            logger.warning("[rid=%s] adopted slice txn %s failed: %s",
+                           record.rid, record.txn_id, e)
+        finally:
+            with self._lock:
+                self._adopting.discard(record.txn_id)
+
+    # -- group detach (expiry / preemption / resize shrink) --------------------
+
+    def _ensure_group_info(self, group: str, members) -> dict:
+        """The group's registry entry, recovering the mesh generation
+        from the member pods' ``tpumounter.io/mesh-generation``
+        annotations when this process has none (restart/failover — the
+        annotation is the persisted half of the signal; max across
+        members survives a partial patch). Cached after the first
+        recovery, so the apiserver cost is one GET per member per group
+        per process lifetime."""
+        with self._lock:
+            info = self._groups.get(group)
+        if info is not None:
+            return dict(info)
+        generation = 1
+        chips = None
+        for lease in members:
+            chips = chips or lease.chips or None
+            try:
+                pod = self.gateway.kube.get_pod(lease.namespace,
+                                                lease.pod)
+            except Exception:  # noqa: BLE001 — best-effort recovery
+                continue
+            raw = (pod.get("metadata", {}).get("annotations") or {}).get(
+                consts.MESH_GENERATION_ANNOTATION)
+            try:
+                generation = max(generation, int(raw))
+            except (TypeError, ValueError):
+                continue
+        with self._lock:
+            info = self._groups.setdefault(
+                group, {"generation": generation,
+                        "tpus_per_host": chips})
+        return dict(info)
+
+    def detach_members(self, pods: list[tuple[str, str]], cause: str,
+                       force: bool = False,
+                       rid: str | None = None
+                       ) -> tuple[bool, list[PodResult]]:
+        """Detach every member pod through the coordinator's normal
+        per-host path (traced, breaker-guarded, journaled worker-side)
+        with the cause stamped into each worker's audit trail."""
+        coordinator = self._coordinator()
+        return coordinator.detach(pods, force=force, request_id=rid,
+                                  cause=cause)
+
+    # -- live mesh reshaping (POST /slice/resize) ------------------------------
+
+    def resize(self, target: list[tuple[str, str]],
+               tpus_per_host: int | None, *,
+               rid: str, tenant: str | None = None,
+               priority: str | None = None, group: str | None = None,
+               strict: bool = False,
+               force: bool = False) -> tuple[int, dict]:
+        """Reshape a live slice to exactly ``target`` membership: attach
+        the delta hosts as a crash-safe slice txn joining the existing
+        group, detach the removed hosts through the normal path, and
+        bump the mesh generation only when the new chip set is fully
+        actuated. The group is found from any target pod's lease (or
+        named explicitly)."""
+        t0 = time.monotonic()
+        groups = self.broker.leases.groups()
+        if group is None:
+            hit = {lease.group
+                   for members in groups.values() for lease in members
+                   if (lease.namespace, lease.pod) in target}
+            if len(hit) > 1:
+                return 400, {
+                    "result": "BadRequest",
+                    "message": f"target pods span {len(hit)} slice "
+                               f"groups {sorted(hit)}: resize one slice "
+                               "at a time (or name ?group= explicitly)"}
+            group = next(iter(hit), None)
+        members = groups.get(group or "", [])
+        if not group or not members:
+            return 404, {
+                "result": "SliceNotFound",
+                "message": "no slice-group lease covers the target pods "
+                           "— attach the slice first (/addtpuslice)"}
+        current = [(lease.namespace, lease.pod) for lease in members]
+        tenant = tenant or members[0].tenant
+        priority = priority or members[0].priority
+        info = self._ensure_group_info(group, members)
+        if tpus_per_host is None:
+            # inherit the group's recorded per-host size; a re-derived
+            # group (master restart) falls back to a member's chip count
+            tpus_per_host = (info.get("tpus_per_host")
+                             or members[0].chips or 4)
+        delta_add = [p for p in target if p not in current]
+        delta_remove = [p for p in current if p not in target]
+        if not delta_add and not delta_remove:
+            # idempotent re-post of the current membership: nothing to
+            # actuate, and the generation must NOT move — a bump would
+            # send every elastic job through a drain/restore for nothing
+            return 200, {
+                "result": "SUCCESS", "group": group,
+                "generation": info["generation"], "tenant": tenant,
+                "hosts": len(target), "added": [], "removed": [],
+                "unchanged": True}
+        if strict:
+            # strict judges the RESULTING mesh — the full target set,
+            # not the grow delta (a 2-host delta of a 4-host topology is
+            # partial by construction; the 4-host target is not)
+            self._coordinator().validate_slice_topology(
+                target, tpus_per_host, strict=True)
+        added: list[PodResult] = []
+        if delta_add:
+            # strict already judged the full target above; the delta
+            # txn's own validation stays non-strict (subset ≠ the mesh)
+            status, payload = self.attach(
+                delta_add, tpus_per_host, tenant=tenant,
+                priority=priority, rid=rid, lease_group=group)
+            if status != 200:
+                # the delta txn rolled itself back: the slice is exactly
+                # what it was, and the generation does not move
+                payload.setdefault("result", "SliceResizeFailed")
+                payload["group"] = group
+                return status, payload
+            added = payload.get("pods", [])
+        removed: list[dict] = []
+        if delta_remove:
+            ok, results = self.detach_members(
+                delta_remove, cause=f"slice-resize:{rid}", force=force,
+                rid=rid)
+            for result in results:
+                if result.result in _GONE:
+                    self.broker.release(result.namespace, result.pod)
+            removed = [r.to_json() for r in results]
+            if not ok:
+                # shrink half incomplete (busy devices): the old chips
+                # are still actuated, so the NEW chip set is not — the
+                # generation must not claim it is
+                return 409, {
+                    "result": "SliceResizeIncomplete",
+                    "message": "some hosts refused detach (busy "
+                               "devices?); resize again or force",
+                    "group": group,
+                    "added": added, "removed": removed}
+        generation = self._bump_generation(group, target, tpus_per_host,
+                                           rid)
+        REGISTRY.slice_resize.observe(time.monotonic() - t0,
+                                      exemplar={"rid": rid})
+        EVENTS.emit("slice_resize", rid=rid, group=group, tenant=tenant,
+                    hosts=len(target), added=len(delta_add),
+                    removed=len(delta_remove), generation=generation)
+        return 200, {
+            "result": "SUCCESS",
+            "group": group,
+            "generation": generation,
+            "tenant": tenant,
+            "hosts": len(target),
+            "added": added,
+            "removed": removed,
+        }
+
+    def _bump_generation(self, group: str, members, tpus_per_host: int,
+                         rid: str) -> int:
+        with self._lock:
+            info = self._groups.setdefault(
+                group, {"generation": 1, "tpus_per_host": tpus_per_host})
+            info["generation"] += 1
+            info["tpus_per_host"] = tpus_per_host
+            generation = info["generation"]
+        # the informer-path signal: every member pod's annotation moves
+        # only AFTER the new chip set is fully actuated, so an elastic
+        # job that drains on the bump never reshapes onto a half-slice
+        for namespace, pod in members:
+            try:
+                self.gateway.kube.patch_pod(
+                    namespace, pod,
+                    {"metadata": {"annotations": {
+                        consts.MESH_GENERATION_ANNOTATION:
+                            str(generation)}}})
+            except Exception as e:  # noqa: BLE001 — best-effort: /slicez
+                # still serves the generation, and the worker-side
+                # notification file is the other signal
+                logger.warning("[rid=%s] mesh-generation annotation on "
+                               "%s/%s failed: %s", rid, namespace, pod, e)
+        return generation
+
+    def generation(self, group: str) -> int:
+        with self._lock:
+            return (self._groups.get(group) or {}).get("generation", 1)
+
+    # -- maintenance (driven by the broker tick) -------------------------------
+
+    def tick(self) -> None:
+        """Adopt any stranded record the store's cached view shows that
+        nothing on this replica is driving (a deferred adoption, an
+        unclean abort), then refresh the gauges."""
+        store = self.broker.store
+        election = self.broker.election
+        if store is not None:
+            shards = (election.owned() if election is not None
+                      else range(store.ring.shards))
+            for shard in shards:
+                records = self._cached_records(store, shard)
+                stale = [r for r in records if not self._driving(r.txn_id)]
+                if stale:
+                    self.adopt(stale)
+        self.export_gauges()
+
+    def _driving(self, txn_id: str) -> bool:
+        with self._lock:
+            return txn_id in self._txns or txn_id in self._adopting
+
+    @staticmethod
+    def _cached_records(store, shard) -> list:
+        """Slice txn records from the store's OBSERVED annotations —
+        zero apiserver calls; the cache is refreshed by every CAS and by
+        the poke check, which is exactly the cadence stranded-record
+        detection needs."""
+        from gpumounter_tpu.master.store import SliceTxnRecord
+        lock = getattr(store, "_lock", None)
+        cache = getattr(store, "_observed", None)
+        if lock is None or cache is None:
+            return []           # store test doubles carry no cache
+        with lock:
+            observed = cache.get(shard)
+        if observed is None:
+            return []
+        _, annotations = observed
+        out = []
+        for key, value in annotations.items():
+            if not key.startswith(consts.STORE_SLICE_ANNOTATION_PREFIX):
+                continue
+            try:
+                out.append(SliceTxnRecord.from_json(value))
+            except (ValueError, TypeError):
+                continue            # torn: rehydrate counts these
+        return out
+
+    def export_gauges(self) -> None:
+        now = time.monotonic()
+        wall = time.time()
+        # prune generation entries for groups with no leases AND no
+        # in-flight txn — membership lives in the lease table, so a
+        # fully detached slice must not pin its registry entry forever
+        live = set(self.broker.leases.groups())
+        with self._lock:
+            in_flight = {txn.record.group or txn.record.txn_id
+                         for txn in self._txns.values()}
+            for group in list(self._groups):
+                if group not in live and group not in in_flight:
+                    del self._groups[group]
+            pending = len(self._txns)
+            oldest = min((txn.started for txn in self._txns.values()),
+                         default=None)
+        REGISTRY.slice_txns_pending.set(pending)
+        REGISTRY.slice_txn_oldest_age.set(
+            0.0 if oldest is None else round(now - oldest, 3))
+        # stranded = persisted records past their deadline that NOTHING
+        # drives (no live txn, no adoption thread) — the doctor CRIT
+        stranded = 0
+        store = self.broker.store
+        if store is not None:
+            election = self.broker.election
+            shards = (election.owned() if election is not None
+                      else range(store.ring.shards))
+            for shard in shards:
+                for record in self._cached_records(store, shard):
+                    if record.deadline_unix and \
+                            wall > record.deadline_unix \
+                            and not self._driving(record.txn_id):
+                        stranded += 1
+        REGISTRY.slice_txns_stranded.set(stranded)
+
+    # -- introspection (/slicez) -----------------------------------------------
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        groups_out: dict[str, dict] = {}
+        for group, members in sorted(self.broker.leases.groups().items()):
+            # recovering lookup: after a restart/failover the generation
+            # comes back from the member annotations (cached after the
+            # first call, so steady-state snapshots stay GET-free)
+            info = self._ensure_group_info(group, members)
+            groups_out[group] = {
+                "tenant": members[0].tenant,
+                "generation": info.get("generation", 1),
+                "tpus_per_host": info.get("tpus_per_host"),
+                "chips": sum(lease.chips for lease in members),
+                "members": [{
+                    "namespace": lease.namespace, "pod": lease.pod,
+                    "chips": lease.chips, "node": lease.node,
+                    "expires_in_s": (None if (r := lease.expires_in_s())
+                                     is None else round(r, 1)),
+                } for lease in members],
+            }
+        with self._lock:
+            txns = [{
+                "txn_id": txn.record.txn_id, "rid": txn.record.rid,
+                "tenant": txn.record.tenant,
+                "pods": list(txn.record.pods),
+                "committed": list(txn.record.committed),
+                "state": txn.state,
+                "adopted": txn.adopted,
+                "age_s": round(now - txn.started, 3),
+            } for txn in self._txns.values()]
+        stranded = float(REGISTRY.slice_txns_stranded.value())
+        return {
+            "groups": groups_out,
+            "txns": {
+                "pending": len(txns),
+                "in_flight": sorted(txns, key=lambda t: -t["age_s"]),
+                "stranded": int(stranded),
+            },
+            "gang_queue_depth": int(
+                REGISTRY.gang_queue_depth.value()),
+        }

@@ -83,6 +83,8 @@ class LeaseRecord:
     created_unix: float = 0.0
     expires_unix: float | None = None   # None = never expires
     renewals: int = 0
+    # Slice-group membership (master/slicetxn.py); "" = single-host.
+    group: str = ""
 
     @property
     def key(self) -> tuple[str, str]:
@@ -114,7 +116,7 @@ class LeaseRecord:
                    created_unix=round(lease.created_unix, 3),
                    expires_unix=(None if remaining is None
                                  else round(time.time() + remaining, 3)),
-                   renewals=lease.renewals)
+                   renewals=lease.renewals, group=lease.group)
 
     def to_lease(self):
         from gpumounter_tpu.master.lease import Lease
@@ -126,7 +128,8 @@ class LeaseRecord:
                      chips=self.chips, uuids=set(self.uuids),
                      node=self.node, rid=self.rid,
                      created_unix=self.created_unix,
-                     expires_at=expires_at, renewals=self.renewals)
+                     expires_at=expires_at, renewals=self.renewals,
+                     group=self.group)
 
 
 @dataclasses.dataclass
@@ -163,6 +166,56 @@ class WaiterRecord:
         return record
 
 
+@dataclasses.dataclass
+class SliceTxnRecord:
+    """A multi-host slice transaction's intent, written BEFORE the
+    fan-out touches any host (master/slicetxn.py). ``committed`` lists
+    the "namespace/pod" members whose hosts already hold chips under the
+    txn — the per-host commit markers. A record still present at
+    rehydration is a transaction its writer never resolved: the adopting
+    leader completes the fan-out under the original rid (worker per-rid
+    idempotency makes re-runs of landed hosts adopt, not double-actuate)
+    while its deadline holds, or rolls every member back via the
+    txn-targeted detach once it has passed."""
+
+    txn_id: str
+    rid: str
+    tenant: str
+    priority: str = consts.DEFAULT_PRIORITY
+    # ["namespace/pod", ...] — flat strings so the record's canonical
+    # JSON stays list-of-strings (annotation values are plain text).
+    pods: list[str] = dataclasses.field(default_factory=list)
+    tpus_per_host: int = 0
+    committed: list[str] = dataclasses.field(default_factory=list)
+    created_unix: float = 0.0
+    deadline_unix: float = 0.0
+    # Lease group the commit joins ("" = the txn id itself — a fresh
+    # slice; a resize delta txn names the EXISTING group here).
+    group: str = ""
+
+    @property
+    def namespace(self) -> str:
+        return self.pods[0].split("/", 1)[0] if self.pods else ""
+
+    def members(self) -> list[tuple[str, str]]:
+        return [tuple(p.split("/", 1)) for p in self.pods if "/" in p]
+
+    @property
+    def annotation_key(self) -> str:
+        return consts.STORE_SLICE_ANNOTATION_PREFIX + _digest(self.txn_id)
+
+    def to_json(self) -> str:
+        return _canonical(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, text: str) -> "SliceTxnRecord":
+        obj = json.loads(text)
+        record = cls(**obj)
+        if not record.txn_id or not record.pods:
+            raise ValueError(f"slice txn record missing identity: {text!r}")
+        return record
+
+
 class IntentStore:
     """Write-through persistence of broker intent, sharded by namespace.
 
@@ -192,6 +245,11 @@ class IntentStore:
         # could not reach the apiserver, replayed oldest-first
         self._dirty: list[tuple[int, str, str | None, float]] = []
         self.torn_records = 0
+        # cross-shard capacity pokes: last stamp sent per peer shard
+        # (rate limit) and last stamp observed per owned shard (edge
+        # detection — only a MOVED stamp is a nudge)
+        self._poke_sent: dict[int, float] = {}
+        self._poke_seen: dict[int, str] = {}
 
     # -- naming ----------------------------------------------------------------
 
@@ -250,6 +308,77 @@ class IntentStore:
     def delete_waiter(self, namespace: str, rid: str) -> bool:
         key = consts.STORE_WAITER_ANNOTATION_PREFIX + _digest(rid)
         return self._write(self.shard_of(namespace), key, None)
+
+    def put_slice_txn(self, record: SliceTxnRecord) -> bool:
+        return self._write(self.shard_of(record.namespace),
+                           record.annotation_key, record.to_json())
+
+    def delete_slice_txn(self, namespace: str, txn_id: str) -> bool:
+        key = consts.STORE_SLICE_ANNOTATION_PREFIX + _digest(txn_id)
+        return self._write(self.shard_of(namespace), key, None)
+
+    # -- cross-shard capacity pokes --------------------------------------------
+
+    # Minimum seconds between pokes to one shard: a burst of detaches is
+    # one "look again", not N ConfigMap patches — and each poke costs a
+    # write on the PEER leader's CAS stream, so the hint stays far
+    # cheaper than the capacity it advertises (a parked waiter losing a
+    # few seconds to the rate limit still beats sleeping to its
+    # deadline).
+    POKE_MIN_INTERVAL_S = 5.0
+
+    def poke_peers(self, own_shards: set[int]) -> int:
+        """Stamp the capacity-poke annotation on every shard map this
+        replica does NOT own: chips just freed here, and a peer leader's
+        parked waiters (gangs especially) should re-attempt now instead
+        of sleeping to their deadline. Best-effort and fence-exempt (the
+        stamp carries no state — see consts); an unreachable apiserver
+        just means peers fall back to their timeout. Returns shards
+        poked."""
+        now = time.monotonic()
+        poked = 0
+        for shard in range(self.ring.shards):
+            if shard in own_shards:
+                continue
+            with self._lock:
+                last = self._poke_sent.get(shard, -1e18)
+                if now - last < self.POKE_MIN_INTERVAL_S:
+                    continue
+                self._poke_sent[shard] = now
+            try:
+                self._cas(shard,
+                          {consts.STORE_CAPACITY_POKE_ANNOTATION:
+                           f"{time.time():.3f}"},
+                          unfenced=True)
+            except K8sApiError as e:
+                logger.debug("capacity poke to shard %d failed: %s",
+                             shard, e)
+                continue
+            REGISTRY.capacity_pokes.inc(direction="sent")
+            poked += 1
+        return poked
+
+    def check_poke(self, shard: int) -> bool:
+        """True when the shard map's poke stamp moved since last checked
+        (a peer freed chips our waiters may want). One fresh GET — driven
+        from the broker tick, never a request path; the read also
+        refreshes the CAS cache, so it is not pure overhead."""
+        try:
+            cm = self.kube.get_config_map(self.namespace,
+                                          self.cm_name(shard))
+        except K8sApiError:
+            return False
+        self._remember(shard, cm)
+        stamp = (cm.get("metadata", {}).get("annotations") or {}).get(
+            consts.STORE_CAPACITY_POKE_ANNOTATION, "")
+        with self._lock:
+            seen = self._poke_seen.get(shard)
+            self._poke_seen[shard] = stamp
+        if seen is None or seen == stamp or not stamp:
+            # first observation is a baseline, not a nudge
+            return False
+        REGISTRY.capacity_pokes.inc(direction="received")
+        return True
 
     def _write(self, shard: int, key: str, value: str | None,
                _from_dirty: bool = False) -> bool:
@@ -314,14 +443,20 @@ class IntentStore:
                     return
             self._dirty.append((shard, key, value, time.monotonic()))
 
-    def _cas(self, shard: int, changes: dict[str, str | None]) -> None:
+    def _cas(self, shard: int, changes: dict[str, str | None],
+             unfenced: bool = False) -> None:
         """One annotation merge under resourceVersion CAS + fence check,
         retried on conflict with a fresh read. The fence bump rides in
         the same patch, so "check the token" and "write the record" are
-        one atomic step — a deposed leader cannot interleave."""
+        one atomic step — a deposed leader cannot interleave.
+
+        ``unfenced=True`` skips the token discipline entirely — reserved
+        for the capacity-poke annotation, which carries no broker state
+        (any replica may stamp any shard; the fence exists to protect
+        records, and a poke writes none)."""
         name = self.cm_name(shard)
-        token = self.election.token(shard)
-        if self.election.enabled and token is None:
+        token = None if unfenced else self.election.token(shard)
+        if not unfenced and self.election.enabled and token is None:
             # Leadership decayed between the caller's ownership check
             # and here (paused process, missed renewals): writing now
             # would be UNFENCED — the one hole in the split-brain
@@ -452,10 +587,13 @@ class IntentStore:
         with self._lock:
             self._observed.pop(shard, None)
             self._dirty = [d for d in self._dirty if d[0] != shard]
+            # stale poke baseline would mis-read the new leader's first
+            # stamp as "unchanged" on a later reacquire
+            self._poke_seen.pop(shard, None)
         # the records belong to the new leader now — freezing our last
         # counts would double-count them in any cross-replica sum (same
         # vanished-series discipline as lease.py's _known_tenants)
-        for kind in ("lease", "waiter"):
+        for kind in ("lease", "waiter", "slice"):
             REGISTRY.store_records.set(0, kind=kind, shard=str(shard))
         self._export_lag_locked_free()
 
@@ -479,11 +617,15 @@ class IntentStore:
         waiters = sum(
             1 for k in annotations
             if k.startswith(consts.STORE_WAITER_ANNOTATION_PREFIX))
+        slices = sum(
+            1 for k in annotations
+            if k.startswith(consts.STORE_SLICE_ANNOTATION_PREFIX))
         # per-shard series: a replica owning several shards must not
         # have the last-written shard's counts overwrite the others'
         REGISTRY.store_records.set(leases, kind="lease", shard=str(shard))
         REGISTRY.store_records.set(waiters, kind="waiter",
                                    shard=str(shard))
+        REGISTRY.store_records.set(slices, kind="slice", shard=str(shard))
 
     # -- rehydration -----------------------------------------------------------
 
@@ -522,6 +664,39 @@ class IntentStore:
             self.torn_records += torn
         self._export_records(shard)
         return leases, waiters, torn
+
+    def rehydrate_slice_txns(self, shard: int
+                             ) -> tuple[list[SliceTxnRecord], int]:
+        """The shard's unresolved slice transactions: (records, torn).
+        A record here means its writer crashed (or was deposed) mid-
+        transaction — the adopting leader must complete or roll it back
+        (master/slicetxn.py adopt). Torn records are counted and dropped
+        like rehydrate()'s: the txn-targeted detach of the next attach
+        attempt (same rid) reconciles whatever they described."""
+        try:
+            cm = self.kube.get_config_map(self.namespace,
+                                          self.cm_name(shard))
+        except K8sApiError as e:
+            if e.status == 404:
+                return [], 0
+            raise
+        self._remember(shard, cm)
+        annotations = dict(cm.get("metadata", {}).get("annotations") or {})
+        records: list[SliceTxnRecord] = []
+        torn = 0
+        for key, value in annotations.items():
+            if not key.startswith(consts.STORE_SLICE_ANNOTATION_PREFIX):
+                continue
+            try:
+                records.append(SliceTxnRecord.from_json(value))
+            except (ValueError, TypeError) as e:
+                torn += 1
+                logger.warning("torn slice txn record %s dropped (%s)",
+                               key, e)
+        if torn:
+            self.torn_records += torn
+        self._export_records(shard)
+        return records, torn
 
     # -- introspection ---------------------------------------------------------
 

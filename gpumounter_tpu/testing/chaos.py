@@ -334,6 +334,7 @@ def assert_broker_invariants(broker, sim, store=None) -> None:
     if store is not None:
         stored: dict[tuple[str, str], int] = {}
         waiter_records = []
+        slice_records = []
         for shard in range(store.ring.shards):
             lease_records, shard_waiters, torn = store.rehydrate(shard)
             assert torn == 0, f"shard {shard}: {torn} torn record(s)"
@@ -341,12 +342,102 @@ def assert_broker_invariants(broker, sim, store=None) -> None:
                 stored[record.key] = stored.get(record.key, 0) \
                     + record.chips
             waiter_records.extend(shard_waiters)
+            shard_slices, slice_torn = store.rehydrate_slice_txns(shard)
+            assert slice_torn == 0, \
+                f"shard {shard}: {slice_torn} torn slice txn record(s)"
+            slice_records.extend(shard_slices)
         assert stored == held, \
             f"intent-store lease records {stored} != cluster ground " \
             f"truth {held} (a failed-over peer would rehydrate a lie)"
         assert not waiter_records, \
             f"{len(waiter_records)} waiter record(s) outlived their " \
             f"resolution: {[w.rid for w in waiter_records]}"
+        assert not slice_records, \
+            f"{len(slice_records)} slice txn record(s) outlived their " \
+            f"resolution: {[r.txn_id for r in slice_records]} — a " \
+            "transaction neither committed nor rolled back"
+
+
+def assert_slice_invariants(broker, sims, store=None) -> None:
+    """The elastic-slice contract after any slice chaos plan (leader
+    killed mid-fan-out, competing gangs, resize races): **zero
+    half-attached slices**, judged against cluster ground truth across
+    EVERY simulated node.
+
+    1. The broker's lease table accounts exactly the chips held across
+       all nodes (the multi-node generalisation of
+       :func:`assert_broker_invariants` point 1).
+    2. Every slave pod stamped with a slice txn id that still holds
+       chips is backed by a slice-GROUP lease — a txn either committed
+       everywhere (all members under one group) or rolled back
+       everywhere (no txn-labelled holder survives). A txn-labelled
+       holder without a group lease is precisely a half-attached slice.
+    3. No gang waiter is still parked.
+    4. ``store`` given: no slice txn record outlives its resolution and
+       none is torn; persisted lease records match ground truth — what
+       a failed-over peer would rehydrate is the truth.
+    """
+    from gpumounter_tpu.k8s import objects
+    from gpumounter_tpu.utils import consts
+    held: dict[tuple[str, str], int] = {}
+    txn_holders: dict[str, set[tuple[str, str]]] = {}
+    for sim in sims:
+        for pod in sim.slave_pods():
+            labels = objects.labels(pod)
+            if labels.get(consts.WARM_POD_LABEL_KEY) == \
+                    consts.WARM_POD_LABEL_VALUE:
+                continue
+            owner_ns = labels.get(consts.OWNER_NAMESPACE_LABEL_KEY)
+            owner = labels.get(consts.OWNER_POD_LABEL_KEY)
+            if not owner or not owner_ns:
+                continue
+            pkey = (objects.namespace(pod), objects.name(pod))
+            chips = sum(
+                len(ids)
+                for containers in (sim.podresources.assignments.get(pkey)
+                                   or {}).values()
+                for ids in containers.values())
+            if not chips:
+                continue
+            held[(owner_ns, owner)] = held.get((owner_ns, owner), 0) \
+                + chips
+            txn = labels.get(consts.TXN_LABEL_KEY)
+            if txn:
+                txn_holders.setdefault(txn, set()).add((owner_ns, owner))
+    leased = {lease.key: lease.chips for lease in broker.leases.leases()}
+    assert leased == held, \
+        f"broker lease table {leased} != multi-node cluster ground " \
+        f"truth {held} (leaked slice reservation or double-release)"
+    for txn, owners in sorted(txn_holders.items()):
+        for owner in sorted(owners):
+            lease = broker.leases.get(*owner)
+            assert lease is not None and lease.group, \
+                f"HALF-ATTACHED SLICE: txn {txn} holder {owner[0]}/" \
+                f"{owner[1]} holds chips without a slice-group lease"
+    with broker._lock:
+        gangs = [w for w in broker._waiters if w.gang]
+    assert not gangs, \
+        f"{len(gangs)} gang waiter(s) still parked: " \
+        f"{[w.rid for w in gangs]}"
+    if store is not None:
+        stored: dict[tuple[str, str], int] = {}
+        leftovers = []
+        for shard in range(store.ring.shards):
+            lease_records, _waiters, torn = store.rehydrate(shard)
+            assert torn == 0, f"shard {shard}: {torn} torn record(s)"
+            for record in lease_records:
+                stored[record.key] = stored.get(record.key, 0) \
+                    + record.chips
+            shard_slices, slice_torn = store.rehydrate_slice_txns(shard)
+            assert slice_torn == 0, \
+                f"shard {shard}: {slice_torn} torn slice txn record(s)"
+            leftovers.extend(shard_slices)
+        assert stored == held, \
+            f"intent-store lease records {stored} != cluster ground " \
+            f"truth {held} (a failed-over peer would rehydrate a lie)"
+        assert not leftovers, \
+            f"slice txn record(s) outlived resolution: " \
+            f"{[r.txn_id for r in leftovers]}"
 
 
 def assert_invariants(rig, expected_uuids: set[str],

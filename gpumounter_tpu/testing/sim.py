@@ -429,12 +429,13 @@ class MultiMasterStack:
     shard over and drains the persisted waiters.
     """
 
-    def __init__(self, rig: WorkerRig, masters: int = 2,
+    def __init__(self, rig: WorkerRig | None = None, masters: int = 2,
                  shards: int | None = None, broker_config=None,
                  store: bool = True, election: bool = True,
                  forward: str = "proxy",
                  renew_interval_s: float = 0.15,
-                 lease_duration_s: float = 0.45):
+                 lease_duration_s: float = 0.45,
+                 rigs: list[WorkerRig] | None = None):
         import dataclasses
 
         from gpumounter_tpu.master.admission import AttachBroker
@@ -443,15 +444,32 @@ class MultiMasterStack:
         from gpumounter_tpu.master.shardring import HAConfig, ShardRing
         from gpumounter_tpu.worker.grpc_server import build_server
 
-        self.rig = rig
-        self.kube = rig.sim.kube
+        # ``rigs=[...]``: N simulated TPU nodes behind the HA masters —
+        # the multi-host slice chaos topology. Each rig keeps its own
+        # fake cluster (its worker's slave pods live there); the masters
+        # share a separate kube holding worker + target pods and the
+        # election/store ConfigMaps, so broker state recovery must come
+        # from the intent store — exactly the failover path under test.
+        # Single-rig (the default) keeps the historical shared-kube view.
+        self.rigs = list(rigs) if rigs is not None else [rig]
+        assert self.rigs and self.rigs[0] is not None
+        self.rig = self.rigs[0]
+        self.kube = (self.rig.sim.kube if rigs is None
+                     else FakeKubeClient())
         self.shards = shards or masters
         self.ring = ShardRing(self.shards)
-        self.grpc_server, grpc_port = build_server(rig.service, port=0,
-                                                   address="127.0.0.1")
-        self.grpc_server.start()
-        self.kube.put_pod(worker_pod(rig.sim.node, "127.0.0.1",
-                                     grpc_port=grpc_port))
+        self.grpc_servers = []
+        for worker_rig in self.rigs:
+            server, grpc_port = build_server(worker_rig.service, port=0,
+                                             address="127.0.0.1")
+            server.start()
+            self.grpc_servers.append(server)
+            self.kube.put_pod(worker_pod(
+                worker_rig.sim.node, "127.0.0.1",
+                name=f"w-{worker_rig.sim.node}", grpc_port=grpc_port))
+            if rigs is not None:
+                self.kube.put_pod(worker_rig.pod)
+        self.grpc_server = self.grpc_servers[0]
         self.gateways = []
         self.http_servers = []
         self.bases: list[str] = []
@@ -462,14 +480,13 @@ class MultiMasterStack:
                 replica=f"master-{i}", forward=forward,
                 renew_interval_s=renew_interval_s,
                 lease_duration_s=lease_duration_s,
-                namespace=rig.sim.settings.pool_namespace)
+                namespace=self.rig.sim.settings.pool_namespace)
             config = (dataclasses.replace(
                 broker_config, quotas=dict(broker_config.quotas))
                 if broker_config is not None else None)
             broker = AttachBroker(self.kube, config)
             gateway = MasterGateway(
-                self.kube, WorkerDirectory(self.kube,
-                                           grpc_port=grpc_port),
+                self.kube, WorkerDirectory(self.kube),
                 # no per-worker health sidecars in this stack: disable
                 # the fleet scrape (and /tracez stitch) resolution
                 worker_tracez_base=lambda target: None,
@@ -533,8 +550,10 @@ class MultiMasterStack:
         for i in self.live():
             self.http_servers[i].shutdown()
             self.dead.add(i)
-        self.grpc_server.stop(grace=0)
-        self.rig.close()
+        for server in self.grpc_servers:
+            server.stop(grace=0)
+        for rig in self.rigs:
+            rig.close()
 
 
 class MultiNodeStack:
@@ -542,7 +561,9 @@ class MultiNodeStack:
     ONE master — the multi-host slice topology (BASELINE config 5). Node i
     is ``node-i`` holding pod ``workload-i``."""
 
-    def __init__(self, hosts: list, n_chips=4, health: bool = False):
+    def __init__(self, hosts: list, n_chips=4, health: bool = False,
+                 broker_config=None):
+        from gpumounter_tpu.master.admission import AttachBroker
         from gpumounter_tpu.master.discovery import WorkerDirectory
         from gpumounter_tpu.master.gateway import MasterGateway
         from gpumounter_tpu.worker.grpc_server import build_server
@@ -575,9 +596,12 @@ class MultiNodeStack:
             self.master_kube.put_pod(worker_pod(
                 f"node-{i}", "127.0.0.1", name=f"w{i}", grpc_port=port))
             self.master_kube.put_pod(rig.pod)
+        broker = (AttachBroker(self.master_kube, broker_config)
+                  if broker_config is not None else None)
         self.gateway = MasterGateway(
             self.master_kube, WorkerDirectory(self.master_kube),
-            worker_tracez_base=(health_bases.get if health else None))
+            worker_tracez_base=(health_bases.get if health else None),
+            broker=broker)
         self.http_server = self.gateway.serve(port=0, address="127.0.0.1")
         self.base = f"http://127.0.0.1:{self.http_server.server_port}"
 

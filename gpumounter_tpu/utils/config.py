@@ -133,6 +133,13 @@ class Settings:
     lease_ttl_s: float = 0.0
     queue_timeout_s: float = 0.0
     queue_depth: int = 64
+    # Elastic slice subsystem (master/slicetxn.py): how long a parked
+    # gang may HOLD partially reserved hosts before handing them back
+    # (anti-deadlock). Gangs only exist when queue_timeout_s > 0.
+    gang_hold_s: float = consts.DEFAULT_GANG_HOLD_S
+    # Worker-side mesh-generation notification files (worker/service.py):
+    # directory stamped on every actuation; "" = disabled.
+    mesh_gen_dir: str = ""
     # HA control plane (master/shardring.py HAConfig.from_settings):
     # admission sharding, per-shard leader election, and the declarative
     # intent store. ALL defaults preserve single-master PR 7 semantics:
@@ -198,6 +205,13 @@ class Settings:
             s.queue_timeout_s = float(t)
         if t := env.get(consts.ENV_QUEUE_DEPTH):
             s.queue_depth = int(t)
+        if t := env.get(consts.ENV_GANG_HOLD_S):
+            s.gang_hold_s = float(t)
+            if s.gang_hold_s <= 0:
+                raise ValueError(
+                    f"{consts.ENV_GANG_HOLD_S} must be > 0 (a gang that "
+                    f"never hands back can deadlock a peer), got {t!r}")
+        s.mesh_gen_dir = env.get(consts.ENV_MESH_GEN_DIR, "")
         if t := env.get(consts.ENV_MASTER_SHARDS):
             s.master_shards = int(t)
             if s.master_shards < 1:

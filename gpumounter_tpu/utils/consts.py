@@ -212,12 +212,43 @@ DEFAULT_ELECTION_TTL_S = 6.0
 # CAS-able object kind the control plane needs beyond pods.
 STORE_CONFIGMAP_PREFIX = "tpu-mounter-broker-state-"
 ELECTION_CONFIGMAP_PREFIX = "tpu-mounter-election-"
-# Annotation key prefixes of the store's records ("l-"/"w-" + a stable
-# digest of the record identity; annotation names are length-capped, so
-# the identity lives IN the record, not the key) and the fencing token.
+# Annotation key prefixes of the store's records ("l-"/"w-"/"s-" + a
+# stable digest of the record identity; annotation names are
+# length-capped, so the identity lives IN the record, not the key) and
+# the fencing token.
 STORE_LEASE_ANNOTATION_PREFIX = "tpumounter.io/l-"
 STORE_WAITER_ANNOTATION_PREFIX = "tpumounter.io/w-"
+STORE_SLICE_ANNOTATION_PREFIX = "tpumounter.io/s-"
 STORE_FENCE_ANNOTATION = "tpumounter.io/fence"
+# Cross-shard capacity nudge (master/store.py poke_peers): a detach on
+# one shard's leader frees node chips another shard's parked waiters may
+# want; the releasing leader stamps this annotation (a coarse wall-clock
+# timestamp) on every PEER shard's state ConfigMap, and each leader's
+# broker tick re-attempts its waiters when the stamp moved. Deliberately
+# fence-exempt: any replica may nudge any shard — the annotation carries
+# no state, only "look again".
+STORE_CAPACITY_POKE_ANNOTATION = "tpumounter.io/capacity-poke"
+
+# --- Elastic slice subsystem (master/slicetxn.py, jaxcheck/elastic.py) --------
+# How long a gang (a parked whole-slice attach) may HOLD partially
+# reserved hosts before handing them back so a competing gang cannot
+# deadlock against it. Seconds; the gang keeps waiting for its queue
+# deadline after a hand-back, it just stops hogging capacity. Only
+# meaningful when the broker queue is enabled (TPU_QUEUE_TIMEOUT_S > 0 —
+# slices fail fast otherwise, exactly the pre-gang behavior).
+ENV_GANG_HOLD_S = "TPU_GANG_HOLD_S"
+DEFAULT_GANG_HOLD_S = 15.0
+# Directory the worker stamps a per-owner-pod mesh-generation
+# notification file into on every actuation (attach/detach success):
+# <dir>/<namespace>--<pod>.json, {"generation": <unix>, "chips": [...]}.
+# An elastic JAX job (jaxcheck/elastic.py) polls it — mounted via
+# hostPath — to learn its chip set changed without watching the
+# apiserver. Empty/unset = disabled (zero new writes).
+ENV_MESH_GEN_DIR = "TPU_MESH_GEN_DIR"
+# Annotation the master's /slice/resize route bumps on every member pod
+# once the slice's NEW chip set is fully actuated — the informer-path
+# generation signal (the alternative to the worker's notification file).
+MESH_GENERATION_ANNOTATION = "tpumounter.io/mesh-generation"
 
 # Request headers naming the tenant/priority (query params ?tenant= /
 # ?priority= take precedence; both fall back to namespace / "normal").

@@ -625,6 +625,65 @@ class Registry:
             "forwarded to the shard leader, by mode (proxy/redirect) "
             "and outcome")
         self.shard_forwards.inc(0.0, mode="proxy", outcome="ok")
+        # Elastic slice subsystem (master/slicetxn.py): every slice
+        # transaction's terminal state by outcome — commit / abort (rolled
+        # back) / adopted_commit / adopted_abort (resolved by a failed-over
+        # peer) / handback (a gang returned partially reserved hosts so a
+        # competing gang could make progress; the txn itself lives on).
+        self.slice_txns = Counter(
+            "tpumounter_slice_txns_total",
+            "Slice transactions resolved, by outcome (commit/abort/"
+            "adopted_commit/adopted_abort) plus gang hand-backs")
+        for outcome in ("commit", "abort", "adopted_commit",
+                        "adopted_abort", "handback"):
+            # pre-seed: a failover's FIRST adopted resolution must read
+            # as a non-zero increase() (see flight_dumps rationale)
+            self.slice_txns.inc(0.0, outcome=outcome)
+        # Gangs (whole-slice attaches) parked waiting for multi-node
+        # capacity — the queue_depth companion for the slice path.
+        self.gang_queue_depth = Gauge(
+            "tpumounter_gang_queue_depth",
+            "Whole-slice attach requests parked as gang waiters")
+        # In-flight slice txn intent records (pending = fan-out running or
+        # gang-parked); stranded = records older than their deadline that
+        # nothing is driving (leader died and nobody adopted) — doctor
+        # CRITs on stranded > 0.
+        self.slice_txns_pending = Gauge(
+            "tpumounter_slice_txns_pending",
+            "Slice transactions currently in flight (fan-out running or "
+            "gang-parked) on this replica")
+        self.slice_txn_oldest_age = Gauge(
+            "tpumounter_slice_txn_oldest_age",
+            "Age in seconds of the oldest in-flight slice transaction "
+            "(0 = none)")
+        self.slice_txns_stranded = Gauge(
+            "tpumounter_slice_txns_stranded",
+            "Slice transaction intent records older than their deadline "
+            "with no resolver driving them — a crashed fan-out nobody "
+            "adopted; doctor CRITs on any")
+        # Per-host attach latency INSIDE a slice fan-out: the straggler
+        # that sets the transaction's wall time was previously only a log
+        # line; exemplars carry the rid so a bad bucket links to /tracez.
+        self.slice_host_attach = Histogram(
+            "tpumounter_slice_host_attach_seconds",
+            "Per-host worker attach round-trip inside a slice fan-out "
+            "(the max across hosts is the transaction's critical path)")
+        # Live mesh reshaping (POST /slice/resize): end-to-end latency of
+        # computing the delta, running it as a slice txn and bumping the
+        # mesh generation.
+        self.slice_resize = Histogram(
+            "tpumounter_slice_resize_seconds",
+            "End-to-end /slice/resize latency (delta txn + generation "
+            "bump)")
+        # Cross-shard capacity nudges (master/store.py): sent = this
+        # replica stamped a peer shard's state ConfigMap after freeing
+        # chips; received = a tick observed a moved stamp and re-attempted
+        # its parked waiters.
+        self.capacity_pokes = Counter(
+            "tpumounter_capacity_pokes_total",
+            "Cross-shard capacity nudges by direction (sent/received)")
+        self.capacity_pokes.inc(0.0, direction="sent")
+        self.capacity_pokes.inc(0.0, direction="received")
         # Fleet aggregator (master/fleet.py): workers by scrape health.
         self.fleet_nodes = Gauge(
             "tpumounter_fleet_nodes",

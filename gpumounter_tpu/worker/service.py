@@ -371,6 +371,11 @@ class TPUMountService:
             self.journal.commit(jid)
         self._remember_attachment(namespace, pod_name, objects.uid(pod),
                                   all_after, all_slave_names)
+        # mesh-generation notification file (jaxcheck/elastic.py): the
+        # pod's chip set just changed — stamp the signal an elastic JAX
+        # job polls, AFTER actuation (the nodes exist when it reads this)
+        self._stamp_mesh_generation(namespace, pod_name,
+                                    [c.uuid for c in all_after])
         self._record_event(
             pod, "TPUAttachResumed" if resumed else "TPUAttached",
             f"attached {len(chips)} TPU chip(s) "
@@ -499,12 +504,42 @@ class TPUMountService:
             self.journal.record_detach(
                 request_id or txn_id, namespace, pod_name,
                 [c.uuid for c in chips], cause=cause, force=force)
+        self._stamp_mesh_generation(namespace, pod_name,
+                                    [c.uuid for c in remaining])
         self._record_event(
             pod, "TPUDetached",
             f"detached {len(chips)} TPU chip(s) (force={force}"
             + (f", cause={cause}" if cause else "") + "): "
             f"{[c.uuid for c in chips]}")
         return RemoveOutcome(consts.RemoveResult.SUCCESS)
+
+    # -- mesh-generation notification (jaxcheck/elastic.py file signal) -------
+
+    def _stamp_mesh_generation(self, namespace: str, pod_name: str,
+                               chips: list[str]) -> None:
+        """Write the per-owner-pod mesh-generation file an elastic JAX
+        job polls (``TPU_MESH_GEN_DIR``; mounted into the workload via
+        hostPath): {"generation": <unix>, "chips": [...]}. Written
+        atomically and best-effort — a full disk must not fail a mount
+        that already succeeded. Disabled (the default) = zero writes."""
+        directory = self.settings.mesh_gen_dir
+        if not directory:
+            return
+        import json as json_mod
+        import os
+        import tempfile
+        try:
+            os.makedirs(directory, exist_ok=True)
+            path = os.path.join(directory,
+                                f"{namespace}--{pod_name}.json")
+            fd, tmp = tempfile.mkstemp(dir=directory, suffix=".gen")
+            with os.fdopen(fd, "w") as f:
+                json_mod.dump({"generation": round(time.time(), 6),
+                               "chips": sorted(chips)}, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.warning("mesh-generation stamp for %s/%s failed: %s",
+                           namespace, pod_name, e)
 
     # -- attachment-record cache (detach resolution fast path) ----------------
 

@@ -6,7 +6,7 @@ method may fire an attach RPC outside the broker's orchestration. A new
 mutating route added without admission wiring fails here instead of
 shipping a quota bypass."""
 
-from gpumounter_tpu.master import admission, gateway
+from gpumounter_tpu.master import admission, gateway, slicetxn
 
 from tests.test_retry_lint import (_functions, _names_used,
                                    _referencing_functions)
@@ -18,6 +18,8 @@ def test_attach_handlers_only_dispatched_from_route():
     assert _referencing_functions(gateway, "_add") == \
         {"MasterGateway._route"}
     assert _referencing_functions(gateway, "_slice_attach") == \
+        {"MasterGateway._route"}
+    assert _referencing_functions(gateway, "_slice_resize") == \
         {"MasterGateway._route"}
 
 
@@ -31,14 +33,31 @@ def test_add_routes_through_the_broker():
 
 
 def test_slice_attach_admits_before_fanout():
+    """Slice admission moved into the txn manager (master/slicetxn.py)
+    with the crash-safe protocol: both gateway slice-mutation handlers
+    route through it, and the manager's transaction entry runs under the
+    broker's reservation-scoped admission context — the whole gang wait
+    stays inside the reservation, so a parked slice's chips count as
+    in-flight usage against its tenant's cap."""
     funcs = _functions(gateway)
-    names = _names_used(funcs["MasterGateway._slice_attach"])
-    assert "admission" in names, \
-        "_slice_attach skips reservation-scoped quota admission"
-    # the coordinator (which holds the raw per-host add_tpu calls) is
-    # only reachable from the two admitted slice handlers
+    for handler in ("MasterGateway._slice_attach",
+                    "MasterGateway._slice_resize"):
+        names = _names_used(funcs[handler])
+        assert "slices" in names, \
+            f"{handler} bypasses the slice txn manager"
+    txn_funcs = _functions(slicetxn)
+    attach_names = _names_used(txn_funcs["SliceTxnManager.attach"])
+    assert "admission" in attach_names, \
+        "SliceTxnManager.attach skips reservation-scoped quota admission"
+    resize_names = _names_used(txn_funcs["SliceTxnManager.resize"])
+    assert "attach" in resize_names, \
+        "resize's grow half must run as an admitted slice txn"
+    # the raw coordinator (which holds the per-host add_tpu calls) is
+    # only reachable from the admitted detach handler and the manager
     assert _referencing_functions(gateway, "_slice_coordinator") == \
-        {"MasterGateway._slice_attach", "MasterGateway._slice_detach"}
+        {"MasterGateway._slice_detach"}
+    assert _referencing_functions(slicetxn, "SliceCoordinator") == \
+        {"SliceTxnManager._coordinator"}
 
 
 def test_broker_attach_cannot_skip_admission():

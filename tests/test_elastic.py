@@ -1,0 +1,251 @@
+"""Elastic mesh reshaping (jaxcheck/elastic.py + POST /slice/resize):
+the worker's mesh-generation notification file, the harness's drain →
+rebuild → restore-resharded sequence, and the acceptance e2e — a live
+training loop rides a slice resize 2→4 hosts (and back) on the CPU sim
+stack with its loss trajectory intact (no reset).
+
+The step factory used here runs FULL attention under sharding hints
+(the ring/shard_map kernels need a newer jax than some environments
+carry); the harness itself is attention-agnostic — production passes
+the flagship ring step.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.config import HostPaths
+
+jax = pytest.importorskip("jax")
+
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from gpumounter_tpu.jaxcheck import elastic  # noqa: E402
+from gpumounter_tpu.jaxcheck import model as model_lib  # noqa: E402
+from gpumounter_tpu.jaxcheck import train as train_lib  # noqa: E402
+from gpumounter_tpu.jaxcheck.ring_attention import full_attention  # noqa: E402
+
+TINY = model_lib.ModelConfig(vocab=64, d_model=32, n_heads=4, n_layers=1,
+                             d_ff=64)
+
+
+def full_attn_step_factory(cfg, mesh, optimizer):
+    """Sharded train step with full attention: tokens ride (data, seq),
+    params carry the Megatron specs, XLA lays the collectives — the
+    shard_map-free stand-in for the ring step."""
+    import optax
+
+    def loss_fn(params, tokens):
+        logits = model_lib.forward(params, tokens, cfg,
+                                   attn_fn=full_attention)
+        return train_lib.cross_entropy(logits, tokens)
+
+    def step(state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        return train_lib.TrainState(params, opt_state, state.step + 1), \
+            loss
+
+    return jax.jit(step, donate_argnums=0,
+                   in_shardings=(None, NamedSharding(mesh,
+                                                     P("data", "seq"))))
+
+
+def _batch(i, batch=4, seq=16):
+    key = jax.random.fold_in(jax.random.PRNGKey(7), i)
+    return np.asarray(train_lib.make_batch(key, batch, seq, TINY.vocab))
+
+
+# -- worker-side notification file ---------------------------------------------
+
+def test_worker_stamps_mesh_generation_file_on_actuation(fake_host,
+                                                         tmp_path):
+    from gpumounter_tpu.testing.sim import WorkerRig
+    rig = WorkerRig(fake_host, n_chips=4)
+    try:
+        gen_dir = tmp_path / "mesh-gen"
+        rig.sim.settings.mesh_gen_dir = str(gen_dir)
+        outcome = rig.service.add_tpu("workload", "default", 4, True,
+                                      request_id="rid-gen")
+        assert outcome.result == consts.AddResult.SUCCESS
+        path = gen_dir / "default--workload.json"
+        payload = elastic.read_generation_file(str(path))
+        assert payload is not None
+        assert len(payload["chips"]) == 4
+        first = payload["generation"]
+        assert first > 0
+        signal = elastic.FileSignal(str(path))
+        assert signal.chips() == 4
+        assert signal.generation() == first
+
+        outcome = rig.service.remove_tpu("workload", "default", [], False,
+                                         request_id="rid-gen-2")
+        assert outcome.result == consts.RemoveResult.SUCCESS
+        payload = elastic.read_generation_file(str(path))
+        assert payload["chips"] == []
+        assert payload["generation"] > first
+    finally:
+        rig.close()
+
+
+def test_generation_file_disabled_by_default(fake_host):
+    from gpumounter_tpu.testing.sim import WorkerRig
+    rig = WorkerRig(fake_host, n_chips=4)
+    try:
+        assert rig.sim.settings.mesh_gen_dir == ""
+        outcome = rig.service.add_tpu("workload", "default", 4, True)
+        assert outcome.result == consts.AddResult.SUCCESS
+    finally:
+        rig.close()
+
+
+# -- harness: drain → rebuild → restore resharded ------------------------------
+
+def test_harness_reshapes_without_resetting_the_trajectory():
+    signal = {"gen": 1, "chips": 4}
+    harness = elastic.ElasticHarness(
+        TINY, lambda: signal["gen"], lambda: signal["chips"],
+        optimizer=train_lib.make_optimizer(lr=1e-2),
+        step_factory=full_attn_step_factory).start()
+    try:
+        assert harness.mesh.devices.shape == (1, 4, 1)
+        losses = [harness.train_step(_batch(i)) for i in range(12)]
+        embed_before = np.asarray(
+            jax.device_get(harness.state.params["embed"]))
+        step_before = int(harness.state.step)
+
+        # grow 4 -> 8 devices
+        signal.update(gen=2, chips=8)
+        assert harness.poll() is True
+        assert harness.mesh.devices.shape == (1, 8, 1)
+        # NO reset: the restored parameters are bit-for-bit the drained
+        # ones, just resharded — and the step counter keeps counting
+        embed_after = np.asarray(
+            jax.device_get(harness.state.params["embed"]))
+        np.testing.assert_array_equal(embed_before, embed_after)
+        assert int(harness.state.step) == step_before == 12
+        losses += [harness.train_step(_batch(i)) for i in range(12, 24)]
+        assert int(harness.state.step) == 24
+        assert harness.poll() is False      # no bump, no reshape
+
+        # shrink 8 -> 4 devices, same contract
+        signal.update(gen=3, chips=4)
+        assert harness.poll() is True
+        assert harness.mesh.devices.shape == (1, 4, 1)
+        assert int(harness.state.step) == 24
+        losses += [harness.train_step(_batch(i)) for i in range(24, 36)]
+        # the trajectory went DOWN across both reshapes (training data is
+        # learnable arithmetic sequences; lr tuned for fast descent)
+        assert np.mean(losses[-6:]) < np.mean(losses[:6]), losses
+        assert harness.reshapes == 2
+    finally:
+        harness.close()
+
+
+def test_harness_refuses_impossible_chip_count():
+    signal = {"gen": 1, "chips": 10_000}
+    harness = elastic.ElasticHarness(
+        TINY, lambda: signal["gen"], lambda: signal["chips"],
+        step_factory=full_attn_step_factory)
+    with pytest.raises(RuntimeError, match="attach/visibility mismatch"):
+        harness.start()
+
+
+# -- acceptance e2e: resize a live slice under a training loop -----------------
+
+def _host(tmp_path, i):
+    base = tmp_path / f"node{i}"
+    for sub in ("dev", "proc", "sys/fs/cgroup"):
+        (base / sub).mkdir(parents=True)
+    return HostPaths(dev_root=str(base / "dev"),
+                     proc_root=str(base / "proc"),
+                     sys_root=str(base / "sys"),
+                     cgroup_root=str(base / "sys" / "fs" / "cgroup"),
+                     kubelet_socket=str(base / "pr" / "kubelet.sock"))
+
+
+def _post(url, obj):
+    req = urllib.request.Request(url, data=json.dumps(obj).encode(),
+                                 method="POST")
+    try:
+        resp = urllib.request.urlopen(req, timeout=60)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _target(n):
+    return {"pods": [{"namespace": "default", "pod": f"workload-{i}"}
+                     for i in range(n)], "tpusPerHost": 2}
+
+
+def test_training_loop_rides_slice_resize_end_to_end(tmp_path):
+    """The acceptance flow: a jaxcheck training loop over an attached
+    2-host slice drains, the control plane resizes the slice 2→4 hosts
+    via POST /slice/resize, the loop restores resharded onto the larger
+    mesh and keeps descending — then shrinks back 4→2 likewise. Chips
+    map to virtual CPU devices (2/host × 4 hosts = the suite's 8-device
+    pin); the generation signal is the master's /slicez view."""
+    from gpumounter_tpu.testing.sim import MultiNodeStack
+    stack = MultiNodeStack([_host(tmp_path, i) for i in range(4)],
+                           n_chips=2)
+    harness = None
+    try:
+        status, body = _post(f"{stack.base}/addtpuslice", _target(2))
+        assert status == 200, body
+        group = body["group"]
+        signal = elastic.MasterSliceSignal(stack.base, group)
+        assert signal.generation() == 1
+        assert signal.chips() == 4
+
+        harness = elastic.ElasticHarness(
+            TINY, signal.generation, signal.chips,
+            optimizer=train_lib.make_optimizer(lr=1e-2),
+            step_factory=full_attn_step_factory).start()
+        assert harness.mesh.devices.shape == (1, 4, 1)
+        losses = []
+        for i in range(10):
+            harness.poll()
+            losses.append(harness.train_step(_batch(i)))
+
+        # GROW: the control plane reshapes the slice 2 -> 4 hosts
+        status, body = _post(f"{stack.base}/slice/resize", _target(4))
+        assert status == 200, body
+        assert body["generation"] == 2
+        embed_before = np.asarray(
+            jax.device_get(harness.state.params["embed"]))
+        assert harness.poll() is True       # generation bump observed
+        assert harness.mesh.devices.shape == (1, 8, 1)
+        np.testing.assert_array_equal(
+            embed_before,
+            np.asarray(jax.device_get(harness.state.params["embed"])))
+        assert int(harness.state.step) == 10      # trajectory continues
+        for i in range(10, 20):
+            harness.poll()
+            losses.append(harness.train_step(_batch(i)))
+        assert int(harness.state.step) == 20
+
+        # SHRINK: 4 -> 2 hosts, loop keeps going on the smaller mesh
+        status, body = _post(f"{stack.base}/slice/resize", _target(2))
+        assert status == 200, body
+        assert body["generation"] == 3
+        assert harness.poll() is True
+        assert harness.mesh.devices.shape == (1, 4, 1)
+        for i in range(20, 30):
+            harness.poll()
+            losses.append(harness.train_step(_batch(i)))
+        assert int(harness.state.step) == 30
+        assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+        assert harness.reshapes == 2
+        # ground truth followed the resizes: only hosts 0-1 hold chips
+        for i, rig in enumerate(stack.rigs):
+            assert len(rig.sim.slave_pods()) == (1 if i < 2 else 0)
+    finally:
+        if harness is not None:
+            harness.close()
+        stack.close()

@@ -89,23 +89,42 @@ def test_every_journal_record_writer_emits_an_event():
 
 
 def test_every_admission_outcome_emits_an_event():
-    tree = _parse("master/admission.py")
-    funcs = _functions(tree)
+    # master/slicetxn.py records gang decisions (queue_timeout /
+    # granted_queued) into the same counter — same pairing contract
     offenders = []
-    for name, node in funcs.items():
-        has_decision = False
-        for call in ast.walk(node):
-            if (isinstance(call, ast.Call)
-                    and isinstance(call.func, ast.Attribute)
-                    and call.func.attr == "inc"
-                    and isinstance(call.func.value, ast.Attribute)
-                    and call.func.value.attr == "admission_decisions"):
-                has_decision = True
-        if has_decision and not _emits_event(node):
-            offenders.append(name)
+    for module in ("master/admission.py", "master/slicetxn.py"):
+        funcs = _functions(_parse(module))
+        for name, node in funcs.items():
+            has_decision = False
+            for call in ast.walk(node):
+                if (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "inc"
+                        and isinstance(call.func.value, ast.Attribute)
+                        and call.func.value.attr
+                        == "admission_decisions"):
+                    has_decision = True
+            if has_decision and not _emits_event(node):
+                offenders.append(f"{module}:{name}")
     assert not offenders, \
         f"admission outcomes recorded without a paired lifecycle " \
         f"event in: {offenders}"
+
+
+def test_slice_txn_terminals_emit_events():
+    """Every slice transaction terminal (commit / abort / adoption /
+    hand-back / resize) is a lifecycle-visible transition: the
+    slice_txns_total counter and the event stream must agree on
+    volume."""
+    funcs = _functions(_parse("master/slicetxn.py"))
+    for name in ("SliceTxnManager._commit", "SliceTxnManager._abort",
+                 "SliceTxnManager._hand_back",
+                 "SliceTxnManager._run_adopted",
+                 "SliceTxnManager.resize"):
+        assert name in funcs, f"{name} vanished — update this lint"
+        assert _emits_event(funcs[name]), \
+            f"{name} resolves slice-txn state without emitting a " \
+            "lifecycle event"
 
 
 def test_reclaim_paths_emit_events():

@@ -85,6 +85,79 @@ def test_slice_attach_rolls_back_on_partial_failure(stack):
     assert len(stack.rigs[1].sim.slave_pods()) == 1
 
 
+def test_slice_duplicate_pod_is_400(stack):
+    """A duplicated (namespace, pod) entry would fan out TWO attaches to
+    one pod (double slave pods, a double-counted lease) — rejected
+    precisely, on both slice routes."""
+    dup = {"pods": [{"namespace": "default", "pod": "workload-0"},
+                    {"namespace": "default", "pod": "workload-0"}],
+           "tpusPerHost": 4}
+    for path in ("/addtpuslice", "/removetpuslice", "/slice/resize"):
+        status, body = _post(f"{stack.base}{path}", dup)
+        assert status == 400, (path, body)
+        assert body["result"] == "BadRequest"
+        assert "duplicate pod default/workload-0" in body["message"]
+    # nothing was touched
+    for rig in stack.rigs:
+        assert rig.sim.slave_pods() == []
+
+
+def _label_nodes(stack, topology="4x4", chips=4):
+    """Advertise a multi-host topology on both nodes (num_hosts = 16/4
+    = 4), so a 2-pod slice is a PARTIAL mesh."""
+    from gpumounter_tpu.testing.sim import make_tpu_node
+    for i in range(2):
+        stack.gateway.kube.put_node(make_tpu_node(
+            name=f"node-{i}", accelerator="tpu-v5p-slice",
+            topology=topology, chips=chips))
+
+
+def test_partial_mesh_warns_by_default_but_attaches(stack):
+    _label_nodes(stack)
+    status, body = _post(f"{stack.base}/addtpuslice", SLICE)
+    assert status == 200, body
+    assert body["result"] == "SUCCESS"
+
+
+def test_partial_mesh_under_strict_is_412(stack):
+    _label_nodes(stack)
+    status, body = _post(f"{stack.base}/addtpuslice",
+                         dict(SLICE, strict=True))
+    assert status == 412
+    assert body["result"] == "TopologyMismatch"
+    assert "partial" in body["message"]
+    # pre-fan-out rejection: no host was touched
+    for rig in stack.rigs:
+        assert rig.sim.slave_pods() == []
+
+
+def test_resize_strict_judges_the_full_target_mesh(stack):
+    """Strict on /slice/resize validates the RESULTING membership, not
+    the grow delta: a still-partial target is 412 and nothing moves; the
+    same resize without strict proceeds with the usual warning."""
+    _label_nodes(stack)
+    one = {"pods": [SLICE["pods"][0]], "tpusPerHost": 4}
+    status, body = _post(f"{stack.base}/addtpuslice", one)
+    assert status == 200, body
+    # topology 4x4 spans 4 hosts; a 2-host target is STILL partial
+    status, body = _post(f"{stack.base}/slice/resize",
+                         dict(SLICE, strict=True))
+    assert status == 412
+    assert body["result"] == "TopologyMismatch"
+    assert stack.rigs[1].sim.slave_pods() == []      # nothing moved
+    status, body = _post(f"{stack.base}/slice/resize", SLICE)
+    assert status == 200, body
+    assert body["generation"] == 2
+    assert len(stack.rigs[1].sim.slave_pods()) == 1
+
+
+def test_strict_non_boolean_is_400(stack):
+    status, body = _post(f"{stack.base}/addtpuslice",
+                         dict(SLICE, strict="yes"))
+    assert status == 400
+    assert body["result"] == "BadRequest"
+
+
 def test_slice_bad_body_is_400(stack):
     for bad in ({"pods": "nope"}, [], None, {"pods": [{}]},
                 {"pods": SLICE["pods"], "tpusPerHost": None},

@@ -1,0 +1,184 @@
+"""Chaos-pinned slice-transaction atomicity (the PR's acceptance bar):
+the leader master is SIGKILL'd after k of n hosts attached — after
+failover, either all n hosts hold chips under ONE slice-group lease or
+all k are rolled back. Zero half-attached slices, zero double-actuation,
+verified against the cross-replica store view."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from gpumounter_tpu.master.admission import BrokerConfig
+from gpumounter_tpu.master.store import SliceTxnRecord
+from gpumounter_tpu.testing.chaos import assert_slice_invariants
+from gpumounter_tpu.testing.sim import MultiMasterStack, WorkerRig
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.config import HostPaths
+
+NS = consts.DEFAULT_POOL_NAMESPACE
+
+SLICE_BODY = json.dumps({
+    "pods": [{"namespace": "default", "pod": "workload-0"},
+             {"namespace": "default", "pod": "workload-1"}],
+    "tpusPerHost": 4}).encode()
+
+
+class _MasterCrash(BaseException):
+    """Simulated master death mid-fan-out. A BaseException on purpose:
+    it must skip every Exception-typed cleanup handler on its way out —
+    no rollback, no terminal txn record, exactly what SIGKILL leaves."""
+
+
+def _host(tmp_path, i):
+    base = tmp_path / f"node{i}"
+    for sub in ("dev", "proc", "sys/fs/cgroup"):
+        (base / sub).mkdir(parents=True)
+    return HostPaths(dev_root=str(base / "dev"),
+                     proc_root=str(base / "proc"),
+                     sys_root=str(base / "sys"),
+                     cgroup_root=str(base / "sys" / "fs" / "cgroup"),
+                     kubelet_socket=str(base / "pr" / "kubelet.sock"))
+
+
+def _stack(tmp_path, queue_timeout_s):
+    rigs = [WorkerRig(_host(tmp_path, i), n_chips=4, node=f"node-{i}",
+                      pod_name=f"workload-{i}") for i in range(2)]
+    return MultiMasterStack(
+        rigs=rigs, masters=2, shards=1,
+        broker_config=BrokerConfig(queue_timeout_s=queue_timeout_s,
+                                   tick_interval_s=0.1))
+
+
+def _store_slice_records(kube) -> list[SliceTxnRecord]:
+    from gpumounter_tpu.utils.errors import K8sApiError
+    try:
+        cm = kube.get_config_map(NS, f"{consts.STORE_CONFIGMAP_PREFIX}0")
+    except K8sApiError:
+        return []
+    out = []
+    for key, value in (cm["metadata"].get("annotations") or {}).items():
+        if key.startswith(consts.STORE_SLICE_ANNOTATION_PREFIX):
+            out.append(SliceTxnRecord.from_json(value))
+    return out
+
+
+def _crash_leader_mid_fanout(stack, leader):
+    """Run the slice attach on the leader and kill it between hosts:
+    workload-0's host lands (commit marker persisted), workload-1's
+    never starts. Returns once the crash has happened."""
+    gateway = stack.gateways[leader]
+    # freeze the doomed leader's maintenance loop first: a live master
+    # SELF-heals a crashed fan-out thread from its own tick (stranded-
+    # record adoption), but a SIGKILL'd process ticks nothing — the test
+    # must leave the record for the SURVIVOR
+    gateway.broker.stop()
+    crashed = threading.Event()
+
+    def before_host_attach(namespace, pod):
+        if pod != "workload-1":
+            return
+        # let host-0 land AND its commit marker reach the store first —
+        # the crash must leave a record saying exactly who holds chips
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            records = _store_slice_records(stack.kube)
+            if any("default/workload-0" in record.committed
+                   for record in records):
+                break
+            time.sleep(0.01)
+        crashed.set()
+        raise _MasterCrash()
+
+    gateway.slices.before_host_attach = before_host_attach
+
+    def run():
+        try:
+            gateway.handle("POST", "/addtpuslice", SLICE_BODY)
+        except BaseException:   # noqa: BLE001 — the simulated SIGKILL
+            pass
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    assert crashed.wait(timeout=30), "crash point never armed"
+    thread.join(timeout=10)
+    # assert the torn mid-state while the frozen leader still HOLDS the
+    # lock (no peer may adopt yet): exactly one unresolved txn record
+    # with host-0's commit marker — the breadcrumb the survivor adopts —
+    # and exactly host-0 holding chips. Then kill the leader.
+    records = _store_slice_records(stack.kube)
+    assert len(records) == 1
+    assert records[0].committed == ["default/workload-0"]
+    assert len(stack.rigs[0].sim.slave_pods()) == 1
+    assert stack.rigs[1].sim.slave_pods() == []
+    stack.kill(leader)
+    return records[0]
+
+
+def _wait(predicate, timeout_s=20.0, message=""):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(message or "condition never held")
+
+
+def test_leader_killed_mid_fanout_survivor_completes_the_slice(tmp_path):
+    """Deadline still open at failover ⇒ the survivor finishes the
+    fan-out under the ORIGINAL rid: host-0 re-runs as an idempotent
+    resume (no double actuation), host-1 attaches fresh, and one
+    slice-group lease spans both — all n hosts, exactly once."""
+    stack = _stack(tmp_path, queue_timeout_s=30.0)
+    try:
+        stack.wait_converged()
+        leader = stack.leader_for("default")
+        record = _crash_leader_mid_fanout(stack, leader)
+        survivor = stack.gateways[next(iter(stack.live()))]
+        _wait(lambda: not _store_slice_records(stack.kube),
+              message="survivor never resolved the stranded slice txn")
+        _wait(lambda: len(survivor.broker.leases.group_leases(
+            record.txn_id)) == 2,
+            message="survivor did not record the slice-group lease")
+        # all n hosts hold chips, exactly one slave pod each
+        for rig in stack.rigs:
+            assert len(rig.sim.slave_pods()) == 1
+        leases = survivor.broker.leases.group_leases(record.txn_id)
+        assert {lease.pod for lease in leases} == {"workload-0",
+                                                   "workload-1"}
+        assert all(lease.chips == 4 for lease in leases)
+        # zero double-actuation: each pod has at most ONE TPUAttached
+        # (the adopted re-run of host-0 records TPUAttachResumed)
+        for rig in stack.rigs:
+            attached = [e for e in rig.sim.kube.events
+                        if e.get("reason") == "TPUAttached"]
+            assert len(attached) <= 1, [e["message"] for e in attached]
+        assert_slice_invariants(survivor.broker,
+                                [rig.sim for rig in stack.rigs],
+                                store=survivor.broker.store)
+    finally:
+        stack.close()
+
+
+def test_leader_killed_mid_fanout_expired_txn_rolls_back(tmp_path):
+    """Deadline already passed at failover ⇒ the survivor rolls every
+    member back via the txn-targeted detach: zero half-attached slices,
+    host-0's chips drain back to the pool."""
+    stack = _stack(tmp_path, queue_timeout_s=0.0)
+    try:
+        stack.wait_converged()
+        leader = stack.leader_for("default")
+        _crash_leader_mid_fanout(stack, leader)
+        survivor = stack.gateways[next(iter(stack.live()))]
+        _wait(lambda: not _store_slice_records(stack.kube),
+              message="survivor never resolved the stranded slice txn")
+        _wait(lambda: all(not rig.sim.slave_pods()
+                          for rig in stack.rigs),
+              message="rollback left a half-attached slice behind")
+        assert survivor.broker.leases.groups() == {}
+        assert_slice_invariants(survivor.broker,
+                                [rig.sim for rig in stack.rigs],
+                                store=survivor.broker.store)
+    finally:
+        stack.close()

@@ -1,0 +1,539 @@
+"""Elastic slice subsystem (master/slicetxn.py): crash-safe txn records,
+slice-group lease lifecycle (record/renew/expire as a unit), gang
+admission (park, incremental reservation, hand-back, no-deadlock,
+timeout), live resize, cross-shard capacity pokes, and the defaults-off
+parity pin (no knobs ⇒ PR 8 slice semantics, zero ConfigMap traffic)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.k8s import objects
+from gpumounter_tpu.k8s.client import FakeKubeClient
+from gpumounter_tpu.master.admission import BrokerConfig
+from gpumounter_tpu.master.shardring import ShardRing
+from gpumounter_tpu.master.store import IntentStore, SliceTxnRecord
+from gpumounter_tpu.testing.chaos import assert_slice_invariants
+from gpumounter_tpu.testing.sim import MultiNodeStack
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.config import HostPaths
+
+NS = consts.DEFAULT_POOL_NAMESPACE
+
+
+def _host(tmp_path, i):
+    base = tmp_path / f"node{i}"
+    for sub in ("dev", "proc", "sys/fs/cgroup"):
+        (base / sub).mkdir(parents=True)
+    return HostPaths(dev_root=str(base / "dev"),
+                     proc_root=str(base / "proc"),
+                     sys_root=str(base / "sys"),
+                     cgroup_root=str(base / "sys" / "fs" / "cgroup"),
+                     kubelet_socket=str(base / "pr" / "kubelet.sock"))
+
+
+def _post(url, obj):
+    req = urllib.request.Request(url, data=json.dumps(obj).encode(),
+                                 method="POST")
+    try:
+        resp = urllib.request.urlopen(req, timeout=60)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _slice_body(n, tpus=4, **extra):
+    body = {"pods": [{"namespace": "default", "pod": f"workload-{i}"}
+                     for i in range(n)],
+            "tpusPerHost": tpus}
+    body.update(extra)
+    return body
+
+
+# -- SliceTxnRecord round trips ------------------------------------------------
+
+def txn_record(**over):
+    fields = dict(txn_id="txn-abc123", rid="rid-9", tenant="teamA",
+                  priority="high",
+                  pods=["default/w-0", "default/w-1"],
+                  tpus_per_host=4, committed=["default/w-0"],
+                  created_unix=1000.0, deadline_unix=1030.0,
+                  group="txn-original")
+    fields.update(over)
+    return SliceTxnRecord(**fields)
+
+
+def test_slice_txn_record_survives_cas_write_byte_identically():
+    kube = FakeKubeClient()
+    store = IntentStore(kube, ShardRing(1), NS)
+    record = txn_record()
+    assert store.put_slice_txn(record)
+    records, torn = store.rehydrate_slice_txns(0)
+    assert torn == 0
+    assert len(records) == 1
+    assert records[0].to_json() == record.to_json()
+    assert records[0].members() == [("default", "w-0"), ("default", "w-1")]
+    # waiter/lease rehydrate must NOT pick slice records up
+    leases, waiters, torn = store.rehydrate(0)
+    assert (leases, waiters, torn) == ([], [], 0)
+    assert store.delete_slice_txn("default", record.txn_id)
+    assert store.rehydrate_slice_txns(0) == ([], 0)
+
+
+def test_torn_slice_txn_record_is_counted_and_dropped():
+    kube = FakeKubeClient()
+    store = IntentStore(kube, ShardRing(1), NS)
+    store.put_slice_txn(txn_record())
+    name = store.cm_name(0)
+    kube.patch_config_map(NS, name, {"metadata": {"annotations": {
+        consts.STORE_SLICE_ANNOTATION_PREFIX + "deadbeef":
+            '{"txn_id": "half-writ'}}})
+    records, torn = store.rehydrate_slice_txns(0)
+    assert torn == 1
+    assert [r.txn_id for r in records] == ["txn-abc123"]
+
+
+# -- group leases over a live multi-node stack ---------------------------------
+
+@pytest.fixture
+def stack2(tmp_path):
+    """2 nodes × 4 chips behind one master with queueing + short leases
+    enabled (gang + group-lease configuration)."""
+    s = MultiNodeStack(
+        [_host(tmp_path, 0), _host(tmp_path, 1)], n_chips=4,
+        broker_config=BrokerConfig(queue_timeout_s=8.0, gang_hold_s=0.5,
+                                   tick_interval_s=0.1))
+    yield s
+    s.close()
+
+
+def test_slice_attach_records_group_leases(stack2):
+    status, body = _post(f"{stack2.base}/addtpuslice", _slice_body(2))
+    assert status == 200, body
+    group = body["group"]
+    assert group
+    leases = stack2.gateway.broker.leases.group_leases(group)
+    assert len(leases) == 2
+    assert {lease.pod for lease in leases} == {"workload-0", "workload-1"}
+    assert all(lease.chips == 4 for lease in leases)
+    # /slicez serves the group view
+    slicez = _get(f"{stack2.base}/slicez")
+    assert slicez["groups"][group]["chips"] == 8
+    assert slicez["groups"][group]["generation"] == 1
+    assert slicez["txns"]["pending"] == 0
+    assert_slice_invariants(stack2.gateway.broker,
+                            [rig.sim for rig in stack2.rigs])
+
+
+def test_group_renewal_extends_every_member(stack2):
+    broker = stack2.gateway.broker
+    broker.config.lease_ttl_s = 30.0
+    status, body = _post(f"{stack2.base}/addtpuslice", _slice_body(2))
+    assert status == 200, body
+    group = body["group"]
+    members = broker.leases.group_leases(group)
+    before = {lease.key: lease.expires_at for lease in members}
+    time.sleep(0.05)
+    # renewing ONE member pushes every member's expiry out
+    urllib.request.urlopen(urllib.request.Request(
+        f"{stack2.base}/renew/namespace/default/pod/workload-0?ttl=300",
+        method="POST"))
+    for lease in broker.leases.group_leases(group):
+        assert lease.expires_at > before[lease.key] + 200, lease.pod
+
+
+def test_group_expiry_detaches_the_whole_slice(stack2):
+    broker = stack2.gateway.broker
+    broker.config.lease_ttl_s = 0.2
+    status, body = _post(f"{stack2.base}/addtpuslice", _slice_body(2))
+    assert status == 200, body
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        broker.tick()
+        if all(not rig.sim.slave_pods() for rig in stack2.rigs):
+            break
+        time.sleep(0.05)
+    assert all(not rig.sim.slave_pods() for rig in stack2.rigs), \
+        "slice-group expiry left member hosts attached"
+    assert broker.leases.groups() == {}
+    assert_slice_invariants(broker, [rig.sim for rig in stack2.rigs])
+
+
+# -- gang admission ------------------------------------------------------------
+
+def _target_pod(stack, node_index, name):
+    """A mountable extra target pod on one node (fixture container
+    provisioned, visible to both the worker's and the master's kube)."""
+    rig = stack.rigs[node_index]
+    pod = rig.sim.add_target_pod(
+        name=name, uid=f"uid-{name}",
+        container_id="containerd://" + ("%02x" % (node_index + 1)) * 32)
+    rig.provision_container(pod)
+    stack.master_kube.put_pod(pod)
+    return pod
+
+
+def _block_node(stack, node_index, chips=4, name="blocker"):
+    """Occupy a node's chips via the per-pod route (a non-slice tenant)."""
+    _target_pod(stack, node_index, name)
+    with urllib.request.urlopen(
+            f"{stack.base}/addtpu/namespace/default/pod/{name}"
+            f"/tpu/{chips}/isEntireMount/true") as resp:
+        assert resp.status == 200
+    return name
+
+
+def test_gang_parks_and_completes_when_capacity_frees(stack2):
+    _block_node(stack2, 1)
+    result = {}
+
+    def run():
+        result["r"] = _post(f"{stack2.base}/addtpuslice", _slice_body(2))
+
+    t = threading.Thread(target=run)
+    t.start()
+    # the gang must be parked (not failed fast) with host-0 reserved
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with stack2.gateway.broker._lock:
+            gangs = [w for w in stack2.gateway.broker._waiters if w.gang]
+        if gangs and stack2.rigs[0].sim.slave_pods():
+            break
+        time.sleep(0.02)
+    assert gangs, "slice over capacity failed fast instead of parking"
+    assert len(stack2.rigs[0].sim.slave_pods()) == 1, \
+        "gang did not keep the available host as an incremental " \
+        "reservation"
+    # free node-1: the gang should wake and complete
+    _post(f"{stack2.base}/removetpu/namespace/default/pod/blocker"
+          "/force/false", {})
+    t.join(timeout=20)
+    assert not t.is_alive()
+    status, body = result["r"]
+    assert status == 200, body
+    assert body["result"] == "SUCCESS"
+    assert body["queued_s"] > 0
+    assert len(stack2.gateway.broker.leases.group_leases(body["group"])) \
+        == 2
+    assert_slice_invariants(stack2.gateway.broker,
+                            [rig.sim for rig in stack2.rigs])
+
+
+def test_gang_timeout_rolls_back_reservations(tmp_path):
+    stack = MultiNodeStack(
+        [_host(tmp_path, 0), _host(tmp_path, 1)], n_chips=4,
+        broker_config=BrokerConfig(queue_timeout_s=1.5, gang_hold_s=0.4,
+                                   tick_interval_s=0.1))
+    try:
+        _block_node(stack, 1)
+        t0 = time.monotonic()
+        status, body = _post(f"{stack.base}/addtpuslice", _slice_body(2))
+        assert status == 503, body
+        assert body["result"] == "SliceAttachFailed"
+        assert body["queue_timeout"] is True
+        assert body["queued_s"] > 0
+        assert body["retry_after_s"] >= 0.1
+        assert time.monotonic() - t0 >= 1.4
+        # the hold deadline (0.4s) fired before the queue deadline: the
+        # reserved host was handed back mid-wait, and the terminal
+        # rollback leaves nothing anywhere
+        assert stack.rigs[0].sim.slave_pods() == []
+        assert len(stack.rigs[1].sim.slave_pods()) == 1   # the blocker
+        assert stack.gateway.broker.leases.groups() == {}
+        from gpumounter_tpu.utils.metrics import REGISTRY
+        assert REGISTRY.slice_txns.value(outcome="handback") >= 1
+        assert_slice_invariants(stack.gateway.broker,
+                                [rig.sim for rig in stack.rigs])
+    finally:
+        stack.close()
+
+
+def test_two_competing_gangs_do_not_deadlock(tmp_path):
+    """Two gangs each needing BOTH nodes: partial holds + the hold
+    deadline + baton passing must converge — one wins all hosts, the
+    loser answers 503 with queued_s. No deadlock, no leaked chips."""
+    stack = MultiNodeStack(
+        [_host(tmp_path, 0), _host(tmp_path, 1)], n_chips=4,
+        broker_config=BrokerConfig(queue_timeout_s=6.0, gang_hold_s=0.4,
+                                   tick_interval_s=0.1))
+    try:
+        # two disjoint pod pairs spanning the same two nodes
+        pairs = {}
+        for gang in ("a", "b"):
+            pods = []
+            for i in range(2):
+                name = f"{gang}-{i}"
+                _target_pod(stack, i, name)
+                pods.append({"namespace": "default", "pod": name})
+            pairs[gang] = pods
+        results = {}
+
+        def run(gang):
+            results[gang] = _post(f"{stack.base}/addtpuslice",
+                                  {"pods": pairs[gang], "tpusPerHost": 4})
+
+        threads = [threading.Thread(target=run, args=(g,))
+                   for g in ("a", "b")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), \
+            "gang deadlock: a slice attach never returned"
+        outcomes = {g: results[g][0] for g in ("a", "b")}
+        assert sorted(outcomes.values()) == [200, 503], outcomes
+        loser = next(g for g, s in outcomes.items() if s == 503)
+        winner = next(g for g, s in outcomes.items() if s == 200)
+        assert results[loser][1]["queued_s"] > 0
+        assert results[loser][1]["rolled_back"] is True
+        group = results[winner][1]["group"]
+        leases = stack.gateway.broker.leases.group_leases(group)
+        assert len(leases) == 2
+        assert_slice_invariants(stack.gateway.broker,
+                                [rig.sim for rig in stack.rigs])
+    finally:
+        stack.close()
+
+
+# -- live resize ---------------------------------------------------------------
+
+@pytest.fixture
+def stack4(tmp_path):
+    """4 nodes × 2 chips — the resize topology."""
+    s = MultiNodeStack(
+        [_host(tmp_path, i) for i in range(4)], n_chips=2,
+        broker_config=BrokerConfig(queue_timeout_s=8.0,
+                                   tick_interval_s=0.1))
+    yield s
+    s.close()
+
+
+def test_resize_grows_and_shrinks_a_live_slice(stack4):
+    status, body = _post(f"{stack4.base}/addtpuslice",
+                         _slice_body(2, tpus=2))
+    assert status == 200, body
+    group = body["group"]
+
+    # grow 2 -> 4 hosts
+    status, body = _post(f"{stack4.base}/slice/resize",
+                         _slice_body(4, tpus=2))
+    assert status == 200, body
+    assert body["group"] == group
+    assert body["generation"] == 2
+    assert len(body["added"]) == 2 and body["removed"] == []
+    leases = stack4.gateway.broker.leases.group_leases(group)
+    assert len(leases) == 4
+    # generation annotation patched on every member pod
+    for i in range(4):
+        pod = stack4.master_kube.get_pod("default", f"workload-{i}")
+        annotations = pod["metadata"].get("annotations") or {}
+        assert annotations.get(consts.MESH_GENERATION_ANNOTATION) == "2"
+    slicez = _get(f"{stack4.base}/slicez")
+    assert slicez["groups"][group]["generation"] == 2
+    assert slicez["groups"][group]["chips"] == 8
+
+    # shrink 4 -> 2 hosts
+    status, body = _post(f"{stack4.base}/slice/resize",
+                         _slice_body(2, tpus=2))
+    assert status == 200, body
+    assert body["generation"] == 3
+    assert len(body["removed"]) == 2
+    assert len(stack4.gateway.broker.leases.group_leases(group)) == 2
+    for i in (2, 3):
+        assert stack4.rigs[i].sim.slave_pods() == []
+    assert_slice_invariants(stack4.gateway.broker,
+                            [rig.sim for rig in stack4.rigs])
+
+
+def test_resize_unknown_group_is_404(stack4):
+    status, body = _post(f"{stack4.base}/slice/resize",
+                         _slice_body(2, tpus=2))
+    assert status == 404
+    assert body["result"] == "SliceNotFound"
+
+
+def test_resize_failed_grow_leaves_slice_and_generation_untouched(stack4):
+    status, body = _post(f"{stack4.base}/addtpuslice",
+                         _slice_body(2, tpus=2))
+    assert status == 200, body
+    group = body["group"]
+    # node-3's chips are taken: growing to 4 hosts cannot complete
+    _block_node(stack4, 3, chips=2)
+    stack4.gateway.broker.config.queue_timeout_s = 0.0   # fail fast
+    status, body = _post(f"{stack4.base}/slice/resize",
+                         _slice_body(4, tpus=2))
+    assert status == 503, body
+    assert len(stack4.gateway.broker.leases.group_leases(group)) == 2
+    slicez = _get(f"{stack4.base}/slicez")
+    assert slicez["groups"][group]["generation"] == 1
+    # the delta hosts hold nothing
+    assert stack4.rigs[2].sim.slave_pods() == []
+
+
+def test_gang_queue_full_rolls_back_reservations(tmp_path):
+    """A gang the queue refuses (429 QueueFull) must resolve its txn
+    before the client hears the refusal: landed hosts roll back, the
+    intent record is deleted — reserved chips cannot outlive a 429."""
+    stack = MultiNodeStack(
+        [_host(tmp_path, 0), _host(tmp_path, 1)], n_chips=4,
+        broker_config=BrokerConfig(queue_timeout_s=5.0, queue_depth=0,
+                                   tick_interval_s=0.1))
+    try:
+        _block_node(stack, 1)
+        status, body = _post(f"{stack.base}/addtpuslice", _slice_body(2))
+        assert status == 429, body
+        assert body["result"] == "QueueFull"
+        # host-0's reservation was rolled back with the refusal
+        assert stack.rigs[0].sim.slave_pods() == []
+        assert stack.gateway.broker.leases.groups() == {}
+        assert_slice_invariants(stack.gateway.broker,
+                                [rig.sim for rig in stack.rigs])
+    finally:
+        stack.close()
+
+
+def test_noop_resize_does_not_bump_generation(stack4):
+    status, body = _post(f"{stack4.base}/addtpuslice",
+                         _slice_body(2, tpus=2))
+    assert status == 200, body
+    group = body["group"]
+    # idempotent re-post of the current membership: no delta, no bump —
+    # a bump would send every elastic job through a pointless reshape
+    status, body = _post(f"{stack4.base}/slice/resize",
+                         _slice_body(2, tpus=2))
+    assert status == 200, body
+    assert body["generation"] == 1
+    assert body["unchanged"] is True
+    assert body["added"] == [] and body["removed"] == []
+    slicez = _get(f"{stack4.base}/slicez")
+    assert slicez["groups"][group]["generation"] == 1
+
+
+def test_generation_survives_registry_loss(stack4):
+    """A master restart/failover loses the in-memory group registry;
+    the generation must come back from the member pods' annotations —
+    or a post-restart resize would re-issue an already-seen generation
+    and the elastic job would never drain."""
+    status, body = _post(f"{stack4.base}/addtpuslice",
+                         _slice_body(2, tpus=2))
+    assert status == 200, body
+    group = body["group"]
+    status, body = _post(f"{stack4.base}/slice/resize",
+                         _slice_body(3, tpus=2))
+    assert status == 200 and body["generation"] == 2
+    # simulate the restart: the registry is gone, annotations survive
+    stack4.gateway.slices._groups.clear()
+    slicez = _get(f"{stack4.base}/slicez")
+    assert slicez["groups"][group]["generation"] == 2
+    stack4.gateway.slices._groups.clear()
+    status, body = _post(f"{stack4.base}/slice/resize",
+                         _slice_body(4, tpus=2))
+    assert status == 200, body
+    assert body["generation"] == 3      # 2 recovered + 1, never back to 2
+
+
+# -- satellite: defaults-off parity --------------------------------------------
+
+def test_defaults_off_slice_semantics_match_pr8(tmp_path):
+    """With every knob off (no store, no queue timeout, no lease TTL):
+    slice attach/detach behaves exactly like PR 8 — immediate fail-fast
+    on contention with clean rollback, per-pod results, and ZERO
+    ConfigMap traffic."""
+    stack = MultiNodeStack([_host(tmp_path, 0), _host(tmp_path, 1)],
+                           n_chips=4)
+    try:
+        status, body = _post(f"{stack.base}/addtpuslice", _slice_body(2))
+        assert status == 200
+        assert body["result"] == "SUCCESS"
+        assert body["rolled_back"] is False
+        assert len(body["pods"]) == 2
+        assert "queued_s" not in body
+        status, body = _post(f"{stack.base}/removetpuslice",
+                             {"pods": _slice_body(2)["pods"]})
+        assert status == 200
+        # contended slice fails FAST (no gang parking without a queue)
+        _block_node(stack, 1)
+        t0 = time.monotonic()
+        status, body = _post(f"{stack.base}/addtpuslice", _slice_body(2))
+        assert status == 503
+        assert body["result"] == "SliceAttachFailed"
+        assert body["rolled_back"] is True
+        assert time.monotonic() - t0 < 5.0
+        assert "queued_s" not in body
+        # the crash-safe txn layer wrote NOTHING: zero ConfigMap traffic
+        assert stack.master_kube.cm_calls == 0
+        for rig in stack.rigs:
+            assert rig.sim.kube.cm_calls == 0
+    finally:
+        stack.close()
+
+
+# -- satellite: cross-shard capacity poke --------------------------------------
+
+def test_release_pokes_peer_shards_and_tick_receives(monkeypatch):
+    """A detach on shard A's leader stamps peer shards' state ConfigMaps;
+    a peer leader's tick observes the moved stamp and opens a retry
+    generation for its parked waiters (ROADMAP open item 1, first half)."""
+    from gpumounter_tpu.master.admission import AttachBroker
+    from gpumounter_tpu.master.election import NullElection
+
+    class _TwoShardElection(NullElection):
+        """Election double: enabled, owns only ``mine``."""
+
+        enabled = True
+
+        def __init__(self, shards, mine):
+            super().__init__(shards)
+            self.mine = mine
+
+        def is_leader(self, shard):
+            return shard == self.mine
+
+        def token(self, shard):
+            return 7 if shard == self.mine else None
+
+        def owned(self):
+            return [self.mine]
+
+    kube = FakeKubeClient()
+    ring = ShardRing(2)
+    election_a = _TwoShardElection(2, 0)
+    election_b = _TwoShardElection(2, 1)
+    store_a = IntentStore(kube, ring, NS, election=election_a)
+    store_b = IntentStore(kube, ring, NS, election=election_b)
+    broker_a = AttachBroker(kube, BrokerConfig())
+    broker_a.bind_ha(store_a, ring, election_a)
+    broker_b = AttachBroker(kube, BrokerConfig())
+    broker_b.bind_ha(store_b, ring, election_b)
+
+    # shard 1's state map must exist for the poke to land on it, and B
+    # must have a baseline observation (first read is baseline, not a
+    # nudge)
+    from gpumounter_tpu.master.store import LeaseRecord
+    ns_b = next(ns for ns in ("default", "team-b", "blue", "green")
+                if ring.shard_of(ns) == 1)
+    store_b.put_lease(LeaseRecord(namespace=ns_b, pod="seed",
+                                  tenant=ns_b, chips=1))
+    assert store_b.check_poke(1) is False      # baseline
+
+    # A frees chips: release() marks the nudge, A's next tick stamps it
+    # (the request thread never pays the peer ConfigMap round trip)
+    broker_a.release("whatever", "pod")
+    assert broker_a._poke_pending is True
+    broker_a.tick()
+    assert broker_a._poke_pending is False
+    assert store_a.poke_peers({0}) == 0        # rate-limited re-poke
+    # B's tick-side check sees the moved stamp exactly once
+    assert store_b.check_poke(1) is True
+    assert store_b.check_poke(1) is False
+    gen_before = broker_b._gen
+    broker_b.signal_capacity()
+    assert broker_b._gen == gen_before + 1

@@ -11,7 +11,7 @@ fails here instead of shipping state a failed-over peer cannot see.
 import ast
 
 from gpumounter_tpu.master import (admission, election, fleet, gateway,
-                                   lease, store)
+                                   lease, slicetxn, store)
 
 from tests.test_retry_lint import (_functions, _names_used,
                                    _referencing_functions)
@@ -113,8 +113,9 @@ def test_waiter_park_and_resolve_sites_persist_intent():
     adopted = _names_used(funcs["AttachBroker._run_adopted"])
     assert "_unpersist_rid" in adopted, \
         "_run_adopted can leave a resolved intent record behind"
-    # parking happens in exactly one place — the persist/unpersist pair
-    # above therefore covers every waiter
+    # parking happens in exactly two places: the single-attach queue
+    # path (persisted as a waiter record above) and the gang path, whose
+    # durable intent is the slice TXN record — pinned below
     appenders = {
         qual.split(".", 1)[0] + "." + qual.split(".")[1]
         for qual, funcdef in funcs.items()
@@ -125,14 +126,38 @@ def test_waiter_park_and_resolve_sites_persist_intent():
                 and isinstance(n.func.value, ast.Attribute)
                 and n.func.value.attr == "_waiters"
                 for n in ast.walk(funcdef))}
-    assert appenders == {"AttachBroker._attach_queued"}, appenders
+    assert appenders == {"AttachBroker._attach_queued",
+                         "AttachBroker.park_gang"}, appenders
+
+
+def test_slice_txn_intent_is_persisted_around_the_fanout():
+    """The crash-safe slice protocol: attach() writes the intent record
+    BEFORE the fan-out (and the per-host marker callback persists as
+    hosts land), every terminal path resolves it (commit deletes, a
+    clean abort deletes, an unclean abort re-persists for re-adoption),
+    and the gang's park site is attach's own loop — no slice waits
+    without a durable record."""
+    funcs = _functions(slicetxn)
+    attach = _names_used(funcs["SliceTxnManager.attach"])
+    assert "_persist_txn" in attach, \
+        "SliceTxnManager.attach fans out without writing its intent"
+    commit = _names_used(funcs["SliceTxnManager._commit"])
+    assert "_unpersist_txn" in commit
+    abort = _names_used(funcs["SliceTxnManager._abort"])
+    assert {"_unpersist_txn", "_persist_txn"} <= abort
+    marker = _names_used(funcs["SliceTxnManager._marker_callback"])
+    assert "_persist_txn" in marker, \
+        "per-host commit markers are not persisted as hosts land"
+    run = _names_used(funcs["SliceTxnManager._run"])
+    assert "park_gang" in run and "unpark_gang" in run, \
+        "the gang park/unpark pair moved out of the txn-scoped loop"
 
 
 def test_configmap_cas_is_confined_to_store_and_election():
     """Only the store (state records) and the election (lock records)
     may write ConfigMaps; a broker/gateway/fleet mutation that bypasses
     them would dodge both the fence check and the CAS discipline."""
-    for module in (admission, lease, gateway, fleet):
+    for module in (admission, lease, gateway, fleet, slicetxn):
         for qual, funcdef in _functions(module).items():
             names = _names_used(funcdef)
             bad = names & {"patch_config_map", "create_config_map",

@@ -26,6 +26,7 @@ Usage (``python -m gpumounter_tpu.cli`` or the ``tpumounterctl`` entry):
     tpumounterctl trace <request-id>
     tpumounterctl doctor [--node my-tpu-node]
     tpumounterctl cachez --master http://<worker>:1201
+    tpumounterctl utilz --master http://<worker>:1201
 
 The master address comes from ``--master`` or ``$TPU_MOUNTER_MASTER``
 (default ``http://127.0.0.1:8080`` — matching a
@@ -492,6 +493,66 @@ def cmd_agentz(args) -> int:
     return rc
 
 
+def cmd_utilz(args) -> int:
+    """Render a worker's /utilz (chip utilization & device-access
+    accounting): per-chip duty cycle + window average, per-lease
+    attribution (chip → slave pod → owner pod), idle flags and the
+    device-open accounting. Exit non-zero on UNATTRIBUTED busy chips —
+    a device in use with no owner attachment on record is access
+    outside the control plane's grants."""
+    try:
+        payload = json.loads(_fetch_text(args.master, "/utilz",
+                                         args.timeout))
+    except TransportError as e:
+        print(f"unreachable: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    except ValueError as e:
+        print(f"bad /utilz payload: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    if not payload.get("enabled"):
+        _emit(payload, args.json,
+              "usage sampler disabled on this target (TPU_USAGE=0 — "
+              "no duty cycles, no device-open accounting)")
+        return 0
+    chips = payload.get("chips") or []
+    busy = sum(1 for c in chips if c.get("busy"))
+    opens = payload.get("opens") or {}
+    lines = [f"node {payload.get('node') or '?'}: {busy}/{len(chips)} "
+             f"chip(s) busy, sampled every {payload.get('interval_s')}s "
+             f"({payload.get('window_samples', 0)} sample(s) held); "
+             f"opens: {opens.get('attributed', 0)} attributed / "
+             f"{opens.get('unattributed', 0)} unattributed"]
+    unattributed = 0
+    for chip in chips:
+        owner = chip.get("owner")
+        flags = []
+        if chip.get("unattributed_busy"):
+            flags.append("UNATTRIBUTED BUSY")
+            unattributed += 1
+        elif not chip.get("busy"):
+            flags.append("idle")
+        via = (f" via {chip['slave_pod']}" if chip.get("slave_pod")
+               else "")
+        lines.append(
+            f"  chip {chip.get('chip')}  {chip.get('device_path')}  "
+            f"duty {100 * float(chip.get('duty') or 0):.0f}% "
+            f"(avg {100 * float(chip.get('avg_duty') or 0):.0f}%)  "
+            f"{owner or 'no owner'}{via}  "
+            f"opens:{chip.get('opens', 0)}"
+            + (f"  [{', '.join(flags)}]" if flags else ""))
+    for owner, agg in sorted((payload.get("owners") or {}).items()):
+        lines.append(
+            f"  lease {owner}: {agg.get('busy_chips')}/{agg.get('chips')}"
+            f" chip(s) busy, avg duty "
+            f"{100 * float(agg.get('avg_duty') or 0):.0f}%")
+    if unattributed:
+        lines.append(f"  WARNING: {unattributed} busy chip(s) with NO "
+                     "owner attachment on record — device access outside "
+                     "the control plane's grants")
+    _emit(payload, args.json, "\n".join(lines))
+    return EXIT_OTHER if unattributed else 0
+
+
 def cmd_fleet(args) -> int:
     """Render the master's /fleetz cluster view: per-node scrape health,
     per-tenant chips in use, top SLO burn, and the merged lifecycle event
@@ -520,7 +581,17 @@ def cmd_fleet(args) -> int:
         chips = n.get("chips") or {}
         chip_str = " ".join(f"{k.lower()}:{v}"
                             for k, v in sorted(chips.items())) or "-"
+        # utilization column (the node's /utilz summary): busy/total
+        # observed chips + mean duty; "-" for sampler-off workers
+        util = n.get("utilization") or {}
+        util_str = (f"{util.get('chips_busy', 0)}/"
+                    f"{util.get('chips_total', 0)} busy "
+                    f"{100 * float(util.get('avg_duty') or 0):.0f}%"
+                    if util else "-")
         extras = []
+        if util.get("unattributed_busy"):
+            extras.append(f"{util['unattributed_busy']} unattributed "
+                          "busy chip(s)")
         if n.get("journal_backlog"):
             extras.append(f"journal backlog {n['journal_backlog']}")
         if n.get("missed_ticks"):
@@ -529,6 +600,7 @@ def cmd_fleet(args) -> int:
             extras.append(n["error"])
         lines.append(
             f"  {node}: {state.upper()}  chips[{chip_str}]  "
+            f"util[{util_str}]  "
             f"events@{n.get('events_seq', 0)}"
             + (f"  [{'; '.join(extras)}]" if extras else ""))
     # HA posture of the answering master (docs/guide/HA.md): its role per
@@ -571,6 +643,22 @@ def cmd_fleet(args) -> int:
     if tenants:
         lines.append("  tenants: " + ", ".join(
             f"{t}={c} chip(s)" for t, c in sorted(tenants.items())))
+    # per-tenant utilization + the idle-lease list (chips held but not
+    # computing — the capacity the broker's idle-aware preemption and
+    # the fractional-sharing roadmap item reclaim/pack)
+    utilization = payload.get("utilization") or {}
+    util_tenants = utilization.get("tenants") or {}
+    if util_tenants:
+        lines.append("  utilization: " + ", ".join(
+            f"{t}={100 * float(agg.get('avg_duty') or 0):.0f}% "
+            f"({agg.get('busy_chips', 0)}/{agg.get('chips', 0)} busy)"
+            for t, agg in sorted(util_tenants.items())))
+    for idle in utilization.get("idle_leases") or []:
+        lines.append(
+            f"  idle lease: {idle.get('namespace')}/{idle.get('pod')} "
+            f"(tenant {idle.get('tenant')}, {idle.get('chips')} chip(s)"
+            + (f" on {idle['node']}" if idle.get("node") else "")
+            + f") idle {idle.get('idle_for_s')}s")
     top = (payload.get("slo") or {}).get("top_burn")
     if top:
         lines.append(f"  top burn: tenant {top.get('tenant')} "
@@ -995,6 +1083,44 @@ def cmd_doctor(args) -> int:
                   f"broker reclaims: {int(expirations)} expired "
                   f"lease(s) auto-detached, {int(preemptions)} "
                   f"preemption(s) — {scope}")
+
+    # Idle leased chips (the utilization plane): CURRENT state — a lease
+    # the broker marked idle holds chips nobody is computing on, counted
+    # against its tenant's quota; WARN with the leases so the operator
+    # can renew-or-release. Windowed mode additionally judges fresh
+    # idle_lease transitions (the events counter), so `--window N` says
+    # whether leases are going idle RIGHT NOW, not just that some are.
+    idle_leases = []
+    if isinstance(brokerz, dict) and "leases" in brokerz:
+        idle_leases = [
+            f"{lease['namespace']}/{lease['pod']} "
+            f"({lease.get('tenant')}, {lease.get('chips')} chip(s), "
+            f"idle {lease.get('idle_s')}s)"
+            for lease in (brokerz.get("leases") or {}).get("leases", [])
+            if lease.get("idle")]
+    idle_gauge = sum(
+        metrics.get("tpumounter_tenant_chips_idle", {}).values()) \
+        if metrics else 0.0
+    if metrics:
+        src = metrics_delta if metrics_delta is not None else metrics
+        scope = (f"in the last {window:g}s" if metrics_delta is not None
+                 else "lifetime")
+        fresh_idle = _counter_total(src, "tpumounter_events_total",
+                                    kind="idle_lease")
+    else:
+        fresh_idle = 0.0
+    if idle_leases or idle_gauge:
+        detail = (", ".join(sorted(idle_leases)) if idle_leases
+                  else f"{int(idle_gauge)} chip(s) "
+                       "(tpumounter_tenant_chips_idle)")
+        windowed = (f"; {int(fresh_idle)} went idle {scope}"
+                    if metrics_delta is not None and fresh_idle else "")
+        check("warn",
+              f"idle leased chips: {detail}{windowed} — held against "
+              "quota with zero observed duty; renew-or-release, or let "
+              "idle-aware preemption reclaim them")
+    elif metrics and metrics.get("tpumounter_tenant_chips_idle"):
+        check("ok", "no leased chips idle past TPU_IDLE_LEASE_S")
 
     # Elastic slice subsystem: a STRANDED slice transaction (intent
     # record older than its deadline that nothing is driving) is a
@@ -1437,6 +1563,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="resident actuation agent health from a worker's health "
              "port (cached ns fds, revalidations, fallbacks)")
     p.set_defaults(fn=cmd_agentz)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser(
+        "utilz",
+        help="chip utilization from a worker's health port: per-chip "
+             "duty cycle, per-lease attribution, idle flags, device-"
+             "open accounting (non-zero exit on unattributed busy "
+             "chips)")
+    p.set_defaults(fn=cmd_utilz)
     _add_common(p, suppress=True)
 
     p = sub.add_parser(

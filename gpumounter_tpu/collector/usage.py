@@ -1,0 +1,493 @@
+"""Worker-side chip usage sampler: duty cycles + device-access accounting.
+
+The control plane so far measured only ITSELF (traces, events, SLO burn)
+— it knew who was *granted* each chip but nothing about what the chip was
+*doing*. Two roadmap items are blocked on exactly that gap: fractional /
+time-sliced sharing (FlexNPU, PAPERS.md) needs utilization per lease so
+the broker can pack, and the eBPF device gate (gpu_ext) needs per-tenant
+audit counters of actual device opens. This module is the measurement
+layer both will stand on:
+
+- a **bounded ring of per-chip samples** (duty cycle 0..1 + busy/open
+  state), taken by a dedicated background thread every
+  ``TPU_USAGE_INTERVAL_S`` seconds — NEVER on an attach/detach request
+  thread (tests/test_usage_lint.py pins that no hot-path module can even
+  reach this one);
+- a **probe seam** (:class:`UsageProbe`): the real path
+  (:class:`FsUsageProbe`) reads per-chip activity from the kernel's own
+  surfaces — a sysfs-style per-device ``usage`` file when the driver
+  exposes one, else open-fd detection through the enumerator's
+  ``device_open_pids`` (the native ``tpuprobe.cc`` hook where the shared
+  library is built, the pure-Python ``/proc/<pid>/fd`` scan otherwise);
+  the sim/fake path (:class:`FakeUsageProbe`) is driven by tests and
+  ``bench.py``;
+- **ownership join**: each sampled chip is attributed to its owner pod —
+  chips held through slave pods resolve slave → owner via the worker's
+  attachment records and the informer's slave-pod labels (an
+  ``owners_fn`` injected by worker/main.py), chips in the pod's own spec
+  attribute directly — so ``GET /utilz`` answers per-chip AND per-owner
+  utilization, the per-lease series the master joins to tenants;
+- **device-open accounting**: every observed idle→busy transition counts
+  one ``tpumounter_device_opens_total{tenant,outcome}`` — attributed to
+  the owner's namespace (the worker's best node-local tenant knowledge),
+  or ``unattributed`` when a device went busy with NO owner on record
+  (access outside the control plane's grants — the audit signal the eBPF
+  gate will enforce on).
+
+``TPU_USAGE=0`` disables the sampler entirely: no thread, no new metric
+series, and every pre-existing endpoint answers byte-for-byte what it
+answered before this module existed.
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import threading
+import time
+
+from gpumounter_tpu.device.model import DeviceState, TPUChip
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+logger = get_logger("collector.usage")
+
+# Duty at or below this is "idle" (float noise guard; real probes report
+# exact 0.0 for an unopened device).
+IDLE_DUTY_EPSILON = 1e-3
+# Ring bound: at the 5 s default interval this holds ~1 h of samples.
+DEFAULT_RING_SIZE = 720
+# Open-fd scans bound the /proc listing so a pid-dense host can't make
+# one sampling pass unbounded (the sampler is off the hot path, but it
+# still shares the node's CPU with workloads).
+MAX_SCAN_PIDS = 4096
+
+
+class UsageProbe(abc.ABC):
+    """One observation of per-chip activity. Implementations return
+    ``{chip uuid: duty fraction 0..1}``; a chip absent from the result is
+    treated as unobserved (no sample recorded for it this pass)."""
+
+    @abc.abstractmethod
+    def sample(self, chips: list[TPUChip]) -> dict[str, float]:
+        """Duty per chip uuid for this instant."""
+
+
+class FakeUsageProbe(UsageProbe):
+    """Settable duties — the sim/fake path tests and bench.py drive."""
+
+    def __init__(self, default_duty: float = 0.0):
+        self.default_duty = default_duty
+        self._duties: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def set_duty(self, uuid: str, duty: float) -> None:
+        with self._lock:
+            self._duties[uuid] = max(0.0, min(1.0, duty))
+
+    def sample(self, chips: list[TPUChip]) -> dict[str, float]:
+        with self._lock:
+            return {c.uuid: self._duties.get(c.uuid, self.default_duty)
+                    for c in chips}
+
+
+class FsUsageProbe(UsageProbe):
+    """The real path: kernel-surface reads on the (fixture or host) tree.
+
+    Per chip, in order of preference:
+
+    1. a sysfs-style per-device utilization file —
+       ``<sys_root>/class/accel/accel<index>/device/usage`` holding an
+       integer percentage (the convention fixture trees script and a
+       driver that exports utilization satisfies);
+    2. open-fd detection: the chip is "busy" (duty 1.0) while any
+       process holds its device node open. ONE enumerator
+       ``device_open_pids`` call over every unprobed chip at once (the
+       native ``tpuprobe.cc`` binding where ``libtpuprobe.so`` is built)
+       narrows the bounded ``/proc`` listing to the handful of HOLDER
+       pids; one pure-Python readlink pass over just those pids then
+       attributes which chip each holds — the fd walk over thousands of
+       pids runs once per pass (natively where possible), never once
+       per chip.
+
+    A boolean open/closed observation is a coarse duty cycle, but it is
+    ground truth about device ACCESS — which is exactly what the open
+    accounting and the idle-lease reclaim signal need; finer duty comes
+    from the sysfs file when the platform provides one.
+    """
+
+    def __init__(self, host, enumerator=None):
+        self.host = host
+        self.enumerator = enumerator
+
+    def _sysfs_duty(self, chip: TPUChip) -> float | None:
+        path = os.path.join(self.host.sys_root, "class", "accel",
+                            f"accel{chip.index}", "device", "usage")
+        try:
+            with open(path) as f:
+                return max(0.0, min(1.0, float(f.read().strip()) / 100.0))
+        except (OSError, ValueError):
+            return None
+
+    def _scan_pids(self) -> list[int]:
+        try:
+            entries = os.listdir(self.host.proc_root)
+        except OSError:
+            return []
+        return [int(e) for e in entries if e.isdigit()][:MAX_SCAN_PIDS]
+
+    def _open_paths(self, pids: list[int],
+                    paths: list[str]) -> set[str]:
+        """Which of ``paths`` some pid in ``pids`` holds open — one
+        readlink pass over the given pids' fd tables, all paths matched
+        together."""
+        targets = set(paths)
+        found: set[str] = set()
+        for pid in pids:
+            fd_dir = os.path.join(self.host.proc_root, str(pid), "fd")
+            try:
+                fds = os.listdir(fd_dir)
+            except OSError:
+                continue
+            for fd in fds:
+                try:
+                    target = os.readlink(os.path.join(fd_dir, fd))
+                except OSError:
+                    continue
+                if target in targets:
+                    found.add(target)
+                    if found == targets:
+                        return found
+        return found
+
+    def sample(self, chips: list[TPUChip]) -> dict[str, float]:
+        out: dict[str, float] = {}
+        fd_chips: list[TPUChip] = []
+        for chip in chips:
+            duty = self._sysfs_duty(chip)
+            if duty is not None:
+                out[chip.uuid] = duty
+            else:
+                fd_chips.append(chip)
+        if fd_chips:
+            pids = self._scan_pids()
+            paths = [c.device_path for c in fd_chips]
+            # the expensive pids x fds walk runs ONCE for all chips —
+            # natively where libtpuprobe is built — yielding the holder
+            # pids; the Python per-path attribution then only walks
+            # those few
+            holders = pids
+            if self.enumerator is not None:
+                try:
+                    holders = self.enumerator.device_open_pids(pids,
+                                                               paths)
+                except OSError:
+                    holders = pids      # degraded: full Python pass
+            open_paths = self._open_paths(holders, paths)
+            for chip in fd_chips:
+                out[chip.uuid] = (1.0 if chip.device_path in open_paths
+                                  else 0.0)
+        return out
+
+
+def slave_owner_resolver(reads, pool_namespace: str, service=None):
+    """Build the sampler's ``owners_fn``: ``{slave pod name: (owner
+    namespace, owner pod)}``. Two sources, cheap-first:
+
+    - the worker's own attachment records (``service.attachment_owners``
+      — in-memory knowledge of every attach THIS process performed);
+    - the informer's cache-served slave-pod listing (owner labels cover
+      attaches that predate this worker process), zero apiserver round
+      trips with the informer wired.
+
+    Both are best-effort: resolution failure degrades chips to
+    unattributed (visible in /utilz and the audit counter), never raises
+    into the sampler loop."""
+    from gpumounter_tpu.k8s import objects
+    from gpumounter_tpu.utils.errors import TPUMounterError
+    selector = (f"{consts.SLAVE_POD_LABEL_KEY}="
+                f"{consts.SLAVE_POD_LABEL_VALUE}")
+
+    def owners() -> dict[str, tuple[str, str]]:
+        out: dict[str, tuple[str, str]] = {}
+        if reads is not None:
+            try:
+                for pod in reads.list_pods(pool_namespace,
+                                           label_selector=selector):
+                    labels = objects.labels(pod)
+                    owner = labels.get(consts.OWNER_POD_LABEL_KEY)
+                    owner_ns = labels.get(consts.OWNER_NAMESPACE_LABEL_KEY)
+                    if owner and owner_ns:
+                        out[objects.name(pod)] = (owner_ns, owner)
+            except TPUMounterError:
+                pass            # degraded to attachment records only
+        if service is not None:
+            out.update(service.attachment_owners())
+        return out
+
+    return owners
+
+
+class ChipUsageSampler:
+    """Bounded-ring sampler + the /utilz snapshot it serves.
+
+    Reads run on the sampler's OWN thread (``start()``) or a test/bench
+    driver calling :meth:`sample_once` — never on a request thread; the
+    health handler serves :meth:`snapshot` from already-collected state.
+    """
+
+    # Inventory-refresh cadence: the kubelet allocation map changes only
+    # on attach/detach — which ALREADY refresh the collector snapshot —
+    # so the sampler's own refresh exists only to catch out-of-band
+    # bindings (a pod scheduled onto the chips directly). Refreshing per
+    # SAMPLE would put a kubelet LIST (and collector-lock contention
+    # with the request path) on every pass; the bench A/B caught exactly
+    # that as a double-digit-ms attach regression at tight intervals.
+    DEFAULT_REFRESH_INTERVAL_S = 30.0
+
+    def __init__(self, collector, probe: UsageProbe, *,
+                 interval_s: float = consts.DEFAULT_USAGE_INTERVAL_S,
+                 ring_size: int = DEFAULT_RING_SIZE,
+                 pool_namespace: str = consts.DEFAULT_POOL_NAMESPACE,
+                 node_name: str = "", owners_fn=None,
+                 refresh_inventory: bool = False,
+                 refresh_interval_s: float = DEFAULT_REFRESH_INTERVAL_S):
+        import collections
+        self.collector = collector
+        self.probe = probe
+        self.interval_s = interval_s
+        self.pool_namespace = pool_namespace
+        self.node_name = node_name
+        # owners_fn() -> {slave pod name: (owner ns, owner pod)}; None =
+        # only directly-bound chips attribute (unit rigs).
+        self.owners_fn = owners_fn
+        # refresh_inventory: re-derive the kubelet allocation map at
+        # most every refresh_interval_s, ahead of the sample using it
+        # (the first sample always refreshes). Production
+        # (worker/main.py) turns it on so ownership tracks the cluster
+        # even without local attach traffic; unit rigs keep the last
+        # snapshot to stay deterministic.
+        self.refresh_inventory = refresh_inventory
+        self.refresh_interval_s = refresh_interval_s
+        self._last_refresh = -float("inf")
+        self._lock = threading.Lock()
+        self._ring: collections.deque[dict] = collections.deque(
+            maxlen=max(16, ring_size))
+        self._samples = 0
+        # uuid -> last observed busy state, for open/close edge
+        # accounting; uuid -> cumulative observed opens for /utilz
+        self._was_busy: dict[str, bool] = {}
+        self._opens: dict[str, int] = {}
+        self._opens_outcomes: dict[str, int] = {"attributed": 0,
+                                                "unattributed": 0}
+        self._exported_chips: set[str] = set()
+        self._loop: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "ChipUsageSampler":
+        if self._loop is None or not self._loop.is_alive():
+            self._stop.clear()
+            self._loop = threading.Thread(target=self._run, daemon=True,
+                                          name="tpumounter-usage")
+            self._loop.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._loop is not None:
+            self._loop.join(timeout=2.0)
+            self._loop = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample_once()
+            except Exception:        # noqa: BLE001 — loop must survive
+                logger.exception("usage sample failed")
+
+    # -- one sampling pass -----------------------------------------------------
+
+    def _resolve_owner(self, chip: TPUChip,
+                       owners: dict[str, tuple[str, str]]
+                       ) -> tuple[str, str] | None:
+        if chip.state is not DeviceState.ALLOCATED or not chip.pod_name:
+            return None
+        if chip.namespace == self.pool_namespace:
+            # held through a slave pod: the grant's real owner is the
+            # pod the slave's labels (or the attach record) name
+            return owners.get(chip.pod_name)
+        return (chip.namespace, chip.pod_name)
+
+    def sample_once(self) -> dict:
+        """Collect one sample; returns the recorded entry (tests assert
+        on it). Runs on the sampler thread or an explicit driver —
+        request threads never call this (pinned by the usage lint)."""
+        if self.refresh_inventory and (
+                time.monotonic() - self._last_refresh
+                >= self.refresh_interval_s):
+            self.collector.update_status()
+            self._last_refresh = time.monotonic()
+        chips = self.collector.chips
+        duties = self.probe.sample(chips)
+        owners = {}
+        if self.owners_fn is not None:
+            try:
+                owners = self.owners_fn() or {}
+            except Exception:    # noqa: BLE001 — attribution degrades,
+                logger.exception("owner resolution failed")  # never dies
+        now = time.time()
+        entry_chips: dict[str, dict] = {}
+        for chip in chips:
+            duty = duties.get(chip.uuid)
+            if duty is None:
+                continue         # unobserved this pass
+            busy = duty > IDLE_DUTY_EPSILON
+            owner = self._resolve_owner(chip, owners)
+            record = {
+                "duty": round(duty, 4),
+                "busy": busy,
+                "device_path": chip.device_path,
+                "slave_pod": (chip.pod_name
+                              if chip.namespace == self.pool_namespace
+                              else ""),
+            }
+            if owner is not None:
+                record["owner"] = f"{owner[0]}/{owner[1]}"
+            entry_chips[chip.uuid] = record
+        entry = {"ts": round(now, 3), "chips": entry_chips}
+        with self._lock:
+            self._ring.append(entry)
+            self._samples += 1
+            self._account_edges_locked(entry_chips)
+        self._export_gauges(entry_chips)
+        return entry
+
+    def _account_edges_locked(self, chips: dict[str, dict]) -> None:
+        """Open/close accounting: an idle→busy edge is one observed
+        device open (the sampling-resolution view of open(2) on the
+        node; the eBPF gate will later count the exact syscalls)."""
+        for uuid, record in chips.items():
+            was = self._was_busy.get(uuid, False)
+            if record["busy"] and not was:
+                self._opens[uuid] = self._opens.get(uuid, 0) + 1
+                owner = record.get("owner", "")
+                outcome = "attributed" if owner else "unattributed"
+                self._opens_outcomes[outcome] += 1
+                # tenant = the owner pod's namespace: the node cannot
+                # see request-time tenant headers, and namespace is the
+                # broker's default tenant too — the labels agree
+                REGISTRY.device_opens.inc(
+                    tenant=owner.split("/", 1)[0] if owner else "",
+                    outcome=outcome)
+                if not owner:
+                    logger.warning(
+                        "chip %s went busy with NO owner attachment on "
+                        "record (unattributed device access)", uuid)
+            self._was_busy[uuid] = record["busy"]
+
+    def _export_gauges(self, chips: dict[str, dict]) -> None:
+        for uuid, record in chips.items():
+            REGISTRY.chip_duty_cycle.set(record["duty"], chip=uuid)
+        # a chip that vanished from the inventory (hot-unplug) must not
+        # freeze its last duty on /metrics: zero it ONCE, then forget it
+        # (re-zeroing an ever-growing dead set every pass would never
+        # converge)
+        for uuid in self._exported_chips - set(chips):
+            REGISTRY.chip_duty_cycle.set(0.0, chip=uuid)
+        self._exported_chips = set(chips)
+
+    # -- the /utilz view -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The GET /utilz payload: latest per-chip state, window
+        averages, per-owner rollups and the open accounting — everything
+        already collected; serving this performs NO sampling."""
+        with self._lock:
+            ring = list(self._ring)
+            samples = self._samples
+            opens = dict(self._opens)
+            outcomes = dict(self._opens_outcomes)
+        latest = ring[-1] if ring else {"ts": None, "chips": {}}
+        # window averages + last-busy per chip, across the ring
+        sums: dict[str, float] = {}
+        counts: dict[str, int] = {}
+        last_busy: dict[str, float] = {}
+        for entry in ring:
+            for uuid, record in entry["chips"].items():
+                sums[uuid] = sums.get(uuid, 0.0) + record["duty"]
+                counts[uuid] = counts.get(uuid, 0) + 1
+                if record["busy"]:
+                    last_busy[uuid] = entry["ts"]
+        chips_out = []
+        owners_out: dict[str, dict] = {}
+        unattributed_busy = 0
+        for uuid in sorted(latest["chips"]):
+            record = latest["chips"][uuid]
+            owner = record.get("owner", "")
+            avg = (round(sums[uuid] / counts[uuid], 4)
+                   if counts.get(uuid) else 0.0)
+            row = {
+                "chip": uuid,
+                "device_path": record["device_path"],
+                "duty": record["duty"],
+                "avg_duty": avg,
+                "busy": record["busy"],
+                "opens": opens.get(uuid, 0),
+            }
+            if record.get("slave_pod"):
+                row["slave_pod"] = record["slave_pod"]
+            if owner:
+                row["owner"] = owner
+            elif record["busy"]:
+                row["unattributed_busy"] = True
+                unattributed_busy += 1
+            if uuid in last_busy:
+                row["last_busy_unix"] = last_busy[uuid]
+            chips_out.append(row)
+            if owner:
+                agg = owners_out.setdefault(
+                    owner, {"chips": 0, "busy_chips": 0, "duty_sum": 0.0,
+                            "last_busy_unix": None})
+                agg["chips"] += 1
+                agg["busy_chips"] += 1 if record["busy"] else 0
+                agg["duty_sum"] += avg
+                if uuid in last_busy and (
+                        agg["last_busy_unix"] is None
+                        or last_busy[uuid] > agg["last_busy_unix"]):
+                    agg["last_busy_unix"] = last_busy[uuid]
+        for agg in owners_out.values():
+            agg["avg_duty"] = round(agg.pop("duty_sum") / agg["chips"], 4)
+        return {
+            "enabled": True,
+            "node": self.node_name,
+            "interval_s": self.interval_s,
+            "samples": samples,
+            "window_samples": len(ring),
+            "ts": latest["ts"],
+            "chips": chips_out,
+            "owners": owners_out,
+            "unattributed_busy": unattributed_busy,
+            "opens": outcomes,
+        }
+
+
+def build_sampler(service, settings, enumerator=None) -> ChipUsageSampler:
+    """Production wiring (worker/main.py): FsUsageProbe over the host
+    tree + the enumerator's (possibly native) open-fd hook, ownership
+    from attachment records + the informer's slave-pod labels, inventory
+    refreshed per pass."""
+    probe = FsUsageProbe(
+        settings.host,
+        enumerator or service.allocator.collector.enumerator)
+    return ChipUsageSampler(
+        service.allocator.collector, probe,
+        interval_s=settings.usage_interval_s,
+        pool_namespace=settings.pool_namespace,
+        node_name=settings.node_name,
+        owners_fn=slave_owner_resolver(service.reads,
+                                       settings.pool_namespace,
+                                       service=service),
+        refresh_inventory=True)

@@ -54,6 +54,7 @@ from gpumounter_tpu.utils.errors import (K8sApiError, QueueFullError,
                                          QuotaExceededError,
                                          StoreFencedError)
 from gpumounter_tpu.utils.events import EVENTS
+from gpumounter_tpu.utils.flight import RECORDER
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.metrics import REGISTRY
 
@@ -85,6 +86,12 @@ class BrokerConfig:
     # Gang (whole-slice) waiters: how long partially reserved hosts may
     # be held before hand-back (master/slicetxn.py anti-deadlock).
     gang_hold_s: float = consts.DEFAULT_GANG_HOLD_S
+    # Idle-lease threshold: zero observed duty for this long marks the
+    # lease idle (event + doctor WARN + preferred preemption victim).
+    # Only acts while worker utilization telemetry is flowing
+    # (bind_utilization + TPU_USAGE on), so the default is inert
+    # without the sampler.
+    idle_lease_s: float = consts.DEFAULT_IDLE_LEASE_S
     tick_interval_s: float = 1.0
     pool_namespace: str = consts.DEFAULT_POOL_NAMESPACE
     resource_name: str = consts.TPU_RESOURCE_NAME
@@ -97,6 +104,7 @@ class BrokerConfig:
                    queue_timeout_s=settings.queue_timeout_s,
                    queue_depth=settings.queue_depth,
                    gang_hold_s=settings.gang_hold_s,
+                   idle_lease_s=settings.idle_lease_s,
                    pool_namespace=settings.pool_namespace,
                    resource_name=settings.resource_name)
 
@@ -200,6 +208,14 @@ class AttachBroker:
         # the tick stamps the peer shards' capacity poke (request
         # threads never pay the ConfigMap round trip).
         self._poke_pending = False
+        # Utilization feed (bind_utilization): zero-arg callable →
+        # {(namespace, pod): activity dict} from the fleet aggregator's
+        # /utilz scrapes. None = no telemetry, no idle marking — the
+        # pre-sampler behavior exactly.
+        self._activity_fn = None
+        # tenants ever exported on tenant_chips_idle, so a tenant whose
+        # idle leases resolved resets to 0 instead of freezing
+        self._idle_tenants: set[str] = set()
 
     def bind(self, detach_fn) -> None:
         """``detach_fn(lease, cause, force) -> result name`` — the
@@ -225,6 +241,14 @@ class AttachBroker:
         group-lease expiry/preemption detach whole slices through it,
         and shard rehydration hands it stranded txn records to adopt."""
         self._slice = manager
+
+    def bind_utilization(self, activity_fn) -> None:
+        """Wire the fleet aggregator's per-lease activity feed
+        (``FleetAggregator.lease_activity``): the broker tick joins it
+        to the lease table to mark leases idle past
+        ``TPU_IDLE_LEASE_S`` — the reclaim signal and the preemption
+        victim preference."""
+        self._activity_fn = activity_fn
 
     # -- sharding / ownership --------------------------------------------------
 
@@ -963,10 +987,16 @@ class AttachBroker:
             candidates.append(lease)
         if not candidates:
             return None
-        # lowest priority first; among equals the NEWEST over-quota grant
-        # goes first (the most recently borrowed capacity is returned)
+        # lowest priority first; within a priority IDLE leases go before
+        # busy ones (reclaiming a chip nobody is computing on costs the
+        # victim nothing — the whole point of measuring utilization);
+        # among equals the NEWEST over-quota grant is returned first
+        # (the most recently borrowed capacity)
         return min(candidates,
-                   key=lambda le: (le.priority_rank(), -le.created_unix))
+                   key=lambda le: (le.priority_rank(),
+                                   0 if le.idle_since_unix is not None
+                                   else 1,
+                                   -le.created_unix))
 
     def _resolve_lease_node(self, lease: Lease) -> None:
         """Re-derived leases carry no node until asked; one GET fills it
@@ -1087,11 +1117,87 @@ class AttachBroker:
         if self._slice is not None:
             # stranded slice-txn adoption + slice gauges
             self._slice.tick()
+        # idle-lease marking from the utilization feed (collector/
+        # usage.py → fleet scrapes → here): leases whose chips showed
+        # zero duty past the threshold become reclaim candidates
+        self._mark_idle_leases()
         with self._lock:
             self._refresh_queue_gauges_locked()
         self.leases.export_gauges()
         self._export_quota_gauges()
         return reaped
+
+    def _mark_idle_leases(self) -> None:
+        """Join the fleet's observed per-lease activity to the lease
+        table: a lease whose chips have shown zero duty for
+        ``idle_lease_s`` is marked idle (ONE ``idle_lease`` event per
+        transition + a flight-recorder note; a burst of them dumps a
+        bundle), cleared the moment its chips go busy again, and
+        exported as ``tenant_chips_idle{tenant}``. Leases the feed has
+        never observed are left alone — absence of telemetry must never
+        read as idleness."""
+        if self._activity_fn is None or self.config.idle_lease_s <= 0:
+            return
+        try:
+            activity = self._activity_fn() or {}
+        except Exception:    # noqa: BLE001 — telemetry must not kill
+            logger.exception("utilization feed failed")     # the tick
+            return
+        idle_chips: dict[str, int] = {}
+        for lease in self.leases.leases():
+            if not self._owns(lease.namespace):
+                continue
+            act = activity.get((lease.namespace, lease.pod))
+            if act is None:
+                # telemetry gone (worker dead, sampler disabled, entry
+                # aged out): a mark with no current evidence must not
+                # keep steering preemption — clear it; never MARK on
+                # absence either (absence of data is not idleness)
+                lease.idle_since_unix = None
+                continue
+            if act.get("busy_chips", 0) > 0:
+                lease.idle_since_unix = None
+                continue
+            ref = (act.get("last_busy_unix")
+                   or act.get("first_seen_unix"))
+            last_seen = act.get("last_seen_unix")
+            if ref is None or last_seen is None:
+                continue
+            idle_for = last_seen - ref
+            if idle_for < self.config.idle_lease_s:
+                # under the threshold — including a chip that burst busy
+                # BETWEEN scrapes (last_busy_unix advanced while the
+                # instantaneous busy_chips read 0): a previously-idle
+                # lease is active again, un-mark it
+                lease.idle_since_unix = None
+                continue
+            if lease.idle_since_unix is None:
+                # transition: the event names the reclaimable grant;
+                # the flight note turns a BURST of tenants going idle
+                # at once into one correlated bundle
+                lease.idle_since_unix = ref
+                EVENTS.emit("idle_lease", rid=lease.rid,
+                            tenant=lease.tenant,
+                            namespace=lease.namespace, pod=lease.pod,
+                            chips=lease.chips, node=lease.node,
+                            idle_s=round(idle_for, 1))
+                RECORDER.note("idle_lease_burst", rid=lease.rid,
+                              tenant=lease.tenant,
+                              pod=f"{lease.namespace}/{lease.pod}",
+                              idle_s=round(idle_for, 1))
+                logger.warning(
+                    "lease %s/%s (tenant=%s, %d chip(s)) idle for "
+                    "%.0fs — reclaim candidate", lease.namespace,
+                    lease.pod, lease.tenant, lease.chips, idle_for)
+            idle_chips[lease.tenant] = (idle_chips.get(lease.tenant, 0)
+                                        + lease.chips)
+        # current tenants re-exported every pass (gauge = current
+        # state); a tenant whose idle leases all resolved is zeroed
+        # ONCE and then forgotten — not re-zeroed forever
+        for tenant in set(self._idle_tenants) | set(idle_chips):
+            REGISTRY.tenant_chips_idle.set(idle_chips.get(tenant, 0),
+                                           tenant=tenant)
+        self._idle_tenants = set(idle_chips)
 
     def _export_quota_gauges(self) -> None:
         """Per-tenant quota gauge (the usage side lives on the lease
@@ -1201,6 +1307,11 @@ class AttachBroker:
                                    if w.priority == priority)
                      for priority in consts.PRIORITIES}
         usage = self.leases.usage()
+        idle_by_tenant: dict[str, int] = {}
+        for lease in self.leases.leases():
+            if lease.idle_since_unix is not None:
+                idle_by_tenant[lease.tenant] = \
+                    idle_by_tenant.get(lease.tenant, 0) + lease.chips
         tenants = {}
         for tenant in sorted(set(usage)
                              | {t for t in self.config.quotas
@@ -1214,6 +1325,10 @@ class AttachBroker:
                 "pct_of_quota": (round(100.0 * in_use / quota, 1)
                                  if quota else None),
             }
+            if idle_by_tenant.get(tenant):
+                # key present only when chips ARE idle — TPU_USAGE=0
+                # (no idle marking) keeps the payload byte-for-byte
+                tenants[tenant]["idle_chips"] = idle_by_tenant[tenant]
         oldest = max((w["waiting_s"] for w in waiters), default=0.0)
         ha: dict = {"enabled": False}
         if self.ring is not None or self.store is not None:

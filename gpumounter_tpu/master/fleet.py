@@ -69,7 +69,7 @@ class _NodeRecord:
     __slots__ = ("node", "base", "state", "last_ok_unix", "missed_ticks",
                  "error", "healthz", "chips", "journal_backlog",
                  "cache_staleness_s", "events_seq", "events_boot",
-                 "events_dropped", "version", "inflight")
+                 "events_dropped", "version", "inflight", "utilz")
 
     def __init__(self, node: str, base: str):
         self.node = node
@@ -86,6 +86,9 @@ class _NodeRecord:
         self.events_boot = ""        # worker incarnation the cursor is for
         self.events_dropped = 0
         self.version = ""
+        # chip-utilization summary from the node's /utilz (None until the
+        # first successful scrape of a sampler-enabled worker)
+        self.utilz: dict | None = None
         # single-flight guard: at most ONE scrape thread per node, ever —
         # a wedged scrape (connectable but dripping bytes) must not stack
         # a new thread per tick racing the record's cursor/state
@@ -106,6 +109,8 @@ class _NodeRecord:
         }
         if self.version:
             out["version"] = self.version
+        if self.utilz is not None:
+            out["utilization"] = dict(self.utilz)
         if self.error:
             out["error"] = self.error
         if self.events_dropped:
@@ -125,10 +130,14 @@ class FleetAggregator:
     def __init__(self, targets_fn, usage_fn=None, slo=None,
                  tick_interval_s: float = DEFAULT_TICK_INTERVAL_S,
                  scrape_timeout_s: float = SCRAPE_TIMEOUT_S,
-                 ha_fn=None):
+                 ha_fn=None, lease_lookup=None):
         self.targets_fn = targets_fn
         self.usage_fn = usage_fn or (lambda: {})
         self.slo = slo
+        # lease_lookup(namespace, pod) -> Lease | None (the broker's
+        # table): joins scraped chip utilization to the tenant that
+        # holds the grant. None = owner-namespace fallback.
+        self.lease_lookup = lease_lookup
         # ha_fn() -> this master replica's HA posture (role per shard,
         # peers from the election lock records, store lag) — the /fleetz
         # section that makes a stuck failover visible in one command.
@@ -147,6 +156,12 @@ class FleetAggregator:
         self._breakers: dict[str, CircuitBreaker] = {}
         self._tail: collections.deque = collections.deque(maxlen=512)
         self._ticks = 0
+        # (namespace, pod) -> per-owner activity derived from /utilz
+        # scrapes: first/last seen, last observed busy, current duty —
+        # what the broker's idle-lease marking consumes (lease_activity)
+        # and the /fleetz utilization section renders.
+        self._activity: dict[tuple[str, str], dict] = {}
+        self._util_tenants: set[str] = set()
         self._loop: threading.Thread | None = None
         self._stop = threading.Event()
 
@@ -179,6 +194,10 @@ class FleetAggregator:
         # process-global registry)
         REGISTRY.fleet_nodes.set(0, state="fresh")
         REGISTRY.fleet_nodes.set(0, state="stale")
+        with self._lock:
+            util_tenants = set(self._util_tenants)
+        for tenant in util_tenants:
+            REGISTRY.lease_utilization.set(0.0, tenant=tenant)
         if self.slo is not None:
             self.slo.reset()
 
@@ -260,6 +279,7 @@ class FleetAggregator:
         if not self._stop.is_set():
             REGISTRY.fleet_nodes.set(fresh, state="fresh")
             REGISTRY.fleet_nodes.set(len(states) - fresh, state="stale")
+            self._export_utilization_gauges()
         # a tick outliving stop() must not re-export burns after
         # stop()'s slo.reset() zeroed them (manual tick()s run with the
         # flag clear, so rigs without the loop still get SLO exports)
@@ -389,9 +409,12 @@ class FleetAggregator:
             if not batch or record.events_seq >= int(events.get("seq")
                                                      or 0):
                 break
-        # journal backlog + informer staleness (best-effort: these
-        # surfaces may be absent on down-level workers)
-        for path, apply in (("/journalz", self._apply_journalz),
+        # journal backlog + informer staleness + chip utilization
+        # (best-effort: these surfaces may be absent on down-level
+        # workers, and /utilz answers {"enabled": false} with the
+        # sampler off)
+        for path, apply in (("/utilz", self._apply_utilz),
+                            ("/journalz", self._apply_journalz),
                             ("/cachez", self._apply_cachez)):
             if time.monotonic() >= budget:
                 break               # keep the prior tick's numbers
@@ -399,6 +422,121 @@ class FleetAggregator:
                 apply(record, json.loads(self._get(record, path)))
             except (urllib.error.URLError, OSError, ValueError):
                 pass
+
+    # activity entries for owners no /utilz scrape has mentioned for
+    # this long are pruned (the lease detached, or the node vanished) —
+    # the map stays bounded by live attachments, not history
+    ACTIVITY_TTL_S = 600.0
+
+    def _apply_utilz(self, record: _NodeRecord, payload: dict) -> None:
+        """Digest one node's /utilz: per-node summary for the fleet
+        table + the per-owner activity map the idle-lease machinery
+        reads. Worker timestamps (last_busy_unix) are wall-clock and
+        assumed comparable across the fleet — the idle threshold is
+        minutes, clock skew is seconds."""
+        if not isinstance(payload, dict) or not payload.get("enabled"):
+            # the node answered but the sampler is off (TPU_USAGE=0 after
+            # a rollout, or a restart without it): a FROZEN pre-rollout
+            # summary rendered as live data is worse than none
+            record.utilz = None
+            return
+        chips = payload.get("chips") or []
+        busy = sum(1 for c in chips if c.get("busy"))
+        duties = [float(c.get("duty") or 0.0) for c in chips]
+        record.utilz = {
+            "chips_total": len(chips),
+            "chips_busy": busy,
+            "avg_duty": (round(sum(duties) / len(duties), 4)
+                         if duties else 0.0),
+            "unattributed_busy": int(payload.get("unattributed_busy")
+                                     or 0),
+        }
+        now = time.time()
+        with self._lock:
+            for owner, info in (payload.get("owners") or {}).items():
+                ns, _, pod = owner.partition("/")
+                if not pod:
+                    continue
+                act = self._activity.setdefault(
+                    (ns, pod), {"first_seen_unix": now,
+                                "last_busy_unix": None})
+                act["last_seen_unix"] = now
+                act["duty"] = float(info.get("avg_duty") or 0.0)
+                act["busy_chips"] = int(info.get("busy_chips") or 0)
+                act["chips"] = int(info.get("chips") or 0)
+                act["node"] = record.node
+                last_busy = info.get("last_busy_unix")
+                if act["busy_chips"] > 0:
+                    act["last_busy_unix"] = now
+                elif last_busy is not None:
+                    act["last_busy_unix"] = max(
+                        act["last_busy_unix"] or 0.0, float(last_busy))
+            stale = [key for key, act in self._activity.items()
+                     if now - act.get("last_seen_unix", now)
+                     > self.ACTIVITY_TTL_S]
+            for key in stale:
+                del self._activity[key]
+
+    def lease_activity(self) -> dict[tuple[str, str], dict]:
+        """Point-in-time copy of the per-owner activity map — the
+        broker's idle-lease marking joins this to its lease table
+        (gateway binds it via ``broker.bind_utilization``)."""
+        with self._lock:
+            return {key: dict(act)
+                    for key, act in self._activity.items()}
+
+    def _utilization_view(self) -> dict:
+        """Per-tenant rollup + currently-idle lease list from the
+        activity map, joined to the broker's lease table when bound.
+        "Idle" HERE means every observed chip of the lease showed zero
+        duty at the latest scrape (visible within ONE fleet tick); the
+        broker applies the TPU_IDLE_LEASE_S threshold before acting."""
+        lookup = self.lease_lookup
+        tenants: dict[str, dict] = {}
+        idle: list[dict] = []
+        for (ns, pod), act in sorted(self.lease_activity().items()):
+            lease = lookup(ns, pod) if lookup is not None else None
+            tenant = lease.tenant if lease is not None else ns
+            agg = tenants.setdefault(
+                tenant, {"chips": 0, "busy_chips": 0, "duty_sum": 0.0,
+                         "idle_chips": 0})
+            chips = act.get("chips", 0)
+            agg["chips"] += chips
+            agg["busy_chips"] += act.get("busy_chips", 0)
+            agg["duty_sum"] += act.get("duty", 0.0) * chips
+            if act.get("busy_chips", 0) == 0 and chips:
+                agg["idle_chips"] += chips
+                ref = (act.get("last_busy_unix")
+                       or act.get("first_seen_unix") or 0.0)
+                entry = {
+                    "namespace": ns, "pod": pod, "tenant": tenant,
+                    "node": act.get("node", ""), "chips": chips,
+                    "idle_for_s": round(
+                        max(0.0, act.get("last_seen_unix", ref) - ref),
+                        1),
+                }
+                if lease is not None:
+                    entry["priority"] = lease.priority
+                idle.append(entry)
+        for agg in tenants.values():
+            chips = agg["chips"]
+            agg["avg_duty"] = (round(agg.pop("duty_sum") / chips, 4)
+                               if chips else 0.0)
+        return {"tenants": tenants, "idle_leases": idle}
+
+    def _export_utilization_gauges(self) -> None:
+        view = self._utilization_view()
+        seen = set(view["tenants"])
+        for tenant, agg in view["tenants"].items():
+            REGISTRY.lease_utilization.set(agg["avg_duty"],
+                                           tenant=tenant)
+        with self._lock:
+            vanished = self._util_tenants - seen
+            self._util_tenants = set(seen)
+        for tenant in vanished:
+            # a tenant whose leases all detached must not freeze its
+            # last utilization on /metrics: zeroed ONCE, then forgotten
+            REGISTRY.lease_utilization.set(0.0, tenant=tenant)
 
     @staticmethod
     def _apply_journalz(record: _NodeRecord, payload: dict) -> None:
@@ -440,6 +578,14 @@ class FleetAggregator:
             "tenants": dict(self.usage_fn()),
             "events": merged,
         }
+        # utilization section only once some worker actually served a
+        # sampler-enabled /utilz: with TPU_USAGE=0 fleet-wide, /fleetz
+        # stays byte-for-byte the pre-sampler payload
+        with self._lock:
+            has_util = bool(self._activity) or any(
+                r.utilz is not None for r in self._nodes.values())
+        if has_util:
+            out["utilization"] = self._utilization_view()
         if self.slo is not None:
             out["slo"] = self.slo.snapshot()
         if self.ha_fn is not None:

@@ -225,7 +225,14 @@ class MasterGateway:
             usage_fn=self.broker.leases.usage,
             slo=self.slo,
             tick_interval_s=fleet_interval,
-            ha_fn=self._ha_view)
+            ha_fn=self._ha_view,
+            # joins scraped chip utilization to the tenant holding the
+            # grant (/fleetz per-tenant utilization + idle-lease list)
+            lease_lookup=self.broker.leases.get)
+        # ...and the reverse direction: the broker tick reads the
+        # fleet's observed per-lease activity to mark leases idle past
+        # TPU_IDLE_LEASE_S (reclaim signal + preemption preference).
+        self.broker.bind_utilization(self.fleet.lease_activity)
         # gRPC target "ip:port" -> base URL of that worker's health/tracez
         # HTTP endpoint. The default follows the worker's fixed convention
         # (health on grpc_port + 1, worker/main.py HEALTH_PORT_OFFSET);

@@ -68,6 +68,13 @@ class Lease:
     # a half-expired slice is useless to the JAX world spanning it.
     # "" = a plain single-host attachment.
     group: str = ""
+    # Idle marking (the utilization plane: collector/usage.py →
+    # master/fleet.py → broker tick): wall-clock time the broker
+    # deemed this lease idle — its chips showed zero observed duty for
+    # TPU_IDLE_LEASE_S. None = busy, or no utilization telemetry
+    # flowing. Idle leases are preferred preemption victims and doctor
+    # WARNs on them.
+    idle_since_unix: float | None = None
 
     @property
     def key(self) -> tuple[str, str]:
@@ -103,6 +110,11 @@ class Lease:
             out["rederived"] = True
         if self.group:
             out["group"] = self.group
+        if self.idle_since_unix is not None:
+            # absent entirely while busy (or with no utilization
+            # telemetry), so TPU_USAGE=0 keeps /brokerz byte-for-byte
+            out["idle"] = True
+            out["idle_s"] = round(time.time() - self.idle_since_unix, 1)
         return out
 
 

@@ -214,7 +214,8 @@ class WorkerRig:
                  use_kubelet_socket=False, node="node-a",
                  pod_name="workload", schedule_delay_s=0.0,
                  kubelet_lag_s=0.0, warm_pool: dict[str, int] | None = None,
-                 informer: bool = False, agent: bool = False):
+                 informer: bool = False, agent: bool = False,
+                 usage=False, usage_interval_s: float = 0.25):
         from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
         from gpumounter_tpu.actuation.mount import TPUMounter
         from gpumounter_tpu.actuation.nsenter import (ProcRootActuator,
@@ -306,6 +307,30 @@ class WorkerRig:
                                        self.sim.kube, self.sim.settings,
                                        pool=self.pool,
                                        journal=self.journal)
+        # Chip usage sampler (collector/usage.py): ``usage="fake"`` gives
+        # a FakeUsageProbe tests script per-chip duties on
+        # (``rig.usage_probe.set_duty``); ``usage="fs"`` the real
+        # FsUsageProbe over the fixture tree (what bench.py runs). The
+        # loop is NOT started — tests drive ``sample_once()`` for
+        # determinism; bench calls ``rig.usage.start()``.
+        self.usage = None
+        self.usage_probe = None
+        if usage:
+            from gpumounter_tpu.collector.usage import (ChipUsageSampler,
+                                                        FakeUsageProbe,
+                                                        FsUsageProbe,
+                                                        slave_owner_resolver)
+            self.usage_probe = (FsUsageProbe(fake_host, self.sim.enumerator)
+                                if usage == "fs" else FakeUsageProbe())
+            self.usage = ChipUsageSampler(
+                self.sim.collector, self.usage_probe,
+                interval_s=usage_interval_s,
+                pool_namespace=self.sim.settings.pool_namespace,
+                node_name=node,
+                owners_fn=slave_owner_resolver(
+                    self.reads, self.sim.settings.pool_namespace,
+                    service=self.service),
+                refresh_inventory=True)
 
     def provision_container(self, pod: objects.Pod,
                             pid: int | None = None) -> dict[str, int]:
@@ -344,6 +369,8 @@ class WorkerRig:
             time.sleep(0.05)
 
     def close(self) -> None:
+        if self.usage is not None:
+            self.usage.stop()
         if self.agent is not None:
             self.agent.stop()
         if self.informer is not None:
@@ -379,6 +406,7 @@ class LiveStack:
         _HealthHandler.journal = rig.service.journal
         _HealthHandler.cache = rig.service.reads
         _HealthHandler.agent = rig.agent
+        _HealthHandler.usage = rig.usage
         self.health_server = start_health_server(0)
         health_port = self.health_server.server_port
         # ``shared_kube=True``: the master reads the SAME fake cluster the
@@ -407,6 +435,7 @@ class LiveStack:
         _HealthHandler.journal = None
         _HealthHandler.cache = None
         _HealthHandler.agent = None
+        _HealthHandler.usage = None
         self.gateway.fleet.stop()
         self.gateway.broker.stop()
         self.http_server.shutdown()
@@ -562,7 +591,7 @@ class MultiNodeStack:
     is ``node-i`` holding pod ``workload-i``."""
 
     def __init__(self, hosts: list, n_chips=4, health: bool = False,
-                 broker_config=None):
+                 broker_config=None, usage=False):
         from gpumounter_tpu.master.admission import AttachBroker
         from gpumounter_tpu.master.discovery import WorkerDirectory
         from gpumounter_tpu.master.gateway import MasterGateway
@@ -580,7 +609,7 @@ class MultiNodeStack:
         self.master_kube = FakeKubeClient()
         for i, host in enumerate(hosts):
             rig = WorkerRig(host, n_chips=n_chips, node=f"node-{i}",
-                            pod_name=f"workload-{i}")
+                            pod_name=f"workload-{i}", usage=usage)
             server, port = build_server(rig.service, port=0,
                                         address="127.0.0.1")
             server.start()
@@ -589,6 +618,7 @@ class MultiNodeStack:
             if health:
                 hs = start_health_server(0, journal=rig.journal,
                                          cache=rig.service.reads,
+                                         usage=rig.usage,
                                          ready=True)
                 self.health_servers.append(hs)
                 health_bases[f"127.0.0.1:{port}"] = \

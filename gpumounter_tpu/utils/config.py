@@ -165,6 +165,16 @@ class Settings:
     # How long a detach may be resolved from the attachment record cached
     # at attach time (validated against the informer's slave-pod view).
     attach_cache_ttl_s: float = consts.DEFAULT_ATTACH_CACHE_TTL_S
+    # Chip usage sampler (collector/usage.py): background per-chip
+    # duty-cycle + device-open accounting served as GET /utilz. ON by
+    # default; TPU_USAGE=0 removes the thread and every new series, so
+    # existing endpoints answer exactly the pre-sampler payloads.
+    usage_enabled: bool = True
+    usage_interval_s: float = consts.DEFAULT_USAGE_INTERVAL_S
+    # Master-side idle-lease threshold (seconds of zero observed duty
+    # before the broker marks a lease idle). Only meaningful while
+    # worker utilization telemetry is flowing.
+    idle_lease_s: float = consts.DEFAULT_IDLE_LEASE_S
     host: HostPaths = dataclasses.field(default_factory=HostPaths)
 
     @classmethod
@@ -244,6 +254,19 @@ class Settings:
             s.enum_cache_ttl_s = consts.DEFAULT_ENUM_CACHE_TTL_S
         if t := env.get(consts.ENV_ATTACH_CACHE_TTL_S):
             s.attach_cache_ttl_s = float(t)
+        s.usage_enabled = env.get(consts.ENV_USAGE, "1") != "0"
+        if t := env.get(consts.ENV_USAGE_INTERVAL_S):
+            s.usage_interval_s = float(t)
+            if s.usage_interval_s <= 0:
+                raise ValueError(
+                    f"{consts.ENV_USAGE_INTERVAL_S} must be > 0 (a zero "
+                    f"interval would busy-spin the sampler thread), got "
+                    f"{t!r}; use {consts.ENV_USAGE}=0 to disable")
+        if t := env.get(consts.ENV_IDLE_LEASE_S):
+            s.idle_lease_s = float(t)
+            if s.idle_lease_s <= 0:
+                raise ValueError(
+                    f"{consts.ENV_IDLE_LEASE_S} must be > 0, got {t!r}")
         if t := env.get(consts.ENV_INFORMER_FENCE_TIMEOUT_S):
             s.informer_fence_timeout_s = float(t)
         if p := env.get("TPU_WORKER_GRPC_PORT"):

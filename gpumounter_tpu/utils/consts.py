@@ -157,6 +157,28 @@ ENV_FLIGHT_INTERVAL_S = "TPU_FLIGHT_INTERVAL_S"
 # Fleet aggregator (master/fleet.py) scrape cadence, default 5 s.
 ENV_FLEET_INTERVAL_S = "TPU_FLEET_INTERVAL_S"
 
+# --- Chip utilization & device-access accounting (collector/usage.py) ---------
+# "1" (default): the worker runs a background chip usage sampler — a
+# bounded ring of per-chip duty-cycle samples plus device-open/close
+# accounting, joined to ownership (chip → slave pod → owner pod) and
+# served as GET /utilz on the health port; the master's fleet aggregator
+# scrapes it into per-lease/per-tenant utilization. "0" disables the
+# sampler entirely: no thread, no new metric series, and every existing
+# endpoint answers byte-for-byte the pre-sampler payloads.
+ENV_USAGE = "TPU_USAGE"
+# Sampling cadence, seconds (the sampler runs on its OWN thread — never
+# on an attach/detach request thread; tests/test_usage_lint.py pins it).
+ENV_USAGE_INTERVAL_S = "TPU_USAGE_INTERVAL_S"
+DEFAULT_USAGE_INTERVAL_S = 5.0
+# Master-side idle-lease threshold, seconds: a lease whose chips have
+# shown zero duty for this long is marked idle (idle_lease event, doctor
+# WARN, /brokerz idle flag) and preferred as a preemption victim over
+# busy leases. Only acts when utilization telemetry is actually flowing
+# (TPU_USAGE on at the workers), so the default changes nothing without
+# the sampler.
+ENV_IDLE_LEASE_S = "TPU_IDLE_LEASE_S"
+DEFAULT_IDLE_LEASE_S = 300.0
+
 # --- Master gateway front (master/httpfront.py) --------------------------------
 # "multiplexed" (default): bounded selector + worker-pool front with
 # HTTP/1.1 keep-alive and connection admission before thread allocation.

@@ -53,11 +53,15 @@ from gpumounter_tpu.utils.log import get_logger
 logger = get_logger("flight")
 
 # Trigger burst thresholds: (count within BURST_WINDOW_S) needed to dump.
-# agent_fallback needs a burst (singles are routine); the rest dump on
-# first occurrence.
+# agent_fallback needs a burst (singles are routine), and so does
+# idle_lease_burst (ONE idle lease is a tenant who stepped out — many at
+# once is a stuck workload class or a dead feed, worth a bundle while
+# the evidence is fresh); the rest dump on first occurrence.
 FALLBACK_BURST = 3
+IDLE_LEASE_BURST = 3
 BURST_WINDOW_S = 60.0
-_THRESHOLDS = {"agent_fallback": FALLBACK_BURST}
+_THRESHOLDS = {"agent_fallback": FALLBACK_BURST,
+               "idle_lease_burst": IDLE_LEASE_BURST}
 
 DEFAULT_MIN_INTERVAL_S = 300.0
 MAX_BUNDLES = 32        # oldest bundles are pruned beyond this

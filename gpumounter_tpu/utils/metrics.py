@@ -578,7 +578,7 @@ class Registry:
         # that first appears at value 1 reads as 0 — the alert would
         # silently miss each trigger's first-ever bundle
         for trigger in ("fast_burn", "agent_fallback", "journal_backlog",
-                        "circuit_open"):
+                        "circuit_open", "idle_lease_burst"):
             self.flight_dumps.inc(0.0, trigger=trigger)
         self.flight_suppressed = Counter(
             "tpumounter_flight_suppressed_total",
@@ -689,6 +689,40 @@ class Registry:
             "tpumounter_fleet_nodes",
             "Workers known to the master's fleet aggregator, by state "
             "(fresh/stale)")
+        # Chip utilization plane (collector/usage.py + master/fleet.py):
+        # the measurement layer the fractional-sharing and eBPF-gate
+        # roadmap items pack/enforce against. duty_cycle is the worker
+        # sampler's latest per-chip observation (0..1);
+        # lease_utilization is the master-side mean duty across a
+        # tenant's LEASED chips; tenant_chips_idle counts leased chips
+        # whose lease the broker has marked idle (zero duty past
+        # TPU_IDLE_LEASE_S — reclaim candidates, doctor WARNs).
+        self.chip_duty_cycle = Gauge(
+            "tpumounter_chip_duty_cycle",
+            "Most recent sampled duty cycle per chip (0 = idle, 1 = "
+            "busy the whole sampling window), by chip id")
+        self.lease_utilization = Gauge(
+            "tpumounter_lease_utilization",
+            "Mean observed duty cycle across a tenant's leased chips "
+            "(0..1), from the fleet aggregator's /utilz scrapes")
+        self.tenant_chips_idle = Gauge(
+            "tpumounter_tenant_chips_idle",
+            "Leased chips whose lease the broker marked idle (zero "
+            "duty past TPU_IDLE_LEASE_S), by tenant — reclaimable "
+            "capacity held against quota")
+        # Device-access accounting (the gpu_ext audit-counter half):
+        # every observed idle→busy transition of a chip's device node is
+        # one "open". outcome=attributed names the owning tenant (the
+        # owner pod's namespace — the worker's best node-local tenant
+        # knowledge); outcome=unattributed means a device went busy with
+        # NO owner attachment on record — access outside the control
+        # plane's grants, the signal the eBPF gate will enforce on.
+        self.device_opens = Counter(
+            "tpumounter_device_opens_total",
+            "Observed chip device-node open transitions, by tenant and "
+            "outcome (attributed/unattributed; unattributed = busy chip "
+            "with no owner on record)")
+        self.device_opens.inc(0.0, tenant="", outcome="unattributed")
         # Identifies the build on every /metrics surface (standard
         # <name>_info pattern: constant 1, the payload is the label).
         from gpumounter_tpu import __version__

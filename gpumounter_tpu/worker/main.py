@@ -39,6 +39,7 @@ class _HealthHandler(BaseHTTPRequestHandler):
     cache = None       # PodCacheReads, set by main() (informer handle)
     agent = None       # ResidentActuationAgent, set when the agent is on
     events = None      # EventLog override; None = the process singleton
+    usage = None       # ChipUsageSampler, set when TPU_USAGE is on
 
     def log_message(self, *args):
         pass
@@ -110,6 +111,19 @@ class _HealthHandler(BaseHTTPRequestHandler):
                               else {"enabled": False}).encode()
             ctype = "application/json"
             code = 200
+        elif self.path == "/utilz":
+            # chip utilization & device-access accounting: per-chip duty
+            # cycle + window average, owner attribution (chip → slave
+            # pod → owner pod), open/close accounting — what the
+            # master's fleet aggregator joins to leases/tenants. Serves
+            # ALREADY-collected sampler state; no sampling runs on this
+            # request thread (tests/test_usage_lint.py pins it).
+            import json
+            usage = type(self).usage
+            body = json.dumps(usage.snapshot() if usage is not None
+                              else {"enabled": False}).encode()
+            ctype = "application/json"
+            code = 200
         elif self.path == "/journalz":
             # attach-journal introspection: backlog of incomplete records
             # (should be 0 outside a crash window) + replay outcomes
@@ -143,7 +157,7 @@ def start_health_server(port: int, **state) -> ThreadingHTTPServer:
     handler = _HealthHandler
     if state:
         unknown = set(state) - {"journal", "cache", "pool", "agent",
-                                "events", "ready"}
+                                "events", "ready", "usage"}
         if unknown:
             raise TypeError(f"unknown health-server state: {unknown}")
         handler = type("_ScopedHealthHandler", (_HealthHandler,), state)
@@ -251,6 +265,21 @@ def main() -> None:
         service.pool = pool
         _HealthHandler.pool = pool
         logger.info("warm pool enabled: %s", settings.warm_pool_sizes)
+    sampler = None
+    if settings.usage_enabled:
+        # chip usage sampler (collector/usage.py): duty cycles + device
+        # open accounting on its OWN thread, served as GET /utilz — the
+        # fleet aggregator's per-lease utilization source. TPU_USAGE=0
+        # removes the thread and every new series.
+        from gpumounter_tpu.collector.usage import build_sampler
+        from gpumounter_tpu.utils.flight import RECORDER
+        sampler = build_sampler(service, settings).start()
+        _HealthHandler.usage = sampler
+        # anomaly bundles on this node answer "what were the chips
+        # DOING" alongside the failing rid's events/traces/journal
+        RECORDER.register_provider("usage", sampler.snapshot)
+        logger.info("usage sampler enabled: interval %.1fs",
+                    settings.usage_interval_s)
     tls = load_tls_config()
     if tls:
         logger.info("worker gRPC TLS enabled (mTLS=%s)",
@@ -265,6 +294,10 @@ def main() -> None:
     finally:
         if pool is not None:
             pool.stop()
+        if sampler is not None:
+            from gpumounter_tpu.utils.flight import RECORDER
+            RECORDER.unregister_provider("usage", sampler.snapshot)
+            sampler.stop()
         if _HealthHandler.agent is not None:
             _HealthHandler.agent.stop()
         reconciler.stop()

@@ -555,6 +555,17 @@ class TPUMountService:
         with self._attach_records_lock:
             self._attach_records.pop((namespace, pod_name), None)
 
+    def attachment_owners(self) -> dict[str, tuple[str, str]]:
+        """{slave pod name: (owner namespace, owner pod)} from the
+        attachment records — the usage sampler's (collector/usage.py)
+        cheap ownership source for chips THIS process attached. Read-only
+        snapshot under the records lock; called from the sampler thread,
+        never the request path."""
+        with self._attach_records_lock:
+            return {slave: key
+                    for key, record in self._attach_records.items()
+                    for slave in record.slaves}
+
     def _resolve_detach_cached(
             self, pod: objects.Pod, pod_name: str, namespace: str,
             uuids: list[str], txn_id: str = ""

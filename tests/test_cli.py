@@ -215,6 +215,13 @@ def test_exposition_round_trip_registry_to_parser():
                           window="5m")
     reg.flight_dumps.inc(trigger="fast_burn")
     reg.fleet_nodes.set(3, state="fresh")
+    # utilization-plane families (ISSUE 10): per-chip duty gauge,
+    # per-tenant lease utilization + idle chips, device-open accounting
+    reg.chip_duty_cycle.set(0.93, chip="0")
+    reg.lease_utilization.set(0.45, tenant="teamA")
+    reg.tenant_chips_idle.set(2, tenant="teamB")
+    reg.device_opens.inc(tenant="teamA", outcome="attributed")
+    reg.device_opens.inc(2, tenant="", outcome="unattributed")
 
     # classic exposition: NO exemplars (the ` # {...}` suffix is a parse
     # error for a real Prometheus scraping text/plain; version=0.0.4) —

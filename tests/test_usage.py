@@ -1,0 +1,499 @@
+"""Chip utilization & device-access accounting plane (ISSUE 10).
+
+Unit coverage for the worker-side sampler (collector/usage.py): probe
+seam, ownership attribution, open/close accounting, /utilz; the fleet
+aggregator's scrape join (per-node + per-tenant utilization, idle-lease
+list); the broker's idle marking + idle-aware preemption preference; and
+the acceptance e2e on the sim stack — two tenants with live leases, one
+goes idle, is flagged fleet-wide, doctor WARNs, and a high-priority
+waiter preempts the idle lease before the busy one.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.collector.usage import (ChipUsageSampler,
+                                            FakeUsageProbe, FsUsageProbe)
+from gpumounter_tpu.master.admission import AttachBroker, BrokerConfig
+from gpumounter_tpu.master.fleet import FleetAggregator
+from gpumounter_tpu.testing.sim import LiveStack, WorkerRig
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+
+def _get_json(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+# -- config knobs --------------------------------------------------------------
+
+def test_usage_knobs_default_on_and_disable():
+    from gpumounter_tpu.utils.config import Settings
+    s = Settings.from_env({})
+    assert s.usage_enabled is True
+    assert s.usage_interval_s == 5.0
+    assert s.idle_lease_s == 300.0
+    assert Settings.from_env({"TPU_USAGE": "0"}).usage_enabled is False
+    s = Settings.from_env({"TPU_USAGE_INTERVAL_S": "1.5",
+                           "TPU_IDLE_LEASE_S": "60"})
+    assert s.usage_interval_s == 1.5 and s.idle_lease_s == 60.0
+    with pytest.raises(ValueError):
+        Settings.from_env({"TPU_USAGE_INTERVAL_S": "0"})
+    with pytest.raises(ValueError):
+        Settings.from_env({"TPU_IDLE_LEASE_S": "-1"})
+
+
+# -- the FsUsageProbe (real path: sysfs file, then open-fd detection) ----------
+
+def test_fs_probe_reads_sysfs_usage_file_and_open_fds(fake_host):
+    from gpumounter_tpu.device.enumerator import PyEnumerator
+    # two fake chips on the fixture tree
+    for i in range(2):
+        with open(os.path.join(fake_host.dev_root, f"accel{i}"), "w"):
+            pass
+    enum = PyEnumerator(fake_host, allow_fake=True)
+    chips = enum.enumerate()
+    assert len(chips) == 2
+    # chip 0: sysfs-style usage file (preferred source)
+    sys_dir = os.path.join(fake_host.sys_root, "class", "accel",
+                           "accel0", "device")
+    os.makedirs(sys_dir)
+    with open(os.path.join(sys_dir, "usage"), "w") as f:
+        f.write("42\n")
+    # chip 1: no sysfs file — open-fd detection: pid 55 holds the node
+    fd_dir = os.path.join(fake_host.proc_root, "55", "fd")
+    os.makedirs(fd_dir)
+    os.symlink(os.path.join(fake_host.dev_root, "accel1"),
+               os.path.join(fd_dir, "3"))
+    probe = FsUsageProbe(fake_host, enum)
+    duties = probe.sample(chips)
+    assert duties[chips[0].uuid] == pytest.approx(0.42)
+    assert duties[chips[1].uuid] == 1.0
+    # fd closed -> idle
+    os.unlink(os.path.join(fd_dir, "3"))
+    assert probe.sample(chips)[chips[1].uuid] == 0.0
+
+
+# -- sampler: attribution, edges, ring, gauges, /utilz -------------------------
+
+@pytest.fixture
+def usage_rig(fake_host):
+    rig = WorkerRig(fake_host, n_chips=4, usage="fake")
+    yield rig
+    rig.close()
+
+
+def test_sampler_attributes_chips_to_owner_and_counts_opens(usage_rig):
+    rig = usage_rig
+    outcome = rig.service.add_tpu("workload", "default", 2, True)
+    assert outcome.result.name == "SUCCESS"
+    uuids = [c.uuid for c in outcome.chips]
+    attributed0 = REGISTRY.device_opens.value(tenant="default",
+                                              outcome="attributed")
+    # idle first: attribution present, nothing busy, no opens
+    entry = rig.usage.sample_once()
+    for uuid in uuids:
+        assert entry["chips"][uuid]["owner"] == "default/workload"
+        assert entry["chips"][uuid]["busy"] is False
+    # busy edge: one open per chip, attributed to the owner namespace
+    for uuid in uuids:
+        rig.usage_probe.set_duty(uuid, 0.8)
+    rig.usage.sample_once()
+    assert REGISTRY.device_opens.value(
+        tenant="default", outcome="attributed") == attributed0 + 2
+    # still busy: no NEW opens (edge accounting, not level)
+    rig.usage.sample_once()
+    assert REGISTRY.device_opens.value(
+        tenant="default", outcome="attributed") == attributed0 + 2
+    # close + reopen: one more edge each
+    for uuid in uuids:
+        rig.usage_probe.set_duty(uuid, 0.0)
+    rig.usage.sample_once()
+    for uuid in uuids:
+        rig.usage_probe.set_duty(uuid, 0.5)
+    rig.usage.sample_once()
+    assert REGISTRY.device_opens.value(
+        tenant="default", outcome="attributed") == attributed0 + 4
+    # duty gauge exports the latest observation per chip
+    assert REGISTRY.chip_duty_cycle.value(chip=uuids[0]) == 0.5
+    snap = rig.usage.snapshot()
+    owner = snap["owners"]["default/workload"]
+    assert owner["chips"] == 2 and owner["busy_chips"] == 2
+    assert snap["opens"]["attributed"] >= 2
+    by_uuid = {c["chip"]: c for c in snap["chips"]}
+    assert by_uuid[uuids[0]]["opens"] == 2
+    assert by_uuid[uuids[0]]["slave_pod"]      # held through a slave pod
+
+
+def test_unattributed_busy_chip_is_flagged_and_counted(usage_rig):
+    rig = usage_rig
+    before = REGISTRY.device_opens.value(tenant="",
+                                         outcome="unattributed")
+    # a FREE chip goes busy: nobody holds a grant for it
+    free_uuid = rig.sim.collector.chips[0].uuid
+    rig.usage_probe.set_duty(free_uuid, 1.0)
+    rig.usage.sample_once()
+    assert REGISTRY.device_opens.value(
+        tenant="", outcome="unattributed") == before + 1
+    snap = rig.usage.snapshot()
+    assert snap["unattributed_busy"] == 1
+    flagged = [c for c in snap["chips"] if c.get("unattributed_busy")]
+    assert [c["chip"] for c in flagged] == [free_uuid]
+
+
+def test_sampler_ring_is_bounded_and_averages_window(usage_rig):
+    rig = usage_rig
+    rig.usage._ring = type(rig.usage._ring)(maxlen=16)   # small window
+    uuid = rig.sim.collector.chips[0].uuid
+    for i in range(40):
+        rig.usage_probe.set_duty(uuid, 1.0 if i % 2 else 0.0)
+        rig.usage.sample_once()
+    snap = rig.usage.snapshot()
+    assert snap["window_samples"] == 16
+    assert snap["samples"] == 40
+    chip = next(c for c in snap["chips"] if c["chip"] == uuid)
+    assert 0.3 <= chip["avg_duty"] <= 0.7
+
+
+def test_utilz_endpoint_serves_snapshot_and_disabled_stub(usage_rig):
+    from gpumounter_tpu.worker.main import start_health_server
+    server = start_health_server(0, usage=usage_rig.usage, ready=True)
+    bare = start_health_server(0, ready=True)
+    try:
+        payload = _get_json(
+            f"http://127.0.0.1:{server.server_port}/utilz")
+        assert payload["enabled"] is True
+        assert payload["interval_s"] == usage_rig.usage.interval_s
+        assert _get_json(
+            f"http://127.0.0.1:{bare.server_port}/utilz") == {
+                "enabled": False}
+    finally:
+        server.shutdown()
+        bare.shutdown()
+
+
+# -- fleet join: per-node summary, activity map, idle list ---------------------
+
+class _FakeLease:
+    def __init__(self, tenant, priority="normal"):
+        self.tenant = tenant
+        self.priority = priority
+
+
+def test_fleet_applies_utilz_and_lists_idle_leases():
+    leases = {("default", "pod-a"): _FakeLease("teamA"),
+              ("default", "pod-b"): _FakeLease("teamB")}
+    fleet = FleetAggregator(lambda: {},
+                            lease_lookup=lambda ns, pod:
+                            leases.get((ns, pod)))
+    record = type("R", (), {"node": "node-0", "utilz": None})()
+    payload = {
+        "enabled": True,
+        "chips": [{"chip": "0", "duty": 0.9, "busy": True},
+                  {"chip": "1", "duty": 0.9, "busy": True},
+                  {"chip": "2", "duty": 0.0, "busy": False},
+                  {"chip": "3", "duty": 0.0, "busy": False}],
+        "unattributed_busy": 0,
+        "owners": {
+            "default/pod-a": {"chips": 2, "busy_chips": 2,
+                              "avg_duty": 0.9,
+                              "last_busy_unix": time.time()},
+            "default/pod-b": {"chips": 2, "busy_chips": 0,
+                              "avg_duty": 0.0, "last_busy_unix": None},
+        },
+    }
+    fleet._apply_utilz(record, payload)
+    assert record.utilz["chips_busy"] == 2
+    assert record.utilz["chips_total"] == 4
+    view = fleet._utilization_view()
+    assert view["tenants"]["teamA"]["busy_chips"] == 2
+    assert view["tenants"]["teamA"]["avg_duty"] == pytest.approx(0.9)
+    assert view["tenants"]["teamB"]["idle_chips"] == 2
+    idle = view["idle_leases"]
+    assert len(idle) == 1 and idle[0]["pod"] == "pod-b"
+    assert idle[0]["tenant"] == "teamB"
+    activity = fleet.lease_activity()
+    assert activity[("default", "pod-a")]["busy_chips"] == 2
+    assert activity[("default", "pod-b")]["last_busy_unix"] is None
+    # a disabled /utilz payload is ignored entirely — and CLEARS a
+    # previously-scraped summary (a worker rolled to TPU_USAGE=0 must
+    # not render frozen pre-rollout numbers as live data)
+    record2 = type("R", (), {"node": "node-1", "utilz": None})()
+    fleet._apply_utilz(record2, {"enabled": False})
+    assert record2.utilz is None
+    fleet._apply_utilz(record, {"enabled": False})
+    assert record.utilz is None
+
+
+# -- broker: idle marking + idle-aware victim preference -----------------------
+
+def _activity(busy: bool, idle_for_s: float = 0.0):
+    now = time.time()
+    return {"busy_chips": 2 if busy else 0, "chips": 2,
+            "duty": 0.9 if busy else 0.0,
+            "first_seen_unix": now - idle_for_s,
+            "last_busy_unix": now if busy else None,
+            "last_seen_unix": now, "node": "node-a"}
+
+
+def test_broker_marks_idle_leases_and_prefers_idle_victims():
+    from gpumounter_tpu.k8s.client import FakeKubeClient
+    from gpumounter_tpu.utils.events import EVENTS
+    broker = AttachBroker(FakeKubeClient(), BrokerConfig(
+        quotas={"teamA": 1, "teamB": 1}, quota_burst=2.0,
+        idle_lease_s=5.0))
+    broker._rederived = True
+    # the soon-idle lease is recorded FIRST (oldest): the pre-existing
+    # newest-grant-first rule alone would pick pod-a, so this pins that
+    # idleness actually outranks recency
+    broker.leases.record("default", "pod-b", "teamB", "normal",
+                         ["2", "3"], node="node-a")
+    time.sleep(0.01)
+    broker.leases.record("default", "pod-a", "teamA", "normal",
+                         ["0", "1"], node="node-a")
+    feed = {("default", "pod-a"): _activity(busy=True),
+            ("default", "pod-b"): _activity(busy=False, idle_for_s=10.0)}
+    broker.bind_utilization(lambda: feed)
+    broker._mark_idle_leases()
+    lease_a = broker.leases.get("default", "pod-a")
+    lease_b = broker.leases.get("default", "pod-b")
+    assert lease_a.idle_since_unix is None
+    assert lease_b.idle_since_unix is not None
+    assert REGISTRY.tenant_chips_idle.value(tenant="teamB") == 2
+    assert REGISTRY.tenant_chips_idle.value(tenant="teamA") == 0
+    assert lease_b.to_json()["idle"] is True
+    assert lease_b.to_json()["idle_s"] >= 0
+    assert "idle" not in lease_a.to_json()
+    events = [e for e in EVENTS.tail(64) if e["kind"] == "idle_lease"]
+    assert any(e.get("pod") == "pod-b" for e in events)
+    # /brokerz: idle chips surfaced per tenant, busy tenants untouched
+    snap = broker.snapshot()
+    assert snap["tenants"]["teamB"]["idle_chips"] == 2
+    assert "idle_chips" not in snap["tenants"]["teamA"]
+    # victim preference: both over quota, same priority — pod-b's grant
+    # is OLDER (the newest-first tiebreak alone would pick pod-a), but
+    # the idle lease goes first
+    waiter = type("W", (), {"tenant": "vip", "priority": "high",
+                            "namespace": "default", "pod": "vip-pod",
+                            "node": "node-a", "rid": "r1"})()
+    victim = broker._pick_victim(waiter)
+    assert victim.pod == "pod-b"
+    # busy again: the mark clears and the gauge returns to zero
+    feed[("default", "pod-b")] = _activity(busy=True)
+    broker._mark_idle_leases()
+    assert broker.leases.get("default",
+                             "pod-b").idle_since_unix is None
+    assert REGISTRY.tenant_chips_idle.value(tenant="teamB") == 0
+
+
+def test_idle_mark_clears_on_burst_between_scrapes_and_lost_feed():
+    """An idle mark must not outlive its evidence: a chip that burst
+    busy BETWEEN scrapes (last_busy advanced, instantaneous busy_chips
+    still 0) drops the lease under the threshold and un-marks it, and a
+    lease whose telemetry vanished entirely is un-marked too — stale
+    idleness must never steer preemption."""
+    from gpumounter_tpu.k8s.client import FakeKubeClient
+    broker = AttachBroker(FakeKubeClient(),
+                          BrokerConfig(idle_lease_s=5.0))
+    broker._rederived = True
+    broker.leases.record("default", "pod-i", "teamI", "normal", ["0"])
+    feed = {("default", "pod-i"): _activity(busy=False,
+                                            idle_for_s=10.0)}
+    broker.bind_utilization(lambda: feed)
+    broker._mark_idle_leases()
+    lease = broker.leases.get("default", "pod-i")
+    assert lease.idle_since_unix is not None
+    # burst between scrapes: busy_chips 0 at the instant, but
+    # last_busy_unix moved to just now -> idle_for below the threshold
+    now = time.time()
+    feed[("default", "pod-i")] = {
+        "busy_chips": 0, "chips": 1, "duty": 0.0,
+        "first_seen_unix": now - 60.0, "last_busy_unix": now - 1.0,
+        "last_seen_unix": now, "node": "node-a"}
+    broker._mark_idle_leases()
+    assert lease.idle_since_unix is None
+    # re-idle past the threshold, then the feed loses the lease
+    feed[("default", "pod-i")] = _activity(busy=False, idle_for_s=10.0)
+    broker._mark_idle_leases()
+    assert lease.idle_since_unix is not None
+    feed.clear()
+    broker._mark_idle_leases()
+    assert lease.idle_since_unix is None
+    assert REGISTRY.tenant_chips_idle.value(tenant="teamI") == 0
+
+
+def test_broker_ignores_unobserved_leases_and_short_idle():
+    from gpumounter_tpu.k8s.client import FakeKubeClient
+    broker = AttachBroker(FakeKubeClient(),
+                          BrokerConfig(idle_lease_s=60.0))
+    broker._rederived = True
+    broker.leases.record("default", "pod-x", "teamX", "normal", ["0"])
+    broker.leases.record("default", "pod-y", "teamY", "normal", ["1"])
+    broker.bind_utilization(lambda: {
+        ("default", "pod-y"): _activity(busy=False, idle_for_s=1.0)})
+    broker._mark_idle_leases()
+    # pod-x: no telemetry — absence of data must never read as idle;
+    # pod-y: idle but under the threshold
+    assert broker.leases.get("default", "pod-x").idle_since_unix is None
+    assert broker.leases.get("default", "pod-y").idle_since_unix is None
+
+
+def test_idle_lease_burst_triggers_one_flight_bundle(tmp_path):
+    from gpumounter_tpu.k8s.client import FakeKubeClient
+    from gpumounter_tpu.utils.flight import RECORDER
+    RECORDER.configure(str(tmp_path), min_interval_s=0.0, settle_s=0.0)
+    try:
+        broker = AttachBroker(FakeKubeClient(), BrokerConfig(
+            idle_lease_s=1.0))
+        broker._rederived = True
+        feed = {}
+        for i in range(3):
+            broker.leases.record("default", f"pod-{i}", f"t{i}",
+                                 "normal", [str(i)])
+            feed[("default", f"pod-{i}")] = _activity(busy=False,
+                                                      idle_for_s=5.0)
+        broker.bind_utilization(lambda: feed)
+        broker._mark_idle_leases()   # 3 transitions >= the burst bar
+        bundles = [n for n in os.listdir(tmp_path)
+                   if "idle_lease_burst" in n]
+        assert len(bundles) == 1
+        with open(tmp_path / bundles[0]) as f:
+            bundle = json.load(f)
+        assert bundle["trigger"] == "idle_lease_burst"
+    finally:
+        RECORDER.configure(None)
+
+
+# -- acceptance e2e: idle tenant flagged fleet-wide and preempted first --------
+
+def test_e2e_idle_lease_flagged_and_preempted_before_busy(fake_host):
+    """ISSUE 10 acceptance: two tenants hold live leases on one node;
+    one goes idle. /utilz attributes per-lease utilization, /fleetz
+    lists the idle lease within ONE fleet tick, doctor WARNs, and a
+    high-priority waiter preempts the IDLE lease while the busy
+    tenant's chips survive."""
+    config = BrokerConfig(quotas={"teamA": 1, "teamB": 1, "vip": 8},
+                          quota_burst=2.0, queue_timeout_s=30.0,
+                          idle_lease_s=0.3)
+    rig = WorkerRig(fake_host, n_chips=4, usage="fake")
+    stack = LiveStack(rig, broker_config=config, shared_kube=True)
+    try:
+        for name in ("pod-a", "pod-b", "vip-pod"):
+            pod = rig.sim.add_target_pod(name=name)
+            rig.provision_container(pod)
+
+        def attach(pod, tenant, priority="normal"):
+            return _get_json(
+                f"{stack.base}/addtpu/namespace/default/pod/{pod}"
+                f"/tpu/2/isEntireMount/true"
+                f"?tenant={tenant}&priority={priority}", timeout=60)
+
+        # the soon-idle tenant attaches FIRST (oldest grant): the
+        # newest-first victim tiebreak alone would reclaim pod-a, so
+        # the preemption below proves idleness outranks recency
+        body_b = attach("pod-b", "teamB")
+        body_a = attach("pod-a", "teamA")
+        assert body_a["result"] == "SUCCESS", body_a
+        assert body_b["result"] == "SUCCESS", body_b
+        # teamA computes, teamB walked away
+        for uuid in body_a["device_ids"]:
+            rig.usage_probe.set_duty(uuid, 0.9)
+        for uuid in body_b["device_ids"]:
+            rig.usage_probe.set_duty(uuid, 0.0)
+        rig.usage.sample_once()
+
+        # /utilz attributes per-lease utilization correctly
+        utilz = rig.usage.snapshot()
+        assert utilz["owners"]["default/pod-a"]["busy_chips"] == 2
+        assert utilz["owners"]["default/pod-b"]["busy_chips"] == 0
+
+        # ONE fleet tick lists the idle lease in /fleetz
+        states = stack.gateway.fleet.tick()
+        assert states == {"node-a": "fresh"}
+        fleetz = _get_json(f"{stack.base}/fleetz")
+        util = fleetz["utilization"]
+        assert util["tenants"]["teamA"]["busy_chips"] == 2
+        assert util["tenants"]["teamB"]["idle_chips"] == 2
+        idle = util["idle_leases"]
+        assert [i["pod"] for i in idle] == ["pod-b"]
+        node_util = fleetz["nodes"]["node-a"]["utilization"]
+        assert node_util["chips_busy"] == 2
+        assert node_util["chips_total"] == 4
+
+        # broker marks the lease idle once past TPU_IDLE_LEASE_S
+        time.sleep(0.4)
+        rig.usage.sample_once()
+        stack.gateway.fleet.tick()
+        stack.gateway.broker.tick()
+        brokerz = _get_json(f"{stack.base}/brokerz")
+        by_pod = {lease["pod"]: lease
+                  for lease in brokerz["leases"]["leases"]}
+        assert by_pod["pod-b"].get("idle") is True
+        assert "idle" not in by_pod["pod-a"]
+
+        # doctor WARNs on the idle lease (rc asserted non-zero, not ==1:
+        # the process-global registry legitimately accumulates earlier
+        # test files' counters, which may add their own checks)
+        from gpumounter_tpu import cli
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli.main(["--master", stack.base, "doctor"])
+        rendered = out.getvalue()
+        assert rc != 0, rendered
+        assert "WARN idle leased chips" in rendered
+        assert "default/pod-b" in rendered
+
+        # the high-priority waiter preempts the IDLE lease, not the
+        # busy one
+        vip = attach("vip-pod", "vip", priority="high")
+        assert vip["result"] == "SUCCESS", vip
+        brokerz = _get_json(f"{stack.base}/brokerz")
+        held = {lease["pod"] for lease in brokerz["leases"]["leases"]}
+        assert "pod-a" in held          # busy tenant untouched
+        assert "pod-b" not in held      # idle tenant reclaimed
+        assert "vip-pod" in held
+
+        # tpumounterctl fleet renders the utilization column
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            cli.main(["--master", stack.base, "fleet"])
+        assert "util[" in out.getvalue()
+    finally:
+        stack.close()
+
+
+def test_usage_off_restores_pre_sampler_payloads(fake_host):
+    """TPU_USAGE=0 semantics: no sampler wired — /utilz answers the
+    disabled stub, /fleetz carries NO utilization section, and lease
+    payloads carry no idle fields (byte-for-byte PR 9)."""
+    rig = WorkerRig(fake_host, n_chips=4)          # usage=False
+    stack = LiveStack(rig, broker_config=BrokerConfig(),
+                      shared_kube=True)
+    try:
+        pod = rig.sim.add_target_pod(name="pod-z")
+        rig.provision_container(pod)
+        body = _get_json(
+            f"{stack.base}/addtpu/namespace/default/pod/pod-z"
+            f"/tpu/2/isEntireMount/true", timeout=60)
+        assert body["result"] == "SUCCESS", body
+        health = f"http://127.0.0.1:{stack.health_server.server_port}"
+        assert _get_json(f"{health}/utilz") == {"enabled": False}
+        stack.gateway.fleet.tick()
+        fleetz = _get_json(f"{stack.base}/fleetz")
+        assert "utilization" not in fleetz
+        assert "utilization" not in fleetz["nodes"]["node-a"]
+        brokerz = _get_json(f"{stack.base}/brokerz")
+        for lease in brokerz["leases"]["leases"]:
+            assert "idle" not in lease and "idle_s" not in lease
+        for tenant in brokerz["tenants"].values():
+            assert "idle_chips" not in tenant
+    finally:
+        stack.close()

@@ -1,0 +1,115 @@
+"""Usage-sampler lint (AST-based, à la test_actuation_lint): sampling
+must stay OFF the attach/detach hot path. The sampler owns its thread;
+request threads may at most serve ALREADY-collected state (/utilz =
+``snapshot()``). These lints pin that:
+
+1. no hot-path module can even import ``collector.usage``;
+2. the request-path methods of the mount service never touch a sampler;
+3. the health handler serves ``snapshot()`` only — no ``sample_once``/
+   ``update_status`` reachable from a health request thread;
+4. the sampler ships ON by default (``TPU_USAGE=0`` reverts), with
+   sampling driven exclusively by its own loop thread.
+"""
+
+import ast
+import inspect
+
+import gpumounter_tpu.actuation.mount as mount_mod
+import gpumounter_tpu.allocator.allocator as allocator_mod
+import gpumounter_tpu.collector.collector as collector_mod
+import gpumounter_tpu.collector.usage as usage_mod
+import gpumounter_tpu.worker.grpc_server as grpc_mod
+import gpumounter_tpu.worker.service as service_mod
+
+# Everything an AddTPU/RemoveTPU request thread executes.
+HOT_PATH_MODULES = (service_mod, grpc_mod, allocator_mod, mount_mod,
+                    collector_mod)
+
+
+def _imports(tree: ast.AST) -> set[str]:
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            out |= {a.name for a in node.names}
+        elif isinstance(node, ast.ImportFrom):
+            out.add(node.module or "")
+    return out
+
+
+def test_no_hot_path_module_imports_the_sampler():
+    offenders = []
+    for module in HOT_PATH_MODULES:
+        tree = ast.parse(inspect.getsource(module))
+        hits = {name for name in _imports(tree) if "usage" in name}
+        if hits:
+            offenders.append(f"{module.__name__}: {sorted(hits)}")
+    assert offenders == [], \
+        f"sampler reachable from the hot path: {offenders}"
+
+
+def test_request_path_methods_never_touch_a_sampler():
+    """The mount service's request-path methods (everything a gRPC
+    request thread runs) must not reference sampler state — sampling is
+    the background thread's job, attribution reads are the sampler's
+    calls INTO the service (attachment_owners), never the reverse."""
+    source = inspect.getsource(service_mod.TPUMountService)
+    tree = ast.parse("class _T:\n" + "\n".join(
+        "    " + line for line in source.splitlines()))
+    request_paths = {"add_tpu", "_add_tpu", "remove_tpu", "_remove_tpu",
+                     "tpu_status", "node_status"}
+    offenders = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef) \
+                or node.name not in request_paths:
+            continue
+        for sub in ast.walk(node):
+            name = (sub.attr if isinstance(sub, ast.Attribute)
+                    else sub.id if isinstance(sub, ast.Name) else "")
+            if name and ("sampler" in name or name == "sample_once"
+                         or name == "usage"):
+                offenders.append(f"{node.name}: {name}")
+    assert offenders == [], \
+        f"request path touches sampler state: {offenders}"
+
+
+def test_health_handler_serves_snapshot_not_sampling():
+    """GET /utilz answers already-collected state: the handler may call
+    ``snapshot()`` but never ``sample_once``/``update_status`` — a
+    scrape must not become a sampling pass on the request thread."""
+    import gpumounter_tpu.worker.main as main_mod
+    source = inspect.getsource(main_mod._HealthHandler)
+    assert "sample_once" not in source
+    assert "update_status" not in source
+    assert ".snapshot()" in source      # the sanctioned read
+
+
+def test_sampling_runs_only_from_the_loop_thread():
+    """Inside collector/usage.py itself, ``sample_once`` is invoked from
+    exactly one place: the sampler's own ``_run`` loop. Everything else
+    (tests, bench) drives it explicitly from outside."""
+    tree = ast.parse(inspect.getsource(usage_mod))
+    callers = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "sample_once":
+                    callers.append(node.name)
+    assert callers == ["_run"], \
+        f"sample_once called outside the loop thread: {callers}"
+
+
+def test_usage_is_the_production_default():
+    from gpumounter_tpu.utils.config import Settings
+    assert Settings().usage_enabled is True
+    assert Settings.from_env({}).usage_enabled is True
+    assert Settings.from_env({"TPU_USAGE": "0"}).usage_enabled is False
+
+
+def test_snapshot_performs_no_probe_or_inventory_reads():
+    """The /utilz serving path (snapshot) must not probe devices or
+    re-derive inventory — it renders the ring the loop filled."""
+    source = inspect.getsource(usage_mod.ChipUsageSampler.snapshot)
+    for forbidden in ("probe.sample", "update_status", "enumerate"):
+        assert forbidden not in source, forbidden

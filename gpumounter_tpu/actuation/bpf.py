@@ -23,7 +23,7 @@ from gpumounter_tpu.utils.log import get_logger
 logger = get_logger("actuation.bpf")
 
 _LIB_NAME = "libbpfgate.so"
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 ACC_MKNOD = 1
 ACC_READ = 2
@@ -97,22 +97,49 @@ def rules_for_chips(chips: list[TPUChip],
     (devices the runtime already granted this container, derived from its
     live /dev — see :func:`container_device_rules`) + chip nodes + their
     companion nodes (VFIO group + container nodes carry their own majmin —
-    without these rules the chip node is visible but unusable)."""
+    without these rules the chip node is visible but unusable).
+
+    Rules agreeing on ``(type, major, minor)`` MERGE their access bits
+    instead of first-wins: an observed narrow rule (e.g. a read-only spec
+    device that happens to share a majmin with a chip grant) must not
+    shadow the chip's rw+mknod — nor the chip grant an operator's wider
+    observed access."""
     rules = list(CONTAINER_DEFAULT_RULES)
-    seen: set[tuple[str, int | None, int | None]] = {
-        (r.dev_type, r.major, r.minor) for r in rules}
-    for rule in observed:
+    index: dict[tuple[str, int | None, int | None], int] = {
+        (r.dev_type, r.major, r.minor): i for i, r in enumerate(rules)}
+
+    def _merge(rule: DeviceRule) -> None:
         key = (rule.dev_type, rule.major, rule.minor)
-        if key not in seen:
-            seen.add(key)
+        at = index.get(key)
+        if at is None:
+            index[key] = len(rules)
             rules.append(rule)
+        elif rules[at].access | rule.access != rules[at].access:
+            rules[at] = dataclasses.replace(
+                rules[at], access=rules[at].access | rule.access)
+
+    for rule in observed:
+        _merge(rule)
     for chip in chips:
         for major, minor in [(chip.major, chip.minor),
                              *((c.major, c.minor) for c in chip.companions)]:
-            if ("c", major, minor) not in seen:
-                seen.add(("c", major, minor))
-                rules.append(DeviceRule("c", ACC_RW | ACC_MKNOD, major, minor))
+            _merge(DeviceRule("c", ACC_RW | ACC_MKNOD, major, minor))
     return rules
+
+
+def chip_majmins(chips: list[TPUChip]) -> list[tuple[int, int]]:
+    """Deduped (major, minor) pairs for chips AND their companion nodes —
+    THE one expansion every consumer (cgroup controller, device gate,
+    replay convergence) must agree on."""
+    out: list[tuple[int, int]] = []
+    seen: set[tuple[int, int]] = set()
+    for chip in chips:
+        for key in [(chip.major, chip.minor),
+                    *((c.major, c.minor) for c in chip.companions)]:
+            if key not in seen:
+                seen.add(key)
+                out.append(key)
+    return out
 
 
 def container_device_rules(proc_root: str, pid: int,
@@ -133,7 +160,10 @@ def container_device_rules(proc_root: str, pid: int,
     Raises OSError when the /dev dir is missing or vanishes mid-walk (the
     PID exited between liveness check and scan) — an unobservable /dev must
     NOT be conflated with an observed-empty one, or the caller would treat
-    it as a valid baseline and silently revoke runtime grants."""
+    it as a valid baseline and silently revoke runtime grants. Hitting
+    ``limit`` raises for the same reason: a PARTIAL baseline composed as
+    ground truth would silently revoke every runtime grant past the cap
+    (the callers' fail-closed/cached-baseline handling applies)."""
     dev_dir = os.path.join(proc_root, str(pid), "root", "dev")
     if not os.path.isdir(dev_dir):
         raise OSError(f"container /dev not readable via {dev_dir}")
@@ -145,11 +175,6 @@ def container_device_rules(proc_root: str, pid: int,
 
     for dirpath, _, filenames in os.walk(dev_dir, onerror=_walk_error):
         for name in sorted(filenames):
-            if len(rules) >= limit:
-                logger.warning("container /dev of pid %d exceeds %d device "
-                               "nodes; truncating observed rule set", pid,
-                               limit)
-                return rules
             path = os.path.join(dirpath, name)
             if name.endswith(".majmin"):
                 continue
@@ -176,6 +201,12 @@ def container_device_rules(proc_root: str, pid: int,
                 continue
             key = (dev_type, major, minor)
             if key not in seen:
+                if len(rules) >= limit:
+                    raise OSError(
+                        f"container /dev of pid {pid} exceeds {limit} "
+                        "device nodes; refusing a truncated baseline that "
+                        "would compose as ground truth and silently "
+                        "revoke grants past the cap")
                 seen.add(key)
                 rules.append(DeviceRule(dev_type, ACC_RWM, major, minor))
     return rules
@@ -216,6 +247,26 @@ class BpfGate:
         self._lib.bpfgate_attach.restype = ctypes.c_int
         self._lib.bpfgate_attach.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(CDeviceRule), ctypes.c_int]
+        # Map-driven gate (PR 12): per-cgroup policy map, in-place updates.
+        self._lib.bpfgate_map_attach.restype = ctypes.c_int
+        self._lib.bpfgate_map_attach.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(CDeviceRule), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int)]
+        self._lib.bpfgate_map_sync.restype = ctypes.c_int
+        self._lib.bpfgate_map_sync.argtypes = [
+            ctypes.c_int, ctypes.POINTER(CDeviceRule), ctypes.c_int]
+        self._lib.bpfgate_map_read.restype = ctypes.c_int
+        self._lib.bpfgate_map_read.argtypes = [
+            ctypes.c_int, ctypes.POINTER(CDeviceRule),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+        self._lib.bpfgate_map_close.restype = ctypes.c_int
+        self._lib.bpfgate_map_close.argtypes = [ctypes.c_int]
+        self._lib.bpfgate_map_recover.restype = ctypes.c_int
+        self._lib.bpfgate_map_recover.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int)]
+        self._lib.bpfgate_build_map_program.restype = ctypes.c_int
+        self._lib.bpfgate_build_map_program.argtypes = [
+            ctypes.c_int, ctypes.POINTER(CBpfInsn), ctypes.c_int]
         self._lib.bpfgate_abi_version.restype = ctypes.c_int
         if self._lib.bpfgate_abi_version() != _ABI_VERSION:
             raise OSError("libbpfgate ABI mismatch")
@@ -264,6 +315,92 @@ class BpfGate:
             raise OSError(
                 f"bpfgate_read_attached({cgroup_path}, {index}): errno {-rc}")
         return list(out[:rc])
+
+    # -- map-driven gate (PR 12) ----------------------------------------------
+    # Outcomes of :meth:`map_attach` (mirror the C layer's return codes).
+    MAP_ATTACHED = 1     # replaced the runtime's program with the map gate
+    MAP_NOOP = 2         # no program attached: access already unrestricted
+    MAP_ADOPTED = 3      # recovered a previous incarnation's live map
+
+    def map_attach(self, cgroup_path: str,
+                   rules: list[DeviceRule]) -> tuple[int, int]:
+        """Attach (or adopt) the map-driven gate and sync its policy map
+        to ``rules``. Returns ``(outcome, map_fd)``; ``map_fd`` is -1 on
+        NOOP. Grant/revoke afterwards go through :meth:`map_sync` — pure
+        in-place map updates, no program replacement."""
+        c_rules = (CDeviceRule * max(len(rules), 1))(
+            *[r.to_c() for r in rules])
+        fd = ctypes.c_int(-1)
+        rc = self._lib.bpfgate_map_attach(cgroup_path.encode(), c_rules,
+                                          len(rules), ctypes.byref(fd))
+        if rc < 0:
+            raise OSError(
+                f"bpfgate_map_attach({cgroup_path}) failed: errno {-rc}")
+        return rc, fd.value
+
+    def map_sync(self, map_fd: int, rules: list[DeviceRule]) -> None:
+        """Make the live policy map match exactly ``rules`` (stale keys
+        deleted first — revocation wins; surviving keys keep their open
+        counters)."""
+        c_rules = (CDeviceRule * max(len(rules), 1))(
+            *[r.to_c() for r in rules])
+        rc = self._lib.bpfgate_map_sync(map_fd, c_rules, len(rules))
+        if rc < 0:
+            raise OSError(f"bpfgate_map_sync(fd={map_fd}): errno {-rc}")
+
+    def map_read(self, map_fd: int,
+                 max_entries: int = 1024
+                 ) -> tuple[list[DeviceRule], dict[tuple, int], int]:
+        """Live map contents: (rules, {(type, major, minor): opens},
+        denies). The reserved deny-counter key is split out as the third
+        element; wildcards read back as None major/minor."""
+        out = (CDeviceRule * max_entries)()
+        opens = (ctypes.c_uint64 * max_entries)()
+        n = self._lib.bpfgate_map_read(map_fd, out, opens, max_entries)
+        if n < 0:
+            raise OSError(f"bpfgate_map_read(fd={map_fd}): errno {-n}")
+        rules: list[DeviceRule] = []
+        open_counts: dict[tuple, int] = {}
+        denies = 0
+        for i in range(n):
+            raw = out[i]
+            if raw.dev_type == 0:
+                denies = int(opens[i])
+                continue
+            rule = DeviceRule(
+                chr(raw.dev_type), raw.access,
+                raw.major if raw.has_major else None,
+                raw.minor if raw.has_minor else None)
+            rules.append(rule)
+            open_counts[(rule.dev_type, rule.major, rule.minor)] = \
+                int(opens[i])
+        return rules, open_counts, denies
+
+    def map_close(self, map_fd: int) -> None:
+        self._lib.bpfgate_map_close(map_fd)
+
+    def map_recover(self, cgroup_path: str) -> tuple[int, int]:
+        """Recover-ONLY adoption probe: ``(outcome, map_fd)`` —
+        MAP_ADOPTED with the live map's fd if a tpumounter map program is
+        attached here, MAP_NOOP (fd -1) otherwise. Never mutates policy;
+        what restart-time orphan discovery walks the cgroup tree with."""
+        fd = ctypes.c_int(-1)
+        rc = self._lib.bpfgate_map_recover(cgroup_path.encode(),
+                                           ctypes.byref(fd))
+        if rc < 0:
+            raise OSError(
+                f"bpfgate_map_recover({cgroup_path}): errno {-rc}")
+        return rc, fd.value
+
+    def build_map_program(self, map_fd: int = 3) -> list[CBpfInsn]:
+        """Pure codegen of the map-driven program (map_fd only lands in
+        the ld_imm64) — exposed for tests/debugging."""
+        max_insns = 256
+        out = (CBpfInsn * max_insns)()
+        n = self._lib.bpfgate_build_map_program(map_fd, out, max_insns)
+        if n < 0:
+            raise OSError("bpfgate_build_map_program failed")
+        return list(out[:n])
 
     def attach(self, cgroup_path: str, rules: list[DeviceRule]) -> None:
         """Attach a fresh program like a container runtime would

@@ -20,7 +20,8 @@ from __future__ import annotations
 import os
 import threading
 
-from gpumounter_tpu.actuation.bpf import (BpfGate, container_device_rules,
+from gpumounter_tpu.actuation.bpf import (BpfGate, chip_majmins,
+                                          container_device_rules,
                                           rules_for_chips)
 from gpumounter_tpu.device.model import TPUChip
 from gpumounter_tpu.k8s import objects
@@ -39,17 +40,10 @@ _SYSTEMD_SCOPE_PREFIX = {
 }
 
 
-def _chip_majmins(chips: list[TPUChip]) -> list[tuple[int, int]]:
-    """Deduped (major, minor) pairs for chips AND their companion nodes."""
-    out: list[tuple[int, int]] = []
-    seen: set[tuple[int, int]] = set()
-    for chip in chips:
-        for key in [(chip.major, chip.minor),
-                    *((c.major, c.minor) for c in chip.companions)]:
-            if key not in seen:
-                seen.add(key)
-                out.append(key)
-    return out
+# The chip+companion (major, minor) expansion lives in actuation/bpf.py
+# (chip_majmins) so the controller, the device gate and replay
+# convergence can never diverge on it.
+_chip_majmins = chip_majmins
 
 
 def detect_cgroup_version(cgroup_root: str) -> int:
@@ -233,16 +227,19 @@ class CgroupDeviceController:
                 f"write {entries!r} to {path} failed: {e}") from e
         logger.debug("v1 %s <- %d rule(s)", path, len(entries))
 
-    def _v2_sync(self, pod: objects.Pod, container_id: str,
-                 chips: list[TPUChip],
-                 exclude: set[tuple[int, int]] = frozenset()) -> None:
+    def observed_baseline(self, pod: objects.Pod, container_id: str,
+                          exclude: set[tuple[int, int]] = frozenset()
+                          ) -> list:
+        """The runtime-granted device baseline of the container: its live
+        /dev read through procfs, cached per cgroup dir. The replacement
+        program (or gate policy map) must preserve every device the
+        runtime already granted this container (spec devices, device
+        plugins, GKE extras) — assumed-runc-defaults alone would silently
+        revoke them. Fails CLOSED (CgroupError) when no live PID is
+        readable and no cached baseline exists — shared seam of the
+        legacy v2 program-replacement sync and the map-driven device gate
+        (actuation/gate.py)."""
         cgroup_dir = self._v2_cgroup_dir(pod, container_id)
-        if not os.path.isdir(cgroup_dir):
-            raise CgroupError(f"container cgroup not found: {cgroup_dir}")
-        # The replacement program must preserve every device the runtime
-        # already granted this container (spec devices, device plugins, GKE
-        # extras) — assumed-runc-defaults alone would silently revoke them.
-        # Ground truth is the container's live /dev, read through procfs.
         observed: list | None = None
         try:
             pids = self.get_pids(pod, container_id)
@@ -265,10 +262,10 @@ class CgroupDeviceController:
             if cached is None:
                 raise CgroupError(
                     f"no live/readable PID in container {container_id} and "
-                    "no cached device baseline; refusing v2 sync that could "
+                    "no cached device baseline; refusing a sync that could "
                     "silently revoke runtime-granted devices (fail closed)")
             logger.warning(
-                "no live PID in container %s; v2 sync falls back to cached "
+                "no live PID in container %s; falling back to cached "
                 "device baseline (%d rules)", container_id, len(cached))
             observed = list(cached)
         if exclude:
@@ -282,6 +279,15 @@ class CgroupDeviceController:
             if len(self._observed_cache) >= 4096:
                 self._observed_cache.pop(next(iter(self._observed_cache)))
             self._observed_cache[cgroup_dir] = list(observed)
+        return observed
+
+    def _v2_sync(self, pod: objects.Pod, container_id: str,
+                 chips: list[TPUChip],
+                 exclude: set[tuple[int, int]] = frozenset()) -> None:
+        cgroup_dir = self._v2_cgroup_dir(pod, container_id)
+        if not os.path.isdir(cgroup_dir):
+            raise CgroupError(f"container cgroup not found: {cgroup_dir}")
+        observed = self.observed_baseline(pod, container_id, exclude)
         try:
             if self._gate is None:
                 self._gate = BpfGate()

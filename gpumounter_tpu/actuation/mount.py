@@ -18,6 +18,7 @@ import concurrent.futures
 import os
 
 from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
+from gpumounter_tpu.actuation.gate import DeviceGate
 from gpumounter_tpu.actuation.nsenter import ContainerNsActuator
 from gpumounter_tpu.device.enumerator import Enumerator
 from gpumounter_tpu.device.model import TPUChip
@@ -63,7 +64,8 @@ class TPUMounter:
     def __init__(self, cgroups: CgroupDeviceController,
                  actuator: ContainerNsActuator, enumerator: Enumerator,
                  host: HostPaths | None = None,
-                 plans: NodePlanCache | None = None):
+                 plans: NodePlanCache | None = None,
+                 gate: DeviceGate | None = None):
         self.cgroups = cgroups
         self.actuator = actuator
         self.enumerator = enumerator
@@ -72,6 +74,13 @@ class TPUMounter:
         # by the collector on every enumeration. A fresh cache with no
         # builds behaves identically: plan_for computes from the chip.
         self.plans = plans if plans is not None else NodePlanCache()
+        # The device-gate seam (actuation/gate.py): EVERY grant/revoke of
+        # device permissions crosses it (tests/test_gate_lint.py pins no
+        # path reaches the cgroup controller around it). None wires a
+        # legacy passthrough — direct controller calls, byte-for-byte the
+        # pre-gate behavior for rigs that predate it.
+        self.gate = gate if gate is not None \
+            else DeviceGate(cgroups, None, mode="legacy")
 
     # -- helpers ---------------------------------------------------------------
 
@@ -218,8 +227,7 @@ class TPUMounter:
                                  for c in new_chips])
 
         def actuate(container_id: str, pid: int) -> int:
-            self.cgroups.sync_device_access(pod, container_id,
-                                            all_chips_after)
+            self.gate.grant(pod, container_id, all_chips_after)
             made = self.actuator.apply_device_nodes(pid, creates, [])
             _observe_batch("create", len(creates))
             return made
@@ -235,16 +243,41 @@ class TPUMounter:
 
     def unmount_chips(self, pod: objects.Pod, chips: list[TPUChip],
                       remaining_chips: list[TPUChip],
-                      force: bool = False) -> None:
+                      force: bool = False, cause: str = "") -> None:
         """Remove ``chips`` from the pod's containers.
 
-        Ref util.go:73-150 UnmountGPU: busy re-check -> cgroup deny ->
+        Ref util.go:73-150 UnmountGPU: busy re-check -> GATE revoke ->
         rm device file -> (force) kill holders. Busy without force raises
         :class:`DeviceBusyError` with the holder PIDs. Unlinks are fused
         into one batch per container, same as :meth:`mount_chips`.
+
+        Revocation crosses the device gate FIRST — an in-place policy-map
+        update, instant deny, zero fork — and nodes are unlinked only
+        after. With a broker ``cause`` (lease expiry / preemption) a BUSY
+        device still gets its gate access cut before the busy error goes
+        back: the holder's open fd survives (the kernel gates open(2),
+        not existing fds), but every re-open is denied-with-reason from
+        that instant even while node cleanup defers and retries — the
+        "holder keeps the chip after its lease is gone" hole this gate
+        exists to close.
         """
         busy = self._busy_map(pod, chips)
         if busy and not force:
+            if cause and self.gate.live:
+                # best-effort by contract: the busy verdict MUST reach
+                # the caller (broker backoff/retry) even when the early
+                # revoke itself fails — a revoke error here may not
+                # replace DeviceBusyError
+                try:
+                    for container_id, _pid in \
+                            self._actuatable_containers(pod):
+                        self.gate.revoke(pod, container_id, chips,
+                                         remaining_chips, cause=cause)
+                except (ActuationError, OSError) as e:
+                    logger.warning(
+                        "busy-path gate revoke for %s/%s failed (%s); "
+                        "busy verdict returned, node cleanup will retry",
+                        objects.namespace(pod), objects.name(pod), e)
             uuid, pids = next(iter(busy.items()))
             raise DeviceBusyError(uuid, pids)
 
@@ -255,8 +288,8 @@ class TPUMounter:
             [self.plans.plan_for(c) for c in remaining_chips])
 
         def actuate(container_id: str, pid: int) -> None:
-            self.cgroups.revoke_device_access(pod, container_id, chips,
-                                              remaining_chips)
+            self.gate.revoke(pod, container_id, chips, remaining_chips,
+                             cause=cause)
             self.actuator.apply_device_nodes(pid, [], removes)
             _observe_batch("remove", len(removes))
 

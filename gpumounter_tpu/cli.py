@@ -27,6 +27,7 @@ Usage (``python -m gpumounter_tpu.cli`` or the ``tpumounterctl`` entry):
     tpumounterctl doctor [--node my-tpu-node]
     tpumounterctl cachez --master http://<worker>:1201
     tpumounterctl utilz --master http://<worker>:1201
+    tpumounterctl gatez --master http://<worker>:1201
 
 The master address comes from ``--master`` or ``$TPU_MOUNTER_MASTER``
 (default ``http://127.0.0.1:8080`` — matching a
@@ -489,6 +490,70 @@ def cmd_agentz(args) -> int:
                      "path is degrading; check worker logs for the "
                      "fault reason")
         rc = EXIT_OTHER
+    _emit(payload, args.json, "\n".join(lines))
+    return rc
+
+
+def cmd_gatez(args) -> int:
+    """Render a worker's /gatez (kernel device gate): backend, per-
+    container entries, the deny ring with revocation reasons, drift from
+    the reconciler audit. Exit non-zero on denials (a workload is
+    hammering access it lost — or never had) or on gate/lease drift (a
+    grant outlived its attachment before the audit reclaimed it)."""
+    try:
+        payload = json.loads(_fetch_text(args.master, "/gatez",
+                                         args.timeout))
+    except TransportError as e:
+        print(f"unreachable: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    except ValueError as e:
+        print(f"bad /gatez payload: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    if not payload.get("enabled"):
+        _emit(payload, args.json,
+              "device gate disabled on this target "
+              f"(mode={payload.get('mode', 'legacy')} — cgroup writes / "
+              "program replacement, no kernel policy maps)")
+        return 0
+    counts = payload.get("counts") or {}
+    denials = payload.get("denials") or {}
+    drift = payload.get("drift") or {}
+    entries = payload.get("entries") or []
+    lines = [
+        f"device gate: backend={payload.get('backend')} "
+        f"node={payload.get('node') or '?'}: "
+        f"{len(entries)} gated container(s), "
+        f"{counts.get('grants', 0)} grant(s) / "
+        f"{counts.get('revokes', 0)} revoke(s), "
+        f"{counts.get('faults', 0)} fault(s) degraded to legacy, "
+        f"{denials.get('total', 0)} denial(s)"]
+    for entry in entries:
+        chips = entry.get("chips") or []
+        lines.append(
+            f"  {entry.get('namespace')}/{entry.get('pod')}: "
+            f"{len(chips)} chip(s), {entry.get('rules')} rule(s)"
+            + ("" if entry.get("enforced") else "  [UNENFORCED: no "
+               "device program on this cgroup]"))
+    for deny in (denials.get("recent") or [])[-8:]:
+        lines.append(
+            f"  DENY {deny.get('device')} tenant={deny.get('tenant') or '?'}"
+            f" reason={deny.get('reason')}"
+            + (f" x{deny['count']}" if deny.get("count", 1) > 1 else ""))
+    rc = 0
+    if drift.get("count"):
+        lines.append(f"  CRITICAL: {drift['count']} gate entr(ies) "
+                     "granted chips with no live owner attachment "
+                     "(reclaimed by the audit — revocation raced a crash)")
+        rc = EXIT_OTHER
+    if denials.get("total"):
+        lines.append(f"  WARNING: {denials['total']} denial(s) — evicted "
+                     "holders are still retrying revoked devices; "
+                     "reasons above")
+        rc = rc or EXIT_OTHER
+    pending = payload.get("journal_pending", 0)
+    if pending:
+        lines.append(f"  note: {pending} gate journal record(s) pending "
+                     "(mutation in flight or awaiting convergence)")
     _emit(payload, args.json, "\n".join(lines))
     return rc
 
@@ -1343,6 +1408,50 @@ def cmd_doctor(args) -> int:
               f"attach-journal backlog: {backlog} incomplete record(s)"
               + (" — inspect /journalz" if backlog else ""))
 
+    # Kernel device gate: worker-local /gatez (the master answers 404 →
+    # skipped). Drift is CRIT — a gate entry granting chips with no live
+    # owner attachment means revocation raced a crash and a workload may
+    # have held access past its lease (the audit reclaimed it, but the
+    # window existed). A WINDOWED denial rate WARNs: denials right now
+    # mean an evicted holder is hammering a device it lost.
+    try:
+        gatez = json.loads(_fetch_text(args.master, "/gatez",
+                                       args.timeout))
+    except (TransportError, ValueError):
+        gatez = None
+    if isinstance(gatez, dict) and "enabled" in gatez \
+            and ("backend" in gatez or not gatez.get("enabled")):
+        if not gatez.get("enabled"):
+            check("ok", "device gate disabled (legacy cgroup "
+                        "enforcement; no kernel policy maps)")
+        else:
+            drift = (gatez.get("drift") or {}).get("count", 0)
+            denial_total = (gatez.get("denials") or {}).get("total", 0)
+            faults = (gatez.get("counts") or {}).get("faults", 0)
+            if drift:
+                check("crit",
+                      f"device gate drift: {drift} entr(ies) granted "
+                      "chips with no live owner attachment — inspect "
+                      "/gatez")
+            src = metrics_delta if metrics_delta is not None else metrics
+            scope = (f"in the last {window:g}s"
+                     if metrics_delta is not None else "lifetime")
+            denial_rate = _counter_total(
+                src, "tpumounter_device_denials_total")
+            if metrics_delta is not None and denial_rate > 0:
+                check("warn",
+                      f"device denials: {int(denial_rate)} {scope} — a "
+                      "workload is retrying access the gate revoked; "
+                      "`tpumounterctl gatez` for reasons")
+            elif not drift:
+                check("ok",
+                      f"device gate healthy: backend "
+                      f"{gatez.get('backend')}, "
+                      f"{len(gatez.get('entries') or [])} gated "
+                      f"container(s), {denial_total} denial(s) lifetime"
+                      + (f", {int(faults)} fault(s) degraded to legacy"
+                         if faults else ""))
+
     # Shared-informer cache health: worker-local /cachez (the master
     # answers 404 → skipped). Staleness is CURRENT state and may WARN: a
     # stale cache means the attach path is coasting on old pod data and
@@ -1572,6 +1681,14 @@ def build_parser() -> argparse.ArgumentParser:
              "open accounting (non-zero exit on unattributed busy "
              "chips)")
     p.set_defaults(fn=cmd_utilz)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser(
+        "gatez",
+        help="kernel device gate from a worker's health port: backend, "
+             "gated containers, deny ring with revocation reasons, "
+             "gate/lease drift (non-zero exit on denials or drift)")
+    p.set_defaults(fn=cmd_gatez)
     _add_common(p, suppress=True)
 
     p = sub.add_parser(

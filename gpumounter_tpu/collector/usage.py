@@ -252,10 +252,19 @@ class ChipUsageSampler:
                  pool_namespace: str = consts.DEFAULT_POOL_NAMESPACE,
                  node_name: str = "", owners_fn=None,
                  refresh_inventory: bool = False,
-                 refresh_interval_s: float = DEFAULT_REFRESH_INTERVAL_S):
+                 refresh_interval_s: float = DEFAULT_REFRESH_INTERVAL_S,
+                 gate=None):
         import collections
         self.collector = collector
         self.probe = probe
+        # Device gate (actuation/gate.py): where it is live, the kernel
+        # program keeps EXACT per-syscall open counts per chip — each
+        # sampling pass pumps those counters (delta-attributed to tenants
+        # by the gate itself) and SKIPS edge accounting for gate-covered
+        # chips; sampling-resolution edges remain the fallback for
+        # uncovered chips (v1 nodes, legacy mode). None = pure PR 10
+        # behavior.
+        self.gate = gate
         self.interval_s = interval_s
         self.pool_namespace = pool_namespace
         self.node_name = node_name
@@ -338,6 +347,19 @@ class ChipUsageSampler:
                 owners = self.owners_fn() or {}
             except Exception:    # noqa: BLE001 — attribution degrades,
                 logger.exception("owner resolution failed")  # never dies
+        # Pump the gate's kernel counters first: exact per-syscall opens
+        # (attributed by the gate) + reasoned deny deltas. The returned
+        # coverage set tells the edge accounting below to stand down for
+        # those chips — exact counts win over sampling resolution.
+        gate_opens: dict[tuple[int, int], int] = {}
+        gate_covered: set[tuple[int, int]] = set()
+        if self.gate is not None and self.gate.live:
+            try:
+                pumped = self.gate.pump()
+                gate_opens = pumped["opens"]
+                gate_covered = pumped["covered"]
+            except Exception:    # noqa: BLE001 — accounting degrades,
+                logger.exception("gate counter pump failed")  # never dies
         now = time.time()
         entry_chips: dict[str, dict] = {}
         for chip in chips:
@@ -356,7 +378,18 @@ class ChipUsageSampler:
             }
             if owner is not None:
                 record["owner"] = f"{owner[0]}/{owner[1]}"
+            majmin = (chip.major, chip.minor)
+            if majmin in gate_covered:
+                record["gated"] = True
             entry_chips[chip.uuid] = record
+            if majmin in gate_opens:
+                with self._lock:
+                    # monotonic: a freshly re-attached map restarts its
+                    # counter at 0 (fault-degrade then re-grant) — the
+                    # /utilz per-chip opens figure must never regress
+                    self._opens[chip.uuid] = max(
+                        self._opens.get(chip.uuid, 0),
+                        gate_opens[majmin])
         entry = {"ts": round(now, 3), "chips": entry_chips}
         with self._lock:
             self._ring.append(entry)
@@ -371,6 +404,12 @@ class ChipUsageSampler:
         node; the eBPF gate will later count the exact syscalls)."""
         for uuid, record in chips.items():
             was = self._was_busy.get(uuid, False)
+            if record.get("gated"):
+                # gate-covered chip: the kernel's exact counters own both
+                # the open accounting and (as reasoned DENIALS) what used
+                # to surface here as unattributed busy edges
+                self._was_busy[uuid] = record["busy"]
+                continue
             if record["busy"] and not was:
                 self._opens[uuid] = self._opens.get(uuid, 0) + 1
                 owner = record.get("owner", "")
@@ -474,11 +513,13 @@ class ChipUsageSampler:
         }
 
 
-def build_sampler(service, settings, enumerator=None) -> ChipUsageSampler:
+def build_sampler(service, settings, enumerator=None,
+                  gate=None) -> ChipUsageSampler:
     """Production wiring (worker/main.py): FsUsageProbe over the host
     tree + the enumerator's (possibly native) open-fd hook, ownership
     from attachment records + the informer's slave-pod labels, inventory
-    refreshed per pass."""
+    refreshed per pass, exact open/deny accounting pumped from the device
+    gate where it is live."""
     probe = FsUsageProbe(
         settings.host,
         enumerator or service.allocator.collector.enumerator)
@@ -490,4 +531,5 @@ def build_sampler(service, settings, enumerator=None) -> ChipUsageSampler:
         owners_fn=slave_owner_resolver(service.reads,
                                        settings.pool_namespace,
                                        service=service),
-        refresh_inventory=True)
+        refresh_inventory=True,
+        gate=gate)

@@ -155,11 +155,20 @@ class FaultPlan:
 
 
 # Where a simulated worker death can be armed, relative to actuation —
-# the window the attach journal exists to cover:
+# the window the attach journal exists to cover (the attach crash/replay
+# matrix parametrizes over exactly these):
 #   before_actuate: intent journaled, slave pods reserved, nothing granted
 #   mid_actuate:    cgroup synced + first device node created, rest missing
 #   before_commit:  actuation complete, commit record never written
 CRASH_POINTS = ("before_actuate", "mid_actuate", "before_commit")
+# Detach-window crash points the device gate's convergence covers
+# (tests/test_gate_chaos.py; gated rigs only for mid_gate_sync):
+#   mid_revoke:     died AFTER the gate revoked device access but BEFORE
+#                   the nodes were unlinked / slaves released
+#   mid_gate_sync:  died INSIDE the gate backend mutation — the gate
+#                   journal record is written, its commit never is (the
+#                   pending-record window convergence must resolve)
+DETACH_CRASH_POINTS = ("mid_revoke", "mid_gate_sync")
 
 
 class ChaosRig:
@@ -190,7 +199,7 @@ class ChaosRig:
     # -- crash points ----------------------------------------------------------
 
     def arm_crash(self, point: str) -> None:
-        assert point in CRASH_POINTS, point
+        assert point in CRASH_POINTS + DETACH_CRASH_POINTS, point
         if point == "before_actuate":
             mounter = self.rig.mounter
             orig = mounter.mount_chips
@@ -221,6 +230,31 @@ class ChaosRig:
                 raise WorkerCrash(point)
             journal.commit = crash_commit
             self._unwind.append(lambda: setattr(journal, "commit", orig))
+        elif point == "mid_revoke":
+            # die on the first node unlink: the gate revoke (which runs
+            # FIRST on the detach path) has landed, nothing else has
+            actuator = self.rig.actuator
+            orig = actuator.apply_device_nodes
+
+            def crash_on_remove(pid, creates=(), removes=(), **kwargs):
+                if removes:
+                    raise WorkerCrash(point)
+                return orig(pid, creates, removes, **kwargs)
+            actuator.apply_device_nodes = crash_on_remove
+            self._unwind.append(
+                lambda: setattr(actuator, "apply_device_nodes", orig))
+        elif point == "mid_gate_sync":
+            backend = self.rig.gate_backend
+            assert backend is not None, "rig built without gate="
+            orig_sync, orig_attach = backend.sync, backend.attach
+
+            def crash_sync(*args, **kwargs):
+                raise WorkerCrash(point)
+            backend.sync = crash_sync
+            backend.attach = crash_sync
+            self._unwind.append(
+                lambda: (setattr(backend, "sync", orig_sync),
+                         setattr(backend, "attach", orig_attach)))
 
     def disarm(self) -> None:
         while self._unwind:
@@ -231,12 +265,21 @@ class ChaosRig:
     def restart_worker(self) -> dict[str, int]:
         """Boot a "new worker process" over the same node state: fresh
         journal object from the on-disk file, fresh service, startup
-        replay. Returns the replay outcome counts."""
+        replay. A gated rig also gets a FRESH DeviceGate (its in-memory
+        entries died with the process) over the SAME backend — the fake
+        backend plays the kernel, whose policy maps survive a worker
+        crash. Returns the replay outcome counts."""
         from gpumounter_tpu.worker.journal import AttachJournal
         from gpumounter_tpu.worker.service import TPUMountService
         self.disarm()
         journal = AttachJournal(self.rig.sim.settings.journal_path)
         self.rig.journal = journal
+        if self.rig.gate is not None:
+            from gpumounter_tpu.actuation.gate import DeviceGate
+            self.rig.gate = DeviceGate(
+                self.rig.cgroups, self.rig.gate_backend, journal=journal,
+                mode="auto", node_name=self.rig.sim.node)
+            self.rig.mounter.gate = self.rig.gate
         self.rig.service = TPUMountService(
             self.rig.allocator, self.rig.mounter, self.rig.sim.kube,
             self.rig.sim.settings, pool=self.rig.pool, journal=journal)
@@ -459,6 +502,10 @@ def assert_invariants(rig, expected_uuids: set[str],
     4. **Idempotency**: across every retry/replay, at most ONE logical
        TPUAttached event per logical attach (resumes record
        TPUAttachResumed instead).
+    5. **Gate == ground truth** (gated rigs): the chips the device gate
+       grants are exactly ``expected_uuids`` — no chip is accessible
+       (gate-granted) without a live attachment backing it, and no live
+       attachment lost its grant.
     """
     sim = rig.sim
     # 1. reservations: chips assigned to live non-warm slave pods
@@ -499,6 +546,18 @@ def assert_invariants(rig, expected_uuids: set[str],
         if rig.service.journal is not None else 0
     assert backlog == 0, \
         f"journal still holds {backlog} incomplete record(s)"
+
+    # 5. gate state mirrors ground truth: gate-granted chips == expected.
+    # Audited from the rig's LIVE gate (post-restart rigs carry the
+    # rebuilt one) — a grant outliving its attachment, or an attachment
+    # without its grant, is exactly the revocation hole the gate closes.
+    gate = getattr(rig, "gate", None)
+    if gate is not None and gate.live:
+        granted = gate.granted_uuids()
+        assert granted == expected_uuids, \
+            f"gate grants {sorted(granted)} != expected " \
+            f"{sorted(expected_uuids)} (a chip is accessible without a " \
+            "live lease/attachment, or a lease lost its grant)"
 
     # 4. ≤ one logical TPUAttached per attach. Default: one when chips are
     # expected, zero when the plan should have reverted everything; a test

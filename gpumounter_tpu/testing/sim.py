@@ -215,7 +215,8 @@ class WorkerRig:
                  pod_name="workload", schedule_delay_s=0.0,
                  kubelet_lag_s=0.0, warm_pool: dict[str, int] | None = None,
                  informer: bool = False, agent: bool = False,
-                 usage=False, usage_interval_s: float = 0.25):
+                 usage=False, usage_interval_s: float = 0.25,
+                 gate=False):
         from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
         from gpumounter_tpu.actuation.mount import TPUMounter
         from gpumounter_tpu.actuation.nsenter import (ProcRootActuator,
@@ -267,9 +268,35 @@ class WorkerRig:
             self.agent = ResidentActuationAgent(
                 fake_host, fake_nodes=(actuator == "procroot"))
             self.actuator = AgentActuator(self.agent, self.actuator)
+        # Crash-safe attach journal path decided early: the gate journals
+        # its mutations through the same file.
+        from gpumounter_tpu.worker.journal import AttachJournal
+        self.sim.settings.journal_path = os.path.join(
+            os.path.dirname(fake_host.proc_root), "attach-journal.jsonl")
+        self.journal = AttachJournal(self.sim.settings.journal_path)
+        # Kernel device gate (``gate="fake"``): every grant/revoke crosses
+        # the DeviceGate seam over a FakeGateBackend — in-memory policy
+        # maps + deny simulation playing the KERNEL (it survives a
+        # simulated worker crash; ChaosRig.restart_worker keeps the
+        # backend while rebuilding the service, exactly like live kernel
+        # maps outliving the process). ``gate=<GateBackend>`` wires a
+        # caller-built backend. Default off = the legacy passthrough —
+        # byte-for-byte pre-gate semantics for rigs that predate it.
+        self.gate = None
+        self.gate_backend = None
+        if gate:
+            from gpumounter_tpu.actuation.gate import (DeviceGate,
+                                                       FakeGateBackend,
+                                                       GateBackend)
+            self.gate_backend = (gate if isinstance(gate, GateBackend)
+                                 else FakeGateBackend())
+            self.gate = DeviceGate(self.cgroups, self.gate_backend,
+                                   journal=self.journal, mode="auto",
+                                   node_name=node)
         self.mounter = TPUMounter(self.cgroups, self.actuator,
                                   self.sim.enumerator, fake_host,
-                                  plans=self.sim.collector.plans)
+                                  plans=self.sim.collector.plans,
+                                  gate=self.gate)
         # Shared pod informer (``informer=True``): ONE list+watch over the
         # pool namespace serves every hot-path read — the production
         # default wiring (worker/main.py). Off by default so unit rigs
@@ -295,14 +322,11 @@ class WorkerRig:
             self.sim.settings.warm_pool_enabled = True
             self.pool = PoolManager(self.allocator, self.sim.kube,
                                     self.sim.settings)
-        # Crash-safe attach journal on the fixture tree — enabled by
-        # default so every rig-driven attach exercises the production
-        # write-ahead path; chaos tests "restart the worker" by building a
-        # fresh service over the same journal (testing/chaos.py).
-        from gpumounter_tpu.worker.journal import AttachJournal
-        self.sim.settings.journal_path = os.path.join(
-            os.path.dirname(fake_host.proc_root), "attach-journal.jsonl")
-        self.journal = AttachJournal(self.sim.settings.journal_path)
+        # Crash-safe attach journal on the fixture tree (created above,
+        # before the gate) — enabled by default so every rig-driven
+        # attach exercises the production write-ahead path; chaos tests
+        # "restart the worker" by building a fresh service over the same
+        # journal (testing/chaos.py).
         self.service = TPUMountService(self.allocator, self.mounter,
                                        self.sim.kube, self.sim.settings,
                                        pool=self.pool,
@@ -407,6 +431,7 @@ class LiveStack:
         _HealthHandler.cache = rig.service.reads
         _HealthHandler.agent = rig.agent
         _HealthHandler.usage = rig.usage
+        _HealthHandler.gate = rig.gate
         self.health_server = start_health_server(0)
         health_port = self.health_server.server_port
         # ``shared_kube=True``: the master reads the SAME fake cluster the
@@ -436,6 +461,7 @@ class LiveStack:
         _HealthHandler.cache = None
         _HealthHandler.agent = None
         _HealthHandler.usage = None
+        _HealthHandler.gate = None
         self.gateway.fleet.stop()
         self.gateway.broker.stop()
         self.http_server.shutdown()
@@ -591,7 +617,7 @@ class MultiNodeStack:
     is ``node-i`` holding pod ``workload-i``."""
 
     def __init__(self, hosts: list, n_chips=4, health: bool = False,
-                 broker_config=None, usage=False):
+                 broker_config=None, usage=False, gate=False):
         from gpumounter_tpu.master.admission import AttachBroker
         from gpumounter_tpu.master.discovery import WorkerDirectory
         from gpumounter_tpu.master.gateway import MasterGateway
@@ -609,7 +635,8 @@ class MultiNodeStack:
         self.master_kube = FakeKubeClient()
         for i, host in enumerate(hosts):
             rig = WorkerRig(host, n_chips=n_chips, node=f"node-{i}",
-                            pod_name=f"workload-{i}", usage=usage)
+                            pod_name=f"workload-{i}", usage=usage,
+                            gate=gate)
             server, port = build_server(rig.service, port=0,
                                         address="127.0.0.1")
             server.start()
@@ -619,6 +646,7 @@ class MultiNodeStack:
                 hs = start_health_server(0, journal=rig.journal,
                                          cache=rig.service.reads,
                                          usage=rig.usage,
+                                         gate=rig.gate,
                                          ready=True)
                 self.health_servers.append(hs)
                 health_bases[f"127.0.0.1:{port}"] = \

@@ -153,6 +153,11 @@ class Settings:
     replica_id: str = ""
     advertise_url: str = ""
     shard_forward: str = "proxy"            # "proxy" | "redirect"
+    # Kernel-enforced device gate (actuation/gate.py): "auto" (default ON
+    # — map-driven eBPF backend on cgroup v2, devices.allow/deny writes
+    # on v1, journaled + audited either way) or "legacy" (byte-for-byte
+    # today's semantics: direct cgroup-controller calls, no gate state).
+    gate_mode: str = "auto"
     # Resident actuation agent (actuation/agent.py): cached namespace fds
     # + in-process batch execution on the attach/detach hot path, with
     # transparent fallback on any agent fault. Default ON in production;
@@ -247,6 +252,15 @@ class Settings:
                 f"got {forward!r}")
         s.shard_forward = forward
         s.informer_enabled = env.get(consts.ENV_INFORMER, "1") != "0"
+        gate = env.get(consts.ENV_GATE, "auto")
+        # "0" is accepted as a legacy alias ("1" as auto) for symmetry
+        # with the other feature knobs; unknown values fail the boot.
+        gate = {"0": "legacy", "1": "auto"}.get(gate, gate)
+        if gate not in ("auto", "legacy"):
+            raise ValueError(
+                f"{consts.ENV_GATE} must be auto|legacy (or 1|0), "
+                f"got {env.get(consts.ENV_GATE)!r}")
+        s.gate_mode = gate
         s.agent_enabled = env.get(consts.ENV_AGENT, "1") != "0"
         if t := env.get(consts.ENV_ENUM_CACHE_TTL_S):
             s.enum_cache_ttl_s = float(t)

@@ -118,6 +118,18 @@ ENV_QUEUE_TIMEOUT_S = "TPU_QUEUE_TIMEOUT_S"
 # Bound of each per-priority FIFO; a full queue answers 429 + Retry-After.
 ENV_QUEUE_DEPTH = "TPU_QUEUE_DEPTH"
 
+# --- Kernel-enforced device gate (actuation/gate.py) --------------------------
+# "auto" (default): every device grant/revoke crosses the DeviceGate seam
+# with the strongest backend this node supports — the per-cgroup eBPF
+# policy map on cgroup v2 (in-place map updates: instant revocation, no
+# program replacement, exact per-syscall open/deny counters), the
+# devices.allow/deny writes on v1 — journaled for crash convergence and
+# served as GET /gatez. "legacy" reverts to today's semantics
+# byte-for-byte: direct cgroup-controller calls, zero gate state, zero
+# new series. Any gate-backend fault degrades that mutation to the legacy
+# path (counted, evented) — never to an unenforced attach.
+ENV_GATE = "TPU_GATE"
+
 # --- Resident actuation agent (actuation/agent.py) ----------------------------
 # "1" (default): device-node actuation runs through the persistent
 # per-node agent — cached namespace fds, setns/proc-root entry in a

@@ -60,6 +60,15 @@ class CgroupError(ActuationError):
     """Could not resolve or modify the container's cgroup."""
 
 
+class GateBackendError(ActuationError):
+    """A device-gate backend (eBPF map / cgroup writes / fake) faulted.
+
+    Deliberately distinct from :class:`CgroupError`: a backend fault makes
+    the :class:`~gpumounter_tpu.actuation.gate.DeviceGate` degrade to the
+    legacy enforcement path (counted + evented), while a CgroupError is a
+    typed actuation failure that rides the normal rollback."""
+
+
 class AllocationTimeoutError(TPUMounterError):
     """Slave pod did not reach Running/terminal state within the deadline.
 

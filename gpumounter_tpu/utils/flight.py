@@ -59,9 +59,15 @@ logger = get_logger("flight")
 # the evidence is fresh); the rest dump on first occurrence.
 FALLBACK_BURST = 3
 IDLE_LEASE_BURST = 3
+# device_denial_burst likewise needs a burst: ONE denial is a workload
+# retrying a just-revoked device (expected during every preemption); a
+# burst means something is hammering a gate it lost — worth a bundle
+# carrying the deny ring while the tombstone reasons are fresh.
+DENIAL_BURST = 3
 BURST_WINDOW_S = 60.0
 _THRESHOLDS = {"agent_fallback": FALLBACK_BURST,
-               "idle_lease_burst": IDLE_LEASE_BURST}
+               "idle_lease_burst": IDLE_LEASE_BURST,
+               "device_denial_burst": DENIAL_BURST}
 
 DEFAULT_MIN_INTERVAL_S = 300.0
 MAX_BUNDLES = 32        # oldest bundles are pruned beyond this

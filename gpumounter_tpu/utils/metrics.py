@@ -578,7 +578,8 @@ class Registry:
         # that first appears at value 1 reads as 0 — the alert would
         # silently miss each trigger's first-ever bundle
         for trigger in ("fast_burn", "agent_fallback", "journal_backlog",
-                        "circuit_open", "idle_lease_burst"):
+                        "circuit_open", "idle_lease_burst",
+                        "device_denial_burst"):
             self.flight_dumps.inc(0.0, trigger=trigger)
         self.flight_suppressed = Counter(
             "tpumounter_flight_suppressed_total",
@@ -721,8 +722,39 @@ class Registry:
             "tpumounter_device_opens_total",
             "Observed chip device-node open transitions, by tenant and "
             "outcome (attributed/unattributed; unattributed = busy chip "
-            "with no owner on record)")
+            "with no owner on record). Where the kernel device gate is "
+            "live these are EXACT per-syscall counts from its policy-map "
+            "counters; elsewhere they remain the usage sampler's "
+            "sampling-resolution edge accounting")
         self.device_opens.inc(0.0, tenant="", outcome="unattributed")
+        # Kernel-enforced device gate (actuation/gate.py): denials are
+        # opens the gate refused, with the revocation cause attributed
+        # from tombstones (revoked:lease-expired / revoked:preempted /
+        # revoked:detach / ungranted). Under the gate, what PR 10 counted
+        # as an unattributed busy chip becomes an attributable DENIAL.
+        self.device_denials = Counter(
+            "tpumounter_device_denials_total",
+            "Device opens denied by the kernel device gate, by tenant "
+            "and reason (revoked:<cause> = access cut by the control "
+            "plane; ungranted = never granted)")
+        self.device_denials.inc(0.0, tenant="", reason="ungranted")
+        # Gate mutations by backend (native-map / cgroup-v1 / fake) and
+        # outcome (grant / revoke / attached / adopted / noop / fault).
+        # fault = the backend degraded that mutation to the legacy
+        # enforcement path — a climbing rate means the map gate is down.
+        self.gate_syncs = Counter(
+            "tpumounter_gate_syncs_total",
+            "Device-gate policy mutations by backend and outcome "
+            "(fault = degraded to the legacy enforcement path)")
+        self.gate_syncs.inc(0.0, backend="native-map", outcome="fault")
+        # Gate-vs-lease drift found by the reconciler's audit pass:
+        # entries whose owner attachment is gone (grants outliving their
+        # lease — reclaimed, but any non-zero value means revocation
+        # raced a crash; doctor CRITs).
+        self.gate_drift = Gauge(
+            "tpumounter_gate_drift",
+            "Gate entries found granting chips with no live owner "
+            "attachment at the last reconciler audit (reclaimed)")
         # Identifies the build on every /metrics surface (standard
         # <name>_info pattern: constant 1, the payload is the label).
         from gpumounter_tpu import __version__

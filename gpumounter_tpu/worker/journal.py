@@ -55,6 +55,14 @@ logger = get_logger("worker.journal")
 # the "rollback started but did not finish" intermediate. intent and
 # revert_pending are the INCOMPLETE states startup replay must resolve.
 INCOMPLETE_STATES = ("intent", "revert_pending")
+# Device-gate mutations (actuation/gate.py) journal around actuation the
+# same way: a ``gate`` record before the backend sync, ``gate_commit``
+# after. gate_pending records are resolved by the startup gate
+# CONVERGENCE (desired map contents re-derived from attachment ground
+# truth), not by the per-record attach replay — they get their own
+# incomplete state so ``incomplete()``/``backlog()`` keep their
+# attach-record semantics (alerts, /journalz) unchanged.
+GATE_PENDING_STATE = "gate_pending"
 
 
 class AttachJournal:
@@ -116,11 +124,18 @@ class AttachJournal:
             record.pop("event", None)
             record["state"] = "detached"
             self._records[jid] = record
+        elif kind == "gate":
+            record = dict(event)
+            record.pop("event", None)
+            record["state"] = GATE_PENDING_STATE
+            self._records[jid] = record
         elif jid in self._records and kind in ("commit", "revert",
-                                               "revert_pending"):
+                                               "revert_pending",
+                                               "gate_commit"):
             self._records[jid]["state"] = {
                 "commit": "committed", "revert": "reverted",
-                "revert_pending": "revert_pending"}[kind]
+                "revert_pending": "revert_pending",
+                "gate_commit": "gate_done"}[kind]
 
     def _append(self, event: dict) -> None:
         line = json.dumps(event, sort_keys=True)
@@ -186,6 +201,37 @@ class AttachJournal:
                     force=force)
         return jid
 
+    def record_gate(self, rid: str, namespace: str, pod: str, op: str,
+                    devices: list[str], key: str = "",
+                    cause: str = "") -> str:
+        """Append a device-gate mutation intent BEFORE the backend sync
+        (``op`` grant|revoke; ``key`` = container cgroup dir; ``cause``
+        rides broker revocations). A crash between this record and its
+        ``gate_commit`` leaves a gate_pending record the startup gate
+        convergence resolves — a gate grant can no more outlive a crash
+        unaccounted than a mknod can."""
+        jid = f"gate-{rid or 'local'}-{secrets.token_hex(4)}"
+        event = {"jid": jid, "event": "gate", "rid": rid,
+                 "namespace": namespace, "pod": pod, "op": op,
+                 "devices": sorted(devices), "key": key, "cause": cause,
+                 "ts": round(time.time(), 3)}
+        with self._lock:
+            self._append(event)
+            self._apply(event)
+        EVENTS.emit("journal_gate", rid=rid, namespace=namespace, pod=pod,
+                    op=op, chips=len(devices), jid=jid, cause=cause)
+        return jid
+
+    def gate_commit(self, jid: str) -> None:
+        self._mark(jid, "gate_commit")
+
+    def pending_gates(self) -> list[dict]:
+        """Gate mutations whose commit never landed (crash mid-sync), in
+        journal order — what startup convergence resolves."""
+        with self._lock:
+            return [dict(r) for r in self._records.values()
+                    if r["state"] == GATE_PENDING_STATE]
+
     def commit(self, jid: str) -> None:
         self._mark(jid, "commit")
 
@@ -211,13 +257,15 @@ class AttachJournal:
         are history the trace/event stores already tell better)."""
         with self._lock:
             keep = [r for r in self._records.values()
-                    if r["state"] in INCOMPLETE_STATES]
+                    if r["state"] in INCOMPLETE_STATES
+                    or r["state"] == GATE_PENDING_STATE]
             tmp = self.path + ".tmp"
             with open(tmp, "w") as f:
                 for record in keep:
                     intent = {k: v for k, v in record.items()
                               if k != "state"}
-                    intent["event"] = "intent"
+                    intent["event"] = ("gate" if record["state"]
+                                       == GATE_PENDING_STATE else "intent")
                     f.write(json.dumps(intent, sort_keys=True) + "\n")
                     if record["state"] == "revert_pending":
                         f.write(json.dumps(
@@ -237,10 +285,15 @@ class AttachJournal:
             records = [dict(r) for r in self._records.values()]
         incomplete = [r for r in records
                       if r["state"] in INCOMPLETE_STATES]
+        payload_gate = len([r for r in records
+                            if r["state"] == GATE_PENDING_STATE])
         return {
             "path": self.path,
             "backlog": len(incomplete),
             "incomplete": incomplete,
+            # key present only when gate records exist: a legacy-mode
+            # worker's /journalz stays byte-for-byte the PR 10 payload
+            **({"gate_pending": payload_gate} if payload_gate else {}),
             "records": records[-64:],
             "replays": {outcome: int(REGISTRY.journal_replays.value(
                 outcome=outcome))

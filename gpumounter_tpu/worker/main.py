@@ -40,6 +40,7 @@ class _HealthHandler(BaseHTTPRequestHandler):
     agent = None       # ResidentActuationAgent, set when the agent is on
     events = None      # EventLog override; None = the process singleton
     usage = None       # ChipUsageSampler, set when TPU_USAGE is on
+    gate = None        # DeviceGate, set when TPU_GATE != legacy
 
     def log_message(self, *args):
         pass
@@ -124,6 +125,17 @@ class _HealthHandler(BaseHTTPRequestHandler):
                               else {"enabled": False}).encode()
             ctype = "application/json"
             code = 200
+        elif self.path == "/gatez":
+            # kernel device gate: backend + per-container entries, the
+            # deny ring with reasons, drift audit, converge stats —
+            # ALREADY-collected state only (snapshot(); no backend poll
+            # runs on this request thread)
+            import json
+            gate = type(self).gate
+            body = json.dumps(gate.snapshot() if gate is not None
+                              else {"enabled": False}).encode()
+            ctype = "application/json"
+            code = 200
         elif self.path == "/journalz":
             # attach-journal introspection: backlog of incomplete records
             # (should be 0 outside a crash window) + replay outcomes
@@ -157,7 +169,7 @@ def start_health_server(port: int, **state) -> ThreadingHTTPServer:
     handler = _HealthHandler
     if state:
         unknown = set(state) - {"journal", "cache", "pool", "agent",
-                                "events", "ready", "usage"}
+                                "events", "ready", "usage", "gate"}
         if unknown:
             raise TypeError(f"unknown health-server state: {unknown}")
         handler = type("_ScopedHealthHandler", (_HealthHandler,), state)
@@ -209,6 +221,17 @@ def build_stack(settings: Settings) -> TPUMountService:
     allocator = TPUAllocator(collector, kube, settings, reads=reads)
     cgroups = CgroupDeviceController(settings.host,
                                      driver=settings.cgroup_driver)
+    journal = _build_journal(settings)
+    # Kernel-enforced device gate (actuation/gate.py): EVERY device
+    # grant/revoke crosses this seam. TPU_GATE=auto (default) picks the
+    # strongest backend (eBPF policy map on cgroup v2, devices.allow/deny
+    # on v1); TPU_GATE=legacy reverts to direct controller calls
+    # byte-for-byte. Journaled for crash convergence when a journal is on.
+    from gpumounter_tpu.actuation.gate import build_gate
+    gate = build_gate(settings, cgroups, journal=journal)
+    if gate.live:
+        _HealthHandler.gate = gate
+        logger.info("device gate enabled: backend=%s", gate.backend.name)
     actuator = ProcRootActuator(settings.host)
     if settings.agent_enabled:
         from gpumounter_tpu.actuation.agent import (AgentActuator,
@@ -221,9 +244,9 @@ def build_stack(settings: Settings) -> TPUMountService:
         actuator = AgentActuator(agent, actuator)
         _HealthHandler.agent = agent
     mounter = TPUMounter(cgroups, actuator, enumerator, settings.host,
-                         plans=collector.plans)
+                         plans=collector.plans, gate=gate)
     return TPUMountService(allocator, mounter, kube, settings,
-                           journal=_build_journal(settings))
+                           journal=journal)
 
 
 def main() -> None:
@@ -245,13 +268,20 @@ def main() -> None:
         # flight-recorder bundles on this node carry the journal tail
         from gpumounter_tpu.utils.flight import RECORDER
         RECORDER.register_provider("journal", service.journal.snapshot)
-        # BEFORE serving: a crash mid-attach must be repaired before new
-        # requests can race the leftover state
-        outcomes = service.replay_journal()
-        if outcomes:
-            logger.info("attach-journal replay: %s", outcomes)
+    # BEFORE serving: a crash mid-attach must be repaired (and the device
+    # gate converged to attachment ground truth) before new requests can
+    # race the leftover state. Runs journal-less too: gate convergence
+    # derives from the cluster, not the journal.
+    outcomes = service.replay_journal()
+    if outcomes:
+        logger.info("attach-journal replay: %s", outcomes)
+    if _HealthHandler.gate is not None:
+        # anomaly bundles answer "what was the gate enforcing / denying"
+        from gpumounter_tpu.utils.flight import RECORDER
+        RECORDER.register_provider("gate", _HealthHandler.gate.snapshot)
     from gpumounter_tpu.worker.reconciler import OrphanReconciler
-    reconciler = OrphanReconciler(service.kube, settings).start()
+    reconciler = OrphanReconciler(service.kube, settings,
+                                  gate=service.mounter.gate).start()
     pool = None
     if settings.warm_pool_enabled:
         from gpumounter_tpu.worker.pool import PoolManager
@@ -273,7 +303,8 @@ def main() -> None:
         # removes the thread and every new series.
         from gpumounter_tpu.collector.usage import build_sampler
         from gpumounter_tpu.utils.flight import RECORDER
-        sampler = build_sampler(service, settings).start()
+        sampler = build_sampler(service, settings,
+                                gate=service.mounter.gate).start()
         _HealthHandler.usage = sampler
         # anomaly bundles on this node answer "what were the chips
         # DOING" alongside the failing rid's events/traces/journal

@@ -43,10 +43,15 @@ logger = get_logger("worker.reconciler")
 
 class OrphanReconciler:
     def __init__(self, kube: KubeClient, settings: Settings | None = None,
-                 interval_s: float = 30.0):
+                 interval_s: float = 30.0, gate=None):
         self.kube = kube
         self.settings = settings or Settings()
         self.interval_s = interval_s
+        # Device gate (actuation/gate.py): each pass audits gate-vs-lease
+        # drift — a gate entry granting chips whose owner attachment is
+        # gone is a grant outliving its lease (reclaimed + surfaced on
+        # /gatez; doctor CRITs). None / legacy mode = no audit.
+        self.gate = gate
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -98,6 +103,11 @@ class OrphanReconciler:
             logger.warning("reconcile list failed: %s", e)
             return []
         deleted = []
+        # Gate drift audit input: owners PROVEN alive this pass. Collected
+        # while the orphan scan already does the liveness work; an
+        # apiserver blip keeps the owner in the live set (absence of
+        # proof ≠ dead — the audit must never revoke on a blip).
+        live_owners: set[tuple[str, str]] = set()
         for slave in slaves:
             if not self._is_ours(slave):
                 continue
@@ -122,13 +132,20 @@ class OrphanReconciler:
                         logger.warning("delete warm pod %s failed: %s",
                                        name, e)
                 continue
+            labels = objects.labels(slave)
+            owner_key = (labels.get(consts.OWNER_NAMESPACE_LABEL_KEY),
+                         labels.get(consts.OWNER_POD_LABEL_KEY))
             try:
                 if self._owner_alive(slave):
+                    if all(owner_key):
+                        live_owners.add(owner_key)
                     continue
             except K8sApiError as e:
                 logger.warning("owner check for %s failed: %s",
                                objects.name(slave), e)
-                continue        # apiserver blip ≠ dead owner
+                if all(owner_key):
+                    live_owners.add(owner_key)  # blip ≠ dead owner
+                continue
             name = objects.name(slave)
             logger.info("deleting orphaned slave pod %s (owner %s/%s gone)",
                         name,
@@ -141,7 +158,34 @@ class OrphanReconciler:
                 REGISTRY.orphans_reclaimed.inc()
             except K8sApiError as e:
                 logger.warning("delete orphan %s failed: %s", name, e)
+        self._audit_gate(live_owners)
         return deleted
+
+    def _audit_gate(self, live_owners: set[tuple[str, str]]) -> None:
+        """Gate-vs-lease drift audit. ``live_owners`` carries owners the
+        slave scan proved alive; gate entries naming OTHER owners (e.g. a
+        pod whose chips all came from its own spec — no slave pods to
+        list) get their own liveness check before the gate may treat them
+        as drift. Every uncertainty (apiserver blip) counts as alive: the
+        audit reclaims only definitively-dead owners' grants."""
+        if self.gate is None or not self.gate.live:
+            return
+        audited = set(live_owners)
+        for owner in self.gate.owners() - audited:
+            namespace, name = owner
+            try:
+                pod = self.kube.get_pod(namespace, name)
+            except PodNotFoundError:
+                continue                     # definitively gone: drift
+            except K8sApiError:
+                audited.add(owner)           # blip ≠ dead owner
+                continue
+            if not objects.is_terminal(pod):
+                audited.add(owner)
+        self.gate.audit(audited)
+        # keep the exact open/deny counters flowing even on nodes where
+        # the usage sampler is off (its loop is the primary pump)
+        self.gate.pump()
 
     # -- background loop -------------------------------------------------------
 

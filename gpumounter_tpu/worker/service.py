@@ -477,8 +477,12 @@ class TPUMountService:
                      if c.uuid not in {x.uuid for x in chips}]
         try:
             with trace.span("actuate"):
+                # cause rides into the gate revoke: a broker-initiated
+                # detach (lease expiry / preemption) of a BUSY device
+                # still cuts gate access instantly before the busy error
+                # returns — re-opens deny-with-reason from here on
                 self.mounter.unmount_chips(pod, chips, remaining,
-                                           force=force)
+                                           force=force, cause=cause)
         except DeviceBusyError as e:
             # ref server.go:148-153 GPUBusy; holder PIDs surfaced to caller
             self._record_event(
@@ -769,9 +773,18 @@ class TPUMountService:
         trusted from the journal alone — the cluster moved on while this
         worker was down. Returns {outcome: count}; each outcome also feeds
         ``tpumounter_journal_replays_total``."""
-        if self.journal is None:
-            return {}
         outcomes: collections.Counter = collections.Counter()
+        if self.journal is None:
+            # no journal (disabled / unwritable dir): attach replay has
+            # nothing to work from, but GATE convergence must still run
+            # — it derives desired state from cluster ground truth, not
+            # the journal, and a crash-orphaned kernel grant would
+            # otherwise never be reclaimed in this supported config
+            gate_stats = self._converge_gate()
+            for outcome, count in gate_stats.items():
+                if count:
+                    outcomes[f"gate_{outcome}"] += count
+            return dict(outcomes)
         for record in self.journal.incomplete():
             try:
                 outcome = self._replay_record(record)
@@ -791,6 +804,17 @@ class TPUMountService:
             logger.info("journal replay %s (%s/%s devices=%s): %s",
                         record.get("jid"), record.get("namespace"),
                         record.get("pod"), record.get("devices"), outcome)
+        # Gate convergence: re-derive desired policy-map contents from
+        # attachment ground truth and make the live maps match — orphan
+        # entries revoked, missing grants restored, pending gate records
+        # resolved. Runs AFTER the per-record replay so the cluster state
+        # it derives from is post-repair.
+        gate_stats = self._converge_gate()
+        for outcome, count in gate_stats.items():
+            if count:
+                outcomes[f"gate_{outcome}"] += count
+        if gate_stats:
+            logger.info("gate convergence: %s", gate_stats)
         self.journal.compact()
         if self.journal.backlog():
             # replay could not resolve everything (busy devices, apiserver
@@ -798,6 +822,66 @@ class TPUMountService:
             RECORDER.note("journal_backlog",
                           backlog=self.journal.backlog())
         return dict(outcomes)
+
+    def _converge_gate(self) -> dict:
+        """Re-grant every live attachment through the gate and sweep
+        orphan gate state (worker/journal.py gate records + the backend's
+        own enumeration). The desired map contents come from CLUSTER
+        ground truth — slave-pod owner labels + the kubelet's device
+        assignments — never from the dead process's memory."""
+        gate = self.mounter.gate
+        if not gate.live:
+            return {}
+        pending = self.journal.pending_gates() \
+            if self.journal is not None else []
+        desired: list[tuple] = []
+        try:
+            self.allocator.collector.update_status()
+            owners: dict[tuple[str, str], list[str]] = {}
+            selector = (f"{consts.SLAVE_POD_LABEL_KEY}="
+                        f"{consts.SLAVE_POD_LABEL_VALUE}")
+            for slave in self.reads.list_pods(
+                    self.settings.pool_namespace,
+                    label_selector=selector):
+                labels = objects.labels(slave)
+                owner = labels.get(consts.OWNER_POD_LABEL_KEY)
+                owner_ns = labels.get(consts.OWNER_NAMESPACE_LABEL_KEY)
+                if owner and owner_ns:
+                    owners.setdefault((owner_ns, owner), []).append(
+                        objects.name(slave))
+            for (owner_ns, owner), slaves in sorted(owners.items()):
+                try:
+                    pod = self.reads.get_pod(owner_ns, owner)
+                except PodNotFoundError:
+                    continue        # reconciler GCs the slaves
+                if not objects.is_running(pod):
+                    continue
+                chips = \
+                    self.allocator.collector.get_pod_tpu_resources_exact(
+                        owner, owner_ns, slaves, refresh=False)
+                if not chips:
+                    continue
+                try:
+                    containers = self.mounter._actuatable_containers(pod)
+                except TPUMounterError:
+                    continue
+                for container_id, _pid in containers:
+                    desired.append((pod, container_id, chips))
+        except TPUMounterError as e:
+            logger.warning("gate convergence could not derive ground "
+                           "truth: %s (retried next boot)", e)
+            return {}
+        from gpumounter_tpu.actuation.bpf import chip_majmins
+        majmins = set(chip_majmins(self.allocator.collector.chips))
+        stats = gate.converge(desired, all_chip_majmins=majmins)
+        # Pending gate mutations are subsumed by a CLEAN convergence;
+        # any failure (unreadable container, backend trouble) keeps the
+        # records incomplete so the next boot retries — resolving them
+        # over a divergent map would drop the crash evidence.
+        if self.journal is not None and not stats.get("failed"):
+            for record in pending:
+                self.journal.gate_commit(record["jid"])
+        return stats
 
     def _replay_record(self, record: dict) -> str:
         namespace, pod_name = record["namespace"], record["pod"]

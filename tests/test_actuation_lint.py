@@ -11,6 +11,7 @@ import inspect
 import gpumounter_tpu.actuation.agent as agent_mod
 import gpumounter_tpu.actuation.bpf as bpf_mod
 import gpumounter_tpu.actuation.cgroup as cgroup_mod
+import gpumounter_tpu.actuation.gate as gate_mod
 import gpumounter_tpu.actuation.mount as mount_mod
 import gpumounter_tpu.actuation.nsenter as nsenter_mod
 import gpumounter_tpu.allocator.allocator as allocator_mod
@@ -25,7 +26,7 @@ import gpumounter_tpu.worker.service as service_mod
 
 # Everything an AddTPU/RemoveTPU can touch while the agent is enabled.
 HOT_PATH_MODULES = (
-    agent_mod, mount_mod, cgroup_mod, bpf_mod,
+    agent_mod, mount_mod, cgroup_mod, bpf_mod, gate_mod,
     service_mod, pool_mod, allocator_mod,
     collector_mod, podresources_mod, enumerator_mod, plan_mod,
     client_mod, informer_mod,

@@ -230,3 +230,116 @@ def test_production_revoke_with_chip_still_in_dev(gate, cg2, tmp_path):
                 os.rmdir(d)
             except OSError:
                 pass
+
+
+# -- map-driven gate (PR 12): kernel-proven ------------------------------------
+
+def _in_cgroup_open(cgroup: str, path: str, flags=os.O_RDONLY) -> int:
+    """fork a child, move it into the cgroup, try open(2); returns 0 on
+    success or the child's errno (EPERM = the device program denied)."""
+    pid = os.fork()
+    if pid == 0:
+        try:
+            with open(os.path.join(cgroup, "cgroup.procs"), "w") as f:
+                f.write(str(os.getpid()))
+            os.close(os.open(path, flags))
+            os._exit(0)
+        except OSError as e:
+            os._exit(e.errno or 99)
+    return os.waitstatus_to_exitcode(os.waitpid(pid, 0)[1])
+
+
+def test_map_gate_grant_revoke_and_exact_counters(gate, cg2):
+    """The PR 12 enforcement point against a live kernel: attach the
+    map program over a runc-style baseline, prove grant/deny through
+    real open(2) calls in the cgroup, revoke IN PLACE (map update, no
+    program replacement), and read back the exact open/deny counters
+    the program maintained."""
+    _attach_runtime_program(gate, cg2)
+    # grant /dev/null rwm + a read-only wildcard on major 1
+    rules = [DeviceRule("c", ACC_RWM, 1, 3),
+             DeviceRule("c", ACC_READ, 1, None)]
+    rc, map_fd = gate.map_attach(cg2, rules)
+    assert rc == BpfGate.MAP_ATTACHED and map_fd >= 0
+    assert gate.attached_count(cg2) == 1          # replaced, not stacked
+    try:
+        assert _in_cgroup_open(cg2, "/dev/null") == 0
+        assert _in_cgroup_open(cg2, "/dev/null", os.O_RDWR) == 0
+        assert _in_cgroup_open(cg2, "/dev/zero") == 0      # via wildcard
+        assert _in_cgroup_open(cg2, "/dev/zero",
+                               os.O_RDWR) == 1             # EPERM: r only
+        assert _in_cgroup_open(cg2, "/dev/tty") == 1       # ungranted
+        # in-place revocation: drop the exact /dev/null grant
+        gate.map_sync(map_fd, [DeviceRule("c", ACC_READ, 1, None)])
+        assert gate.attached_count(cg2) == 1      # SAME program, new map
+        assert _in_cgroup_open(cg2, "/dev/null",
+                               os.O_RDWR) == 1             # now denied
+        assert _in_cgroup_open(cg2, "/dev/null") == 0      # wildcard read
+        live, opens, denies = gate.map_read(map_fd)
+        assert {(r.dev_type, r.major, r.minor) for r in live} == \
+            {("c", 1, None)}
+        assert denies == 3                        # the three EPERMs above
+        # adoption: a "restarted worker" recovers the SAME live map —
+        # counters and policy survive the process death
+        rc2, map_fd2 = gate.map_attach(cg2, [DeviceRule("c", ACC_READ,
+                                                        1, None)])
+        assert rc2 == BpfGate.MAP_ADOPTED
+        _l, _o, denies2 = gate.map_read(map_fd2)
+        assert denies2 == denies
+        gate.map_close(map_fd2)
+    finally:
+        gate.map_close(map_fd)
+
+
+def test_map_gate_noop_on_unrestricted_cgroup(gate, cg2):
+    rc, map_fd = gate.map_attach(cg2, [DeviceRule("c", ACC_RWM, 1, 3)])
+    assert rc == BpfGate.MAP_NOOP and map_fd == -1
+    assert gate.attached_count(cg2) == 0
+
+
+def test_map_recover_discovers_previous_incarnations_maps(gate, cg2):
+    """Restart-time orphan discovery: a NEW worker process (fresh
+    NativeGateBackend, empty fd cache) walks the cgroup tree, adopts a
+    crash-surviving map via the recover-only probe, and the converge
+    orphan sweep can then strip a dead owner's chip grants IN the kernel
+    — the enumeration in-process state cannot provide."""
+    from gpumounter_tpu.actuation.gate import NativeGateBackend
+    rules = [DeviceRule("c", ACC_RWM, 1, 3),
+             DeviceRule("c", ACC_RW, CHIP_MAJOR, 0)]
+    # recover-only probe semantics on a directly-gated cgroup
+    _attach_runtime_program(gate, cg2)
+    rc, map_fd = gate.map_attach(cg2, rules)
+    assert rc == BpfGate.MAP_ATTACHED
+    gate.map_close(map_fd)                    # the old process died
+    rc, fd = gate.map_recover(cg2)
+    assert rc == BpfGate.MAP_ADOPTED and fd >= 0
+    live, _opens, _denies = gate.map_read(fd)
+    assert {(r.dev_type, r.major, r.minor) for r in live} == \
+        {("c", 1, 3), ("c", CHIP_MAJOR, 0)}
+    gate.map_close(fd)
+    # ungated dir: no adoption, no mutation
+    mnt = os.path.dirname(cg2)
+    assert gate.map_recover(mnt)[0] == BpfGate.MAP_NOOP
+    # the discovery WALK: stage a kubepods-shaped subtree holding a gated
+    # container from the "previous incarnation", then point a FRESH
+    # backend (empty fd cache — the restarted worker) at the root
+    kube_top = os.path.join(mnt, "kubepods")
+    nested = os.path.join(kube_top, "pod-dead", "container-x")
+    os.makedirs(nested)
+    try:
+        _attach_runtime_program(gate, nested)
+        rc, map_fd = gate.map_attach(nested, rules)
+        assert rc == BpfGate.MAP_ATTACHED
+        gate.map_close(map_fd)
+        backend = NativeGateBackend(gate, cgroup_root=mnt)
+        assert backend.discover() == 1
+        assert nested in backend.keys()
+        live, _o, _d = backend.read(nested)
+        assert ("c", CHIP_MAJOR, 0) in live
+        backend.remove(nested)
+    finally:
+        for d in (nested, os.path.dirname(nested), kube_top):
+            try:
+                os.rmdir(d)
+            except OSError:
+                pass

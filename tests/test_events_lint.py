@@ -69,7 +69,7 @@ def _emits_event(func_node):
 def test_every_journal_record_writer_emits_an_event():
     funcs = _functions(_parse("worker/journal.py"))
     writers = ["AttachJournal.begin", "AttachJournal._mark",
-               "AttachJournal.record_detach"]
+               "AttachJournal.record_detach", "AttachJournal.record_gate"]
     for name in writers:
         assert name in funcs, f"{name} vanished — update this lint"
         assert _emits_event(funcs[name]), \

@@ -1,0 +1,586 @@
+"""Kernel-enforced device gate (actuation/gate.py).
+
+Covers the PR 12 contract: backend selection, in-place map grant/revoke
+through the one seam, deny-with-reason accounting (+ the burst flight
+trigger), crash-replay convergence, fault degradation to the legacy path
+without losing enforcement accounting, exact open counts through the
+usage sampler, and the TPU_GATE=legacy passthrough staying byte-for-byte
+the pre-gate behavior. The two bpf.py satellites (truncation refusal,
+access-bit merge on dedup) are pinned here too.
+"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.actuation.bpf import (ACC_MKNOD, ACC_READ, ACC_RW,
+                                          ACC_RWM, DeviceRule,
+                                          container_device_rules,
+                                          rules_for_chips)
+from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
+from gpumounter_tpu.actuation.gate import (CgroupV1GateBackend, DeviceGate,
+                                           FakeGateBackend, build_gate)
+from gpumounter_tpu.device.fake import make_chips
+from gpumounter_tpu.testing.sim import WorkerRig
+from gpumounter_tpu.utils.config import Settings
+from gpumounter_tpu.utils.errors import GateBackendError
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+
+@pytest.fixture
+def gated_rig(fake_host):
+    rig = WorkerRig(fake_host, n_chips=4, gate="fake")
+    yield rig
+    rig.close()
+
+
+def attach(rig, n=2, rid="r1"):
+    out = rig.service.add_tpu(rig.pod_name, "default", n, False,
+                              request_id=rid)
+    assert out.result.name == "SUCCESS", out.message
+    return out
+
+
+def gate_key(rig):
+    keys = rig.gate_backend.keys()
+    assert len(keys) == 1
+    return keys[0]
+
+
+# -- config: default ON, legacy opt-out ---------------------------------------
+
+def test_gate_defaults_on_and_legacy_reverts():
+    assert Settings().gate_mode == "auto"
+    assert Settings.from_env({}).gate_mode == "auto"
+    assert Settings.from_env({"TPU_GATE": "legacy"}).gate_mode == "legacy"
+    assert Settings.from_env({"TPU_GATE": "0"}).gate_mode == "legacy"
+    assert Settings.from_env({"TPU_GATE": "1"}).gate_mode == "auto"
+    with pytest.raises(ValueError):
+        Settings.from_env({"TPU_GATE": "maybe"})
+
+
+def test_build_gate_backend_selection(fake_host):
+    settings = Settings()
+    settings.host = fake_host
+    v1 = CgroupDeviceController(fake_host, driver="cgroupfs", version=1)
+    gate = build_gate(settings, v1)
+    assert gate.live and isinstance(gate.backend, CgroupV1GateBackend)
+    settings.gate_mode = "legacy"
+    gate = build_gate(settings, v1)
+    assert not gate.live and gate.mode == "legacy"
+
+
+def test_build_gate_v2_without_bpf_degrades_to_legacy(fake_host):
+    """A v2 node whose kernel/caller cannot load device programs must
+    boot DEGRADED (legacy program-replacement), never unenforced."""
+    settings = Settings()
+    settings.host = fake_host
+
+    class NoBpf:
+        def supported(self):
+            return False
+
+    v2 = CgroupDeviceController(fake_host, driver="cgroupfs", version=2,
+                                bpf_gate=NoBpf())
+    gate = build_gate(settings, v2)
+    assert not gate.live and gate.mode == "legacy"
+
+
+# -- legacy passthrough: byte-for-byte the pre-gate behavior -------------------
+
+def test_legacy_mode_is_pure_controller_passthrough(fake_host):
+    """TPU_GATE=legacy: grant/revoke land on the cgroup controller with
+    the exact pre-gate arguments — no gate state, no journal records, no
+    new metric series, /gatez disabled."""
+    calls = []
+
+    class Recorder:
+        def sync_device_access(self, pod, cid, chips):
+            calls.append(("sync", cid, [c.uuid for c in chips]))
+
+        def revoke_device_access(self, pod, cid, chips, remaining):
+            calls.append(("revoke", cid, [c.uuid for c in chips],
+                          [c.uuid for c in remaining]))
+
+    gate = DeviceGate(Recorder(), None, mode="legacy")
+    assert not gate.live
+    chips = make_chips(2)
+    denials_before = dict(REGISTRY.device_denials.series())
+    syncs_before = dict(REGISTRY.gate_syncs.series())
+    gate.grant({"metadata": {"name": "p", "namespace": "ns"}}, "c1", chips)
+    gate.revoke({"metadata": {"name": "p", "namespace": "ns"}}, "c1",
+                chips[:1], chips[1:], cause="lease-expired:t")
+    assert calls == [("sync", "c1", ["0", "1"]),
+                     ("revoke", "c1", ["0"], ["1"])]
+    assert gate.snapshot() == {"enabled": False, "mode": "legacy"}
+    assert gate.granted_uuids() == set()
+    assert gate.try_open("any", 120, 0) is True      # never denies
+    assert dict(REGISTRY.device_denials.series()) == denials_before
+    assert dict(REGISTRY.gate_syncs.series()) == syncs_before
+
+
+def test_ungated_rig_journal_has_no_gate_records(fake_host):
+    """The default (legacy) rig's /journalz payload stays byte-for-byte
+    PR 10: no gate_pending key, no gate record kinds."""
+    rig = WorkerRig(fake_host, n_chips=2)
+    try:
+        attach(rig, 1)
+        snap = rig.journal.snapshot()
+        assert "gate_pending" not in snap
+        assert all(r.get("state") not in ("gate_pending", "gate_done")
+                   for r in snap["records"])
+    finally:
+        rig.close()
+
+
+# -- map grant / revoke through the seam ---------------------------------------
+
+def test_attach_grants_defaults_plus_chips_in_the_map(gated_rig):
+    out = attach(gated_rig, 2)
+    key = gate_key(gated_rig)
+    rules, _opens, denies = gated_rig.gate_backend.read(key)
+    # chip rules present with rw+mknod
+    for chip in out.chips:
+        assert rules[("c", chip.major, chip.minor)] == ACC_RW | ACC_MKNOD
+    # container defaults preserved (e.g. /dev/null, wildcard mknod)
+    assert rules[("c", 1, 3)] == ACC_RWM
+    assert rules[("c", None, None)] == ACC_MKNOD
+    assert denies == 0
+    assert gated_rig.gate.granted_uuids() == {c.uuid for c in out.chips}
+
+
+def test_revoke_is_an_in_place_map_update_and_denies_reopens(gated_rig):
+    attach(gated_rig, 2)
+    key = gate_key(gated_rig)
+    assert gated_rig.gate.try_open(key, 120, 0)
+    out = gated_rig.service.remove_tpu(gated_rig.pod_name, "default",
+                                       ["0"], False)
+    assert out.result.name == "SUCCESS"
+    rules, _opens, _denies = gated_rig.gate_backend.read(key)
+    assert ("c", 120, 0) not in rules
+    assert rules[("c", 120, 1)] == ACC_RW | ACC_MKNOD     # survivor kept
+    # the evicted device denies with the detach reason
+    assert not gated_rig.gate.try_open(key, 120, 0)
+    recent = gated_rig.gate.snapshot()["denials"]["recent"]
+    assert recent[-1]["reason"] == "revoked:detach"
+    assert recent[-1]["tenant"] == "default"
+    # the surviving chip still opens
+    assert gated_rig.gate.try_open(key, 120, 1)
+
+
+def test_broker_cause_lands_in_deny_reason(gated_rig):
+    attach(gated_rig, 1)
+    key = gate_key(gated_rig)
+    out = gated_rig.service.remove_tpu(
+        gated_rig.pod_name, "default", [], False,
+        cause="preempted:by=high/rid")
+    assert out.result.name == "SUCCESS"
+    assert not gated_rig.gate.try_open(key, 120, 0)
+    recent = gated_rig.gate.snapshot()["denials"]["recent"]
+    assert recent[-1]["reason"] == "revoked:preempted"
+
+
+def test_busy_broker_revoke_cuts_gate_access_before_busy_error(gated_rig):
+    """The hole this gate closes: a holder with an open fd no longer
+    keeps re-openable access after its lease is gone. A broker-caused
+    detach of a BUSY device still revokes through the gate (instant
+    deny) before the TPU_BUSY answer goes back; node cleanup defers."""
+    out = attach(gated_rig, 1)
+    key = gate_key(gated_rig)
+    path = out.chips[0].device_path
+    gated_rig.sim.enumerator.busy_pids = {path: [gated_rig.pid]}
+    res = gated_rig.service.remove_tpu(
+        gated_rig.pod_name, "default", [], False,
+        cause="lease-expired:short-lease")
+    assert res.result.name == "TPU_BUSY"
+    # slave pods still stand (cleanup deferred) but access is CUT
+    assert len(gated_rig.sim.slave_pods()) == 1
+    assert not gated_rig.gate.try_open(key, 120, 0)
+    recent = gated_rig.gate.snapshot()["denials"]["recent"]
+    assert recent[-1]["reason"] == "revoked:lease-expired"
+    # an OWNER-initiated busy detach (no cause) keeps today's semantics:
+    # busy error, access untouched
+    gated_rig.sim.enumerator.busy_pids = {}
+    attach2 = gated_rig.service.remove_tpu(gated_rig.pod_name, "default",
+                                           [], False)
+    assert attach2.result.name == "SUCCESS"
+
+
+def test_owner_busy_detach_without_cause_does_not_revoke(gated_rig):
+    out = attach(gated_rig, 1)
+    key = gate_key(gated_rig)
+    path = out.chips[0].device_path
+    gated_rig.sim.enumerator.busy_pids = {path: [gated_rig.pid]}
+    res = gated_rig.service.remove_tpu(gated_rig.pod_name, "default",
+                                       [], False)
+    assert res.result.name == "TPU_BUSY"
+    assert gated_rig.gate.try_open(key, 120, 0)     # still granted
+
+
+# -- deny accounting + flight trigger ------------------------------------------
+
+def test_denial_burst_dumps_one_flight_bundle(gated_rig, tmp_path):
+    from gpumounter_tpu.utils.flight import RECORDER
+    attach(gated_rig, 1)
+    key = gate_key(gated_rig)
+    gated_rig.service.remove_tpu(gated_rig.pod_name, "default", [], False,
+                                 cause="lease-expired:t")
+    RECORDER.configure(str(tmp_path), min_interval_s=0.0, settle_s=0.0)
+    try:
+        for _ in range(3):                   # DENIAL_BURST = 3 within 60s
+            assert not gated_rig.gate.try_open(key, 120, 0)
+        bundles = [n for n in os.listdir(tmp_path)
+                   if "device_denial_burst" in n]
+        assert len(bundles) == 1
+        with open(tmp_path / bundles[0]) as f:
+            bundle = json.load(f)
+        assert bundle["trigger"] == "device_denial_burst"
+    finally:
+        RECORDER.configure(None)
+
+
+def test_denials_metric_carries_tenant_and_reason(gated_rig):
+    attach(gated_rig, 1)
+    key = gate_key(gated_rig)
+    before = REGISTRY.device_denials.value(tenant="default",
+                                           reason="revoked:lease-expired")
+    gated_rig.service.remove_tpu(gated_rig.pod_name, "default", [], False,
+                                 cause="lease-expired:t")
+    assert not gated_rig.gate.try_open(key, 120, 0)
+    after = REGISTRY.device_denials.value(tenant="default",
+                                          reason="revoked:lease-expired")
+    assert after - before == 1
+
+
+# -- fault degradation ---------------------------------------------------------
+
+def test_backend_fault_degrades_to_legacy_never_unenforced(gated_rig):
+    """A backend fault must not fail the attach OR skip enforcement: the
+    mutation lands through the legacy controller (v1 file writes here),
+    the fault is counted+evented, and the gate's accounting still tracks
+    the applied state."""
+    faults_before = REGISTRY.gate_syncs.value(backend="fake",
+                                              outcome="fault")
+    gated_rig.gate_backend.fail_ops = 1
+    out = attach(gated_rig, 2)
+    assert REGISTRY.gate_syncs.value(backend="fake",
+                                     outcome="fault") - faults_before == 1
+    # legacy v1 write happened: the devices.allow file carries the chips
+    with open(os.path.join(gated_rig.cgroup_dir, "devices.allow")) as f:
+        allowed = f.read()
+    for chip in out.chips:
+        assert f"c {chip.major}:{chip.minor} rw" in allowed
+    # accounting survived the fault
+    assert gated_rig.gate.granted_uuids() == {c.uuid for c in out.chips}
+    snap = gated_rig.gate.snapshot()
+    assert snap["counts"]["faults"] == 1
+    # the next mutation re-establishes the backend
+    res = gated_rig.service.remove_tpu(gated_rig.pod_name, "default",
+                                       [], False)
+    assert res.result.name == "SUCCESS"
+    assert gated_rig.gate.granted_uuids() == set()
+
+
+# -- replay convergence --------------------------------------------------------
+
+def test_replay_converges_orphan_entries_and_missing_grants(gated_rig):
+    out = attach(gated_rig, 2)
+    key = gate_key(gated_rig)
+    # corrupt the "kernel" state both ways: an orphan grant for a chip
+    # the pod does not hold, and a lost grant for one it does
+    maps = gated_rig.gate_backend.maps[key]
+    maps[("c", 120, 3)] = ACC_RWM                    # orphan map entry
+    del maps[("c", 120, 0)]                          # lost grant
+    gated_rig.gate_backend.maps["/stale/container"] = {
+        ("c", 120, 2): ACC_RW}                       # whole orphan map
+    stats = gated_rig.service.replay_journal()
+    assert stats.get("gate_restored", 0) >= 1
+    assert stats.get("gate_orphans_revoked", 0) == 1
+    rules, _o, _d = gated_rig.gate_backend.read(key)
+    assert ("c", 120, 0) in rules                    # grant restored
+    assert ("c", 120, 3) not in rules                # orphan entry gone
+    # the orphan container's chip rules are REVOKED by an in-place sync
+    # (forgetting the map would not revoke anything — the kernel program
+    # keeps its own reference); the map itself stays, chip-free
+    stale, _o2, _d2 = gated_rig.gate_backend.read("/stale/container")
+    assert ("c", 120, 2) not in stale
+    assert not gated_rig.gate_backend.try_open("/stale/container", 120, 2)
+    assert gated_rig.gate.granted_uuids() == {c.uuid for c in out.chips}
+
+
+# -- reconciler drift audit ----------------------------------------------------
+
+def test_reconciler_audit_reclaims_dead_owner_grants(gated_rig):
+    from gpumounter_tpu.worker.reconciler import OrphanReconciler
+    attach(gated_rig, 1)
+    key = gate_key(gated_rig)
+    reconciler = OrphanReconciler(gated_rig.sim.kube,
+                                  gated_rig.sim.settings,
+                                  gate=gated_rig.gate)
+    # owner alive: no drift
+    reconciler.scan_once()
+    assert gated_rig.gate.snapshot()["drift"]["count"] == 0
+    assert key in gated_rig.gate_backend.keys()
+    # owner pod dies (delete) — audit must REVOKE the grant in place:
+    # the chip rules vanish from the live map (a forgotten map would
+    # keep enforcing ALLOW in the kernel) while defaults survive
+    gated_rig.sim.kube.delete_pod("default", gated_rig.pod_name)
+    reconciler.scan_once()
+    snap = gated_rig.gate.snapshot()
+    assert snap["drift"]["count"] == 1
+    rules, _opens, _denies = gated_rig.gate_backend.read(key)
+    assert ("c", 120, 0) not in rules
+    assert rules[("c", 1, 3)]                        # defaults kept
+    assert not gated_rig.gate_backend.try_open(key, 120, 0)
+    assert gated_rig.gate.granted_uuids() == set()
+    assert REGISTRY.gate_drift.value() == 1
+
+
+def test_adopted_map_history_is_not_replayed_as_fresh_deltas(fake_host):
+    """A restarted worker ADOPTS the live map with its lifetime
+    counters (that survival is the point) — pump must baseline at the
+    current values, not attribute the whole history as new opens and
+    reasonless denials (which would spike counters and fire a false
+    denial-burst bundle on every restart)."""
+    from gpumounter_tpu.actuation.gate import DeviceGate, FakeGateBackend
+    rig = WorkerRig(fake_host, n_chips=2, gate="fake")
+    try:
+        out = attach(rig, 1)
+        key = gate_key(rig)
+        # history before the "restart": opens and denials on the kernel
+        for _ in range(4):
+            assert rig.gate.try_open(key, 120, 0)
+        assert not rig.gate.try_open(key, 120, 1)    # 1 deny on record
+        # "restart": fresh gate over the SAME backend (the live kernel)
+        gate2 = DeviceGate(rig.cgroups, rig.gate_backend,
+                           journal=rig.journal, mode="auto")
+        rig.gate = gate2
+        rig.mounter.gate = gate2
+        opens_before = REGISTRY.device_opens.value(tenant="default",
+                                                   outcome="attributed")
+        denials_series_before = dict(REGISTRY.device_denials.series())
+        rig.service.replay_journal()                 # converge adopts
+        pumped = gate2.pump()
+        assert REGISTRY.device_opens.value(
+            tenant="default", outcome="attributed") == opens_before
+        assert dict(REGISTRY.device_denials.series()) == \
+            denials_series_before
+        assert gate2.snapshot()["denials"]["recent"] == []
+        # NEW activity after the restart still counts exactly
+        assert rig.gate.try_open(key, 120, 0)
+        gate2.pump()
+        assert REGISTRY.device_opens.value(
+            tenant="default", outcome="attributed") - opens_before == 1
+    finally:
+        rig.close()
+
+
+# -- exact open counts through the usage sampler -------------------------------
+
+def test_gate_exact_opens_replace_edge_accounting(fake_host):
+    from gpumounter_tpu.collector.usage import (ChipUsageSampler,
+                                                FakeUsageProbe)
+    rig = WorkerRig(fake_host, n_chips=2, gate="fake")
+    try:
+        out = attach(rig, 1)
+        key = gate_key(rig)
+        probe = FakeUsageProbe()
+        sampler = ChipUsageSampler(rig.sim.collector, probe,
+                                   pool_namespace=rig.sim.settings
+                                   .pool_namespace, gate=rig.gate)
+        opens_before = REGISTRY.device_opens.value(tenant="default",
+                                                   outcome="attributed")
+        unattr_before = REGISTRY.device_opens.value(
+            tenant="", outcome="unattributed")
+        # three exact opens through the gate
+        for _ in range(3):
+            assert rig.gate.try_open(key, 120, 0)
+        # the chip reads busy with NO owner resolution (owners_fn absent):
+        # pre-gate this would count an UNATTRIBUTED edge open
+        probe.set_duty(out.chips[0].uuid, 1.0)
+        entry = sampler.sample_once()
+        assert entry["chips"][out.chips[0].uuid]["gated"] is True
+        opens_after = REGISTRY.device_opens.value(tenant="default",
+                                                  outcome="attributed")
+        assert opens_after - opens_before == 3       # exact, not edges
+        assert REGISTRY.device_opens.value(
+            tenant="", outcome="unattributed") == unattr_before
+        # /utilz shows the exact count for the gated chip
+        snap = sampler.snapshot()
+        row = [c for c in snap["chips"]
+               if c["chip"] == out.chips[0].uuid][0]
+        assert row["opens"] == 3
+    finally:
+        rig.close()
+
+
+# -- /gatez endpoint + CLI -----------------------------------------------------
+
+def test_gatez_endpoint_and_cli(gated_rig, capsys):
+    from gpumounter_tpu.worker.main import start_health_server
+    attach(gated_rig, 1)
+    key = gate_key(gated_rig)
+    gated_rig.service.remove_tpu(gated_rig.pod_name, "default", [], False,
+                                 cause="lease-expired:t")
+    assert not gated_rig.gate.try_open(key, 120, 0)
+    server = start_health_server(0, gate=gated_rig.gate, ready=True)
+    try:
+        base = f"http://127.0.0.1:{server.server_port}"
+        with urllib.request.urlopen(f"{base}/gatez", timeout=5) as resp:
+            payload = json.loads(resp.read())
+        assert payload["enabled"] and payload["backend"] == "fake"
+        assert payload["denials"]["total"] == 1
+        assert payload["denials"]["recent"][-1]["reason"] == \
+            "revoked:lease-expired"
+        # CLI renders it and exits non-zero on denials
+        from gpumounter_tpu.cli import main as cli_main
+        rc = cli_main(["gatez", "--master", base])
+        out = capsys.readouterr().out
+        assert rc != 0
+        assert "revoked:lease-expired" in out
+        # --json emits the raw payload, same exit contract
+        rc = cli_main(["gatez", "--master", base, "--json"])
+        assert rc != 0
+    finally:
+        server.shutdown()
+
+
+def test_doctor_crits_on_gate_drift(gated_rig, capsys):
+    from gpumounter_tpu.cli import main as cli_main
+    from gpumounter_tpu.worker.main import start_health_server
+    from gpumounter_tpu.worker.reconciler import OrphanReconciler
+    attach(gated_rig, 1)
+    server = start_health_server(0, gate=gated_rig.gate, ready=True)
+    try:
+        base = f"http://127.0.0.1:{server.server_port}"
+        rc = cli_main(["doctor", "--master", base])
+        out = capsys.readouterr().out
+        assert "device gate healthy" in out
+        # kill the owner, let the audit find the drift → doctor CRITs
+        gated_rig.sim.kube.delete_pod("default", gated_rig.pod_name)
+        OrphanReconciler(gated_rig.sim.kube, gated_rig.sim.settings,
+                         gate=gated_rig.gate).scan_once()
+        rc = cli_main(["doctor", "--master", base])
+        out = capsys.readouterr().out
+        assert rc == 12                      # EXIT_DOCTOR_CRIT
+        assert "device gate drift" in out
+    finally:
+        server.shutdown()
+
+
+def test_gatez_disabled_payload(fake_host):
+    from gpumounter_tpu.worker.main import start_health_server
+    server = start_health_server(0, gate=None, ready=True)
+    try:
+        base = f"http://127.0.0.1:{server.server_port}"
+        with urllib.request.urlopen(f"{base}/gatez", timeout=5) as resp:
+            assert json.loads(resp.read()) == {"enabled": False}
+        from gpumounter_tpu.cli import main as cli_main
+        assert cli_main(["gatez", "--master", base]) == 0
+    finally:
+        server.shutdown()
+
+
+# -- v1 backend ----------------------------------------------------------------
+
+def test_v1_backend_diffs_against_shadow(fake_host, tmp_path):
+    controller = CgroupDeviceController(fake_host, driver="cgroupfs",
+                                        version=1)
+    backend = CgroupV1GateBackend(controller)
+    cgroup_dir = os.path.join(fake_host.cgroup_root, "devices", "kubepods",
+                              "podx", "c1")
+    os.makedirs(cgroup_dir)
+    pod = {"metadata": {"name": "p", "namespace": "ns", "uid": "podx"},
+           "status": {"qosClass": "Guaranteed"}}
+    backend.address(cgroup_dir, pod, "c1")
+    # route writes at the fixture dir directly
+    controller._v1_devices_dir = lambda *a: cgroup_dir
+    rules = [DeviceRule("c", ACC_RW | ACC_MKNOD, 120, 0),
+             DeviceRule("c", ACC_RW | ACC_MKNOD, 120, 1)]
+    assert backend.attach(cgroup_dir, rules) == "attached"
+    with open(os.path.join(cgroup_dir, "devices.allow")) as f:
+        assert f.read().count("\n") == 2
+    # identical re-sync: zero writes
+    backend.sync(cgroup_dir, rules)
+    with open(os.path.join(cgroup_dir, "devices.allow")) as f:
+        assert f.read().count("\n") == 2
+    # revoke one: a deny line, no extra allows
+    backend.sync(cgroup_dir, rules[1:])
+    with open(os.path.join(cgroup_dir, "devices.deny")) as f:
+        assert "c 120:0 rw" in f.read()
+    live, _opens, _denies = backend.read(cgroup_dir)
+    assert ("c", 120, 0) not in live and ("c", 120, 1) in live
+
+
+def test_v1_revocation_fails_closed_without_shadow(fake_host):
+    """A v1 backend with NO shadow for the container (restart before
+    convergence reached it, prior fault) must still write the explicit
+    deny — a shadow diff alone would silently skip the revocation and
+    re-open the evicted-holder hole."""
+    controller = CgroupDeviceController(fake_host, driver="cgroupfs",
+                                        version=1)
+    backend = CgroupV1GateBackend(controller)
+    cgroup_dir = os.path.join(fake_host.cgroup_root, "devices",
+                              "kubepods", "pody", "c1")
+    os.makedirs(cgroup_dir)
+    pod = {"metadata": {"name": "p", "namespace": "ns", "uid": "pody"},
+           "status": {"qosClass": "Guaranteed"}}
+    backend.address(cgroup_dir, pod, "c1")
+    controller._v1_devices_dir = lambda *a: cgroup_dir
+    assert cgroup_dir not in backend.keys()          # no shadow at all
+    backend.attach(cgroup_dir,
+                   [DeviceRule("c", ACC_RW | ACC_MKNOD, 120, 1)],
+                   deny=[(120, 0)])
+    with open(os.path.join(cgroup_dir, "devices.deny")) as f:
+        assert "c 120:0 rw" in f.read()
+    with open(os.path.join(cgroup_dir, "devices.allow")) as f:
+        assert "c 120:1 rw" in f.read()
+
+
+def test_v1_backend_keeps_edge_accounting(fake_host):
+    """v1 has no kernel counters (write-only surface): pump() must NOT
+    mark its chips covered, or the sampler would stop edge accounting
+    with no exact counts ever arriving — device opens would go dark."""
+    from gpumounter_tpu.actuation.gate import DeviceGate
+    controller = CgroupDeviceController(fake_host, driver="cgroupfs",
+                                        version=1)
+    gate = DeviceGate(controller, CgroupV1GateBackend(controller),
+                      mode="auto")
+    assert gate.live and not gate.backend.exact_counters
+    assert gate.pump() == {"opens": {}, "covered": set()}
+
+
+# -- bpf.py satellites ---------------------------------------------------------
+
+def test_rules_for_chips_merges_access_bits_on_equal_majmin():
+    """An observed NARROW rule sharing a chip's (type, major, minor) must
+    not shadow the chip grant — the bits merge."""
+    chips = make_chips(1)                   # c 120:0
+    observed = [DeviceRule("c", ACC_READ, 120, 0)]
+    rules = rules_for_chips(chips, observed=observed)
+    merged = [r for r in rules
+              if (r.dev_type, r.major, r.minor) == ("c", 120, 0)]
+    assert len(merged) == 1
+    assert merged[0].access == ACC_READ | ACC_RW | ACC_MKNOD
+    # and the reverse: a WIDER observed rule keeps its extra bits when
+    # the chip grant lands on the same key
+    observed = [DeviceRule("c", ACC_RWM, 120, 0)]
+    merged = [r for r in rules_for_chips(chips, observed=observed)
+              if (r.dev_type, r.major, r.minor) == ("c", 120, 0)]
+    assert merged[0].access == ACC_RWM
+
+
+def test_container_device_rules_refuses_truncation(tmp_path):
+    """Hitting the scan limit raises like the unreadable-/dev case: a
+    partial baseline composed as ground truth would silently revoke
+    runtime grants past the cap."""
+    dev = tmp_path / "4242" / "root" / "dev"
+    dev.mkdir(parents=True)
+    for i in range(5):
+        (dev / f"node{i}").write_text("x")
+        (dev / f"node{i}.majmin").write_text(f"1:{i}")
+    assert len(container_device_rules(str(tmp_path), 4242, limit=5)) == 5
+    with pytest.raises(OSError, match="exceeds 4"):
+        container_device_rules(str(tmp_path), 4242, limit=4)

@@ -1,0 +1,187 @@
+"""Chaos plans for the kernel device gate (the PR 12 acceptance pins).
+
+Three scenarios the gate exists to survive:
+
+- an **open-fd holder after lease expiry**: the broker's reap finds the
+  device busy and defers node cleanup — but gate access is cut within
+  that SAME tick, with zero fork/nsenter on the revoke path, so every
+  re-open denies with the lease-expiry reason;
+- a **worker killed mid-revoke**: the gate revoked, nodes were never
+  unlinked, the process died. Restart convergence re-derives desired map
+  contents from attachment ground truth — no gate grant outlives its
+  lease, no live lease loses its grant;
+- a **backend fault mid-plan**: enforcement degrades to the legacy path
+  (counted + evented) without ever leaving a mutation unenforced and
+  without corrupting the gate's accounting.
+
+``assert_invariants`` point 5 (gate == ground truth) runs after every
+plan.
+"""
+
+import time
+
+import pytest
+
+from gpumounter_tpu.testing.chaos import (ChaosRig, WorkerCrash,
+                                          assert_invariants)
+from gpumounter_tpu.utils.metrics import REGISTRY
+from tests.test_broker import BrokerStack, add
+
+
+@pytest.fixture
+def chaos(fake_host):
+    rig = ChaosRig(fake_host, n_chips=4, gate="fake")
+    yield rig
+    rig.close()
+
+
+def _gate_key(rig):
+    keys = rig.gate_backend.keys()
+    assert keys, "no gated container"
+    return keys[0]
+
+
+# -- acceptance 1: expired lease => deny-on-open within one broker tick --------
+
+def test_open_fd_holder_denied_within_one_tick_of_expiry(fake_host):
+    from gpumounter_tpu.master.admission import BrokerConfig
+    stack = BrokerStack(fake_host,
+                        config=BrokerConfig(lease_ttl_s=0.3),
+                        gate="fake")
+    try:
+        rig = stack.rig
+        gw = stack.gateway
+        status, body = add(gw, "workload", 1)
+        assert status == 200
+        path = body["device_paths"][0]
+        key = _gate_key(rig)
+        # a workload process holds the device open — the exact hole:
+        # pre-gate, it kept re-openable access forever past expiry
+        rig.sim.enumerator.busy_pids = {path: [rig.pid]}
+        assert rig.gate.try_open(key, 120, 0)
+        time.sleep(0.35)
+        syncs_before = rig.gate_backend.sync_calls
+        assert gw.broker.tick() == 0          # busy: node cleanup deferred
+        # ...but within that ONE tick, gate access is cut:
+        assert not rig.gate.try_open(key, 120, 0)
+        recent = rig.gate.snapshot()["denials"]["recent"]
+        assert recent[-1]["reason"] == "revoked:lease-expired"
+        # the revoke was an in-place map update — no program replacement,
+        # no nsenter/fork (the backend mutated; no legacy deny-file write)
+        assert rig.gate_backend.sync_calls > syncs_before
+        import os
+        assert not os.path.exists(
+            os.path.join(rig.cgroup_dir, "devices.deny"))
+        # holder exits; the deferred reap completes past the backoff and
+        # the gate ends empty, matching ground truth
+        rig.sim.enumerator.busy_pids = {}
+        time.sleep(2.1)
+        assert gw.broker.tick() == 1
+        assert rig.gate.granted_uuids() == set()
+        assert rig.sim.slave_pods() == []
+    finally:
+        stack.close()
+
+
+# -- acceptance 2: crash mid-revoke converges on restart -----------------------
+
+def test_crash_mid_revoke_converges_on_restart(chaos):
+    """Killed between the gate revoke and the node unlink: the journal
+    holds a pending gate record; the attachment (slave pods + kubelet
+    map) still stands. Restart convergence re-grants — the lease still
+    exists, so 'no lease loses its grant' wins — and the retried detach
+    then completes to empty."""
+    rig = chaos.rig
+    out = rig.service.add_tpu("workload", "default", 2, False,
+                              request_id="r1")
+    assert out.result.name == "SUCCESS"
+    uuids = {c.uuid for c in out.chips}
+    key = _gate_key(rig)
+    chaos.arm_crash("mid_revoke")
+    with pytest.raises(WorkerCrash):
+        rig.service.remove_tpu("workload", "default", [], False,
+                               request_id="r2")
+    # the crash window: access already revoked (that mutation committed),
+    # nodes still linked, reservation still held
+    assert not rig.gate.try_open(key, 120, 0)
+    replay = chaos.restart_worker()
+    rig = chaos.rig
+    # convergence restored the grant (the attachment/lease still stands)
+    assert replay.get("gate_restored", 0) >= 1
+    assert rig.gate.granted_uuids() == uuids
+    assert rig.gate.try_open(key, 120, 0)
+    assert_invariants(rig, uuids, max_attached_events=1)
+    # the caller's retried detach now completes: gate ends empty
+    res = rig.service.remove_tpu("workload", "default", [], False,
+                                 request_id="r2")
+    assert res.result.name == "SUCCESS"
+    assert rig.gate.granted_uuids() == set()
+    assert_invariants(rig, set(), max_attached_events=1)
+
+
+def test_crash_before_commit_replay_completes_attach_with_gate(chaos):
+    """The pre-existing before_commit crash plan, now gated: replay
+    completes the attach AND the gate converges to grant exactly the
+    completed attachment's chips."""
+    rig = chaos.rig
+    chaos.arm_crash("before_commit")
+    with pytest.raises(WorkerCrash):
+        rig.service.add_tpu("workload", "default", 2, False,
+                            request_id="r1")
+    replay = chaos.restart_worker()
+    rig = chaos.rig
+    assert replay.get("completed") == 1
+    granted = rig.gate.granted_uuids()
+    assert len(granted) == 2
+    assert_invariants(rig, granted, max_attached_events=1)
+
+
+def test_crash_mid_gate_sync_leaves_pending_record_replay_resolves(chaos):
+    """Killed INSIDE the gate backend mutation: the gate journal record
+    is on disk, its commit is not, and the live map never changed.
+    Restart convergence re-derives the desired contents, re-grants, and
+    resolves the pending record — no gate grant outlives its lease, no
+    lease loses its grant."""
+    rig = chaos.rig
+    out = rig.service.add_tpu("workload", "default", 2, False,
+                              request_id="r1")
+    assert out.result.name == "SUCCESS"
+    uuids = {c.uuid for c in out.chips}
+    chaos.arm_crash("mid_gate_sync")
+    with pytest.raises(WorkerCrash):
+        rig.service.remove_tpu("workload", "default", [], False,
+                               request_id="r2")
+    assert rig.journal.pending_gates()           # intent without commit
+    replay = chaos.restart_worker()
+    rig = chaos.rig
+    assert replay.get("gate_restored", 0) >= 1
+    assert not rig.journal.pending_gates()       # resolved by convergence
+    assert rig.gate.granted_uuids() == uuids     # the lease still stands
+    assert_invariants(rig, uuids, max_attached_events=1)
+    res = rig.service.remove_tpu("workload", "default", [], False,
+                                 request_id="r2")
+    assert res.result.name == "SUCCESS"
+    assert rig.gate.granted_uuids() == set()
+    assert_invariants(rig, set(), max_attached_events=1)
+
+
+# -- acceptance 3: backend fault degrades without losing accounting ------------
+
+def test_backend_fault_mid_detach_degrades_and_invariants_hold(chaos):
+    rig = chaos.rig
+    out = rig.service.add_tpu("workload", "default", 2, False,
+                              request_id="r1")
+    assert out.result.name == "SUCCESS"
+    faults_before = REGISTRY.gate_syncs.value(backend="fake",
+                                              outcome="fault")
+    rig.gate_backend.fail_ops = 1
+    res = rig.service.remove_tpu("workload", "default", [], False,
+                                 request_id="r2")
+    assert res.result.name == "SUCCESS"
+    # the fault degraded ONE mutation to the legacy path; the detach
+    # still fully enforced and the gate's ledger tracks it
+    assert REGISTRY.gate_syncs.value(
+        backend="fake", outcome="fault") - faults_before == 1
+    assert rig.gate.granted_uuids() == set()
+    assert rig.gate.snapshot()["counts"]["faults"] == 1
+    assert_invariants(rig, set(), max_attached_events=1)

@@ -741,6 +741,76 @@ def cmd_fleet(args) -> int:
     return rc
 
 
+def cmd_nodes(args) -> int:
+    """Render the master's node failure-domain view (/fleetz
+    node_health + the broker's lease table): per-node judged health
+    state, scrape staleness, and the leases still anchored to each
+    node. Exit non-zero on a DEAD node that still holds leases — the
+    exact state fencing exists to eliminate (stuck fence = stranded
+    chips + quota)."""
+    try:
+        fleetz = json.loads(_fetch_text(args.master, "/fleetz",
+                                        args.timeout))
+    except TransportError as e:
+        print(f"unreachable: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    except ValueError as e:
+        print(f"bad /fleetz payload: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    try:
+        brokerz = json.loads(_fetch_text(args.master, "/brokerz",
+                                         args.timeout))
+    except (TransportError, ValueError):
+        brokerz = {}
+    health = fleetz.get("node_health")
+    if not isinstance(health, dict):
+        _emit(fleetz, args.json,
+              "node health subsystem disabled (TPU_NODE_HEALTH=0) — "
+              "see `tpumounterctl fleet` for scrape state")
+        return 0
+    leases_by_node: dict[str, list[str]] = {}
+    for lease in (brokerz.get("leases") or {}).get("leases") or []:
+        leases_by_node.setdefault(lease.get("node") or "", []).append(
+            f"{lease.get('namespace')}/{lease.get('pod')}")
+    scrape_nodes = fleetz.get("nodes") or {}
+    entries = health.get("nodes") or {}
+    lines = [f"nodes: {len(entries)} tracked "
+             f"(suspect after {health.get('suspect_after_ticks')} "
+             f"missed tick(s), dead after "
+             f"{health.get('dead_after_ticks')})"]
+    rc = 0
+    for node in sorted(set(entries) | (set(scrape_nodes) - {""})):
+        entry = entries.get(node) or {}
+        state = entry.get("state", "healthy")
+        held = leases_by_node.get(node, [])
+        extras = []
+        if entry.get("reason"):
+            extras.append(entry["reason"])
+        if entry.get("missed_ticks"):
+            extras.append(f"{entry['missed_ticks']} missed tick(s)")
+        scrape = (scrape_nodes.get(node) or {}).get("state")
+        if scrape and scrape != "fresh":
+            extras.append(f"scrape {scrape}")
+        if held:
+            extras.append(f"{len(held)} lease(s): "
+                          + ", ".join(sorted(held)))
+        line = (f"  {node}: {state.upper()}"
+                + (f"  [{'; '.join(extras)}]" if extras else ""))
+        if state == "dead" and held:
+            line += "  <-- DEAD WITH LIVE LEASES (fence stuck?)"
+            rc = EXIT_OTHER
+        lines.append(line)
+    fenced = brokerz.get("fenced") or []
+    for entry in fenced[-5:]:
+        lines.append(f"  fenced: {entry.get('namespace')}/"
+                     f"{entry.get('pod')} ({entry.get('chips')} "
+                     f"chip(s) on {entry.get('node') or '?'}, "
+                     f"{entry.get('reason')})")
+    _emit({"node_health": health, "fenced": fenced}, args.json,
+          "\n".join(lines))
+    return rc
+
+
 def cmd_flight(args) -> int:
     """Inspect flight-recorder bundles (local TPU_FLIGHT_DIR — the
     recorder writes on the master/worker host, so run this where the
@@ -1296,6 +1366,63 @@ def cmd_doctor(args) -> int:
             check("ok", f"top burn tenant (fleetz): {top.get('tenant')} "
                         f"slo {top.get('slo')} at {top.get('burn')}x")
 
+    # Node failure domain (master/nodehealth.py): a DEAD node still
+    # holding leases is the one state fencing exists to eliminate —
+    # stranded chips counted against quota with no worker to detach
+    # them — and pages CRIT. Prolonged suspect WARNs (the node is
+    # cordoned; if it is really dead the dead window should have
+    # fired); draining nodes are reported as routine.
+    health = (fleetz or {}).get("node_health")
+    if isinstance(health, dict):
+        node_states = health.get("nodes") or {}
+        leases_on = {}
+        for lease in ((brokerz or {}).get("leases") or {}).get(
+                "leases") or []:
+            node = lease.get("node") or ""
+            leases_on[node] = leases_on.get(node, 0) + 1
+        dead_with_leases = sorted(
+            node for node, entry in node_states.items()
+            if entry.get("state") == "dead" and leases_on.get(node))
+        dead = sorted(node for node, entry in node_states.items()
+                      if entry.get("state") == "dead")
+        suspects = sorted(
+            node for node, entry in node_states.items()
+            if entry.get("state") == "suspect"
+            and time.time() - float(entry.get("since_unix") or 0) > 120)
+        draining = sorted(node for node, entry in node_states.items()
+                          if entry.get("state") == "draining")
+        if dead_with_leases:
+            check("crit",
+                  f"DEAD node(s) still holding leases: "
+                  f"{', '.join(dead_with_leases)} — fencing is stuck; "
+                  "those chips and their quota are stranded "
+                  "(`tpumounterctl nodes` for the leases)")
+        elif dead:
+            check("warn", f"dead node(s) (leases fenced): "
+                          f"{', '.join(dead)}")
+        if suspects:
+            check("warn",
+                  f"node(s) suspect > 120s: {', '.join(suspects)} — "
+                  "cordoned from new grants; if really dead the "
+                  "dead-tick window should fire, if flapping check "
+                  "the health port")
+        if draining:
+            check("ok", f"node(s) draining (graceful): "
+                        f"{', '.join(draining)}")
+        if node_states and not (dead or suspects or draining):
+            check("ok", f"node health: all {len(node_states)} node(s) "
+                        "healthy")
+        fenced = (brokerz or {}).get("fenced") or []
+        if fenced and metrics:
+            src = metrics_delta if metrics_delta is not None else metrics
+            scope = (f"in the last {window:g}s"
+                     if metrics_delta is not None else "lifetime")
+            fresh = _counter_total(src, "tpumounter_lease_fences_total")
+            check("warn" if (metrics_delta is not None and fresh)
+                  else "ok",
+                  f"lease fences: {len(fenced)} recent, "
+                  f"{int(fresh)} — {scope}")
+
     # HA posture (docs/guide/HA.md): a shard with no live leader means
     # admission for its keyspace is DOWN right now — every request 503s
     # until a replica takes it over — and pages CRIT. Leadership
@@ -1647,6 +1774,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--events", type=int, default=10,
                    help="merged lifecycle events to show (default 10)")
     p.set_defaults(fn=cmd_fleet)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser(
+        "nodes",
+        help="node failure-domain view: per-node health state "
+             "(healthy/draining/suspect/dead), leases anchored to each "
+             "node, recent fences (non-zero exit on dead-with-leases)")
+    p.set_defaults(fn=cmd_nodes)
     _add_common(p, suppress=True)
 
     p = sub.add_parser(

@@ -48,6 +48,14 @@ class WorkerDirectory:
         self._refresh_lock = threading.Lock()   # serialises apiserver LISTs
         self._by_node: dict[str, str] = {}     # node -> "ip:port" target
         self._fetched_at = 0.0
+        # Negative cache (node failure domain): a node whose worker the
+        # gateway found dead (invalidate()) fast-fails worker_target
+        # for a backoff window instead of adding a re-resolve + dial
+        # timeout to every request routed near it. node -> (until
+        # monotonic, consecutive failures, the target that failed).
+        # A refresh that maps the node to a NEW target (worker pod
+        # restarted with a new IP/port) clears the entry immediately.
+        self._negative: dict[str, tuple[float, int, str]] = {}
 
     def _refresh(self) -> None:
         """LIST outside the cache lock (a hung apiserver must not block
@@ -75,18 +83,57 @@ class WorkerDirectory:
     # Floor between miss-triggered refreshes so clients hammering a node
     # whose worker is down can't turn every request into an apiserver LIST.
     MISS_REFRESH_INTERVAL_S = 1.0
+    # Negative-cache backoff: the quarantine window arms only after
+    # this many CONSECUTIVE invalidations (a single transient blip —
+    # which the gateway's in-request retry absorbs — must not
+    # quarantine a healthy node), then doubles per failure up to the
+    # cap. The failure count decays after a quiet period.
+    NEGATIVE_AFTER_FAILURES = 3
+    NEGATIVE_TTL_BASE_S = 1.0
+    NEGATIVE_TTL_MAX_S = 30.0
+    NEGATIVE_DECAY_S = 60.0
 
     def worker_target(self, node: str) -> str:
-        """gRPC target ``ip:port`` of the worker on ``node``."""
+        """gRPC target ``ip:port`` of the worker on ``node``.
+
+        Negative-cache semantics: inside a dead node's backoff window
+        the ONLY way out is a (rate-limited) refresh resolving the node
+        to a DIFFERENT target — the worker pod was replaced, the
+        failure history belongs to the dead incarnation. Re-resolving
+        to the SAME failed target fast-fails (WorkerNotFoundError)
+        without a dial, so a dead node costs one dial timeout per
+        backoff window instead of one per request routed near it. Past
+        the window one attempt goes through half-open; failing re-arms
+        the window doubled (invalidate())."""
+        now = time.monotonic()
         with self._lock:
-            stale = time.monotonic() - self._fetched_at > self.ttl_s
+            negative = self._negative.get(node)
+            stale = now - self._fetched_at > self.ttl_s
             target = self._by_node.get(node)
+        quarantined = negative is not None and now < negative[0]
         if stale or (target is None and self._miss_refresh_allowed()):
+            self._refresh()
+            with self._lock:
+                target = self._by_node.get(node)
+        if quarantined and target == negative[2] \
+                and self._miss_refresh_allowed():
+            # quarantined and still mapping to the dead address: one
+            # rate-limited LIST may reveal a REPLACEMENT pod (the only
+            # way out of the window) — a dial is never risked on it
             self._refresh()
             with self._lock:
                 target = self._by_node.get(node)
         if not target:
             raise WorkerNotFoundError(node)
+        if negative is not None:
+            if target == negative[2] and quarantined:
+                # same dead address, window still open: fail fast —
+                # no dial timeout for this request
+                raise WorkerNotFoundError(node)
+            with self._lock:
+                current = self._negative.get(node)
+                if current is not None and target != current[2]:
+                    del self._negative[node]
         return target
 
     def _miss_refresh_allowed(self) -> bool:
@@ -115,11 +162,37 @@ class WorkerDirectory:
     def invalidate(self, node: str) -> None:
         """Drop a cached entry the caller found to be dead (e.g. gRPC
         UNAVAILABLE after a worker pod restart) so the next request
-        re-resolves instead of 502ing until the TTL expires."""
+        re-resolves instead of 502ing until the TTL expires — AND arm
+        the node's negative cache: until the backoff window passes,
+        ``worker_target`` fast-fails instead of re-LISTing and
+        re-dialing the same dead address per request. Consecutive
+        invalidations double the window (capped); a refresh that maps
+        the node to a NEW target clears it."""
+        now = time.monotonic()
         with self._lock:
-            if self._by_node.pop(node, None) is not None:
+            failed_target = self._by_node.pop(node, None)
+            if failed_target is not None:
                 # age the cache so the next lookup's miss-refresh engages
                 self._fetched_at = min(
                     self._fetched_at,
-                    time.monotonic() - self.MISS_REFRESH_INTERVAL_S - 1e-3)
-        logger.info("invalidated worker cache for node %s", node)
+                    now - self.MISS_REFRESH_INTERVAL_S - 1e-3)
+            prior = self._negative.get(node)
+            failures = prior[1] if prior is not None else 0
+            if prior is not None \
+                    and now - prior[3] > self.NEGATIVE_DECAY_S:
+                failures = 0         # quiet period: old failures expired
+            failures += 1
+            over = failures - self.NEGATIVE_AFTER_FAILURES
+            window = (min(self.NEGATIVE_TTL_MAX_S,
+                          self.NEGATIVE_TTL_BASE_S * 2 ** over)
+                      if over >= 0 else 0.0)
+            self._negative[node] = (
+                now + window, failures,
+                failed_target or (prior[2] if prior is not None else ""),
+                now)
+        if window > 0:
+            logger.info("invalidated worker cache for node %s "
+                        "(negative-cached %.1fs, consecutive failure "
+                        "#%d)", node, window, failures)
+        else:
+            logger.info("invalidated worker cache for node %s", node)

@@ -130,10 +130,16 @@ class FleetAggregator:
     def __init__(self, targets_fn, usage_fn=None, slo=None,
                  tick_interval_s: float = DEFAULT_TICK_INTERVAL_S,
                  scrape_timeout_s: float = SCRAPE_TIMEOUT_S,
-                 ha_fn=None, lease_lookup=None):
+                 ha_fn=None, lease_lookup=None, node_health=None):
         self.targets_fn = targets_fn
         self.usage_fn = usage_fn or (lambda: {})
         self.slo = slo
+        # Node failure domain (master/nodehealth.py): when bound, every
+        # tick's per-node scrape outcome (fresh/missed + the healthz
+        # text, which a draining worker changes) feeds the tracker's
+        # healthy → suspect → dead state machine. None = subsystem off
+        # — /fleetz stays byte-for-byte the pre-subsystem payload.
+        self.node_health = node_health
         # lease_lookup(namespace, pod) -> Lease | None (the broker's
         # table): joins scraped chip utilization to the tenant that
         # holds the grant. None = owner-namespace fallback.
@@ -270,6 +276,17 @@ class FleetAggregator:
         with self._lock:
             self._ticks += 1
             states = {r.node: r.state for r in self._nodes.values()}
+            health_feed = {
+                r.node: {"fresh": r.state == "fresh",
+                         "missed_ticks": r.missed_ticks,
+                         "healthz": r.healthz}
+                for r in self._nodes.values()
+                if r.state != "unscraped" or r.last_ok_unix is not None}
+        if self.node_health is not None and not self._stop.is_set():
+            # after the join barrier, before the gauge exports: the
+            # tracker's dead/drain callbacks (fencing, slice repair)
+            # run on this tick thread and hand real work to threads
+            self.node_health.ingest(health_feed)
         fresh = sum(1 for s in states.values() if s == "fresh")
         # stop-guarded like the SLO tick below: a tick outliving stop()
         # (wedged scrape past stop's join timeout) must not re-export
@@ -586,6 +603,10 @@ class FleetAggregator:
                 r.utilz is not None for r in self._nodes.values())
         if has_util:
             out["utilization"] = self._utilization_view()
+        if self.node_health is not None:
+            # absent entirely under TPU_NODE_HEALTH=0 — the pre-
+            # subsystem /fleetz payload stays byte-for-byte
+            out["node_health"] = self.node_health.snapshot()
         if self.slo is not None:
             out["slo"] = self.slo.snapshot()
         if self.ha_fn is not None:

@@ -327,7 +327,49 @@ def _fixture_device_nodes(rig) -> set[str]:
     return nodes
 
 
-def assert_broker_invariants(broker, sim, store=None) -> None:
+def assert_node_death_invariants(broker, health) -> None:
+    """The node-failure-domain clauses (shared by the broker and slice
+    invariant suites; ``health`` = the master's NodeHealthTracker):
+
+    1. **No lease outlives its node's death**: once a node is judged
+       ``dead``, every lease on it must have been fenced (single) or
+       repaired/torn down (group) — a lease still naming a dead node
+       is exactly the stranded state the fencing deadline exists to
+       bound.
+    2. **No group mixes fenced and live members**: a slice either
+       re-formed whole (every member on a non-dead node) or went down
+       as a unit — a group with some members fenced and others still
+       leased is a half-alive slice, the state self-healing must never
+       leave behind.
+    """
+    stranded = [f"{lease.namespace}/{lease.pod}@{lease.node}"
+                for lease in broker.leases.leases()
+                if lease.node and health.state(lease.node) == "dead"]
+    assert not stranded, \
+        f"lease(s) survive on DEAD node(s) past the fencing deadline: " \
+        f"{stranded}"
+    fenced_groups = {entry["group"] for entry in broker.fenced()
+                     if entry.get("group")}
+    for group, members in sorted(broker.leases.groups().items()):
+        dead_members = [f"{m.namespace}/{m.pod}@{m.node}"
+                        for m in members
+                        if m.node and health.state(m.node) == "dead"]
+        assert not dead_members, \
+            f"group {group} mixes live members with dead-node " \
+            f"members {dead_members} (half-alive slice)"
+        if group in fenced_groups:
+            # a group that had members fenced must have re-formed to
+            # its full strength on live nodes (the repair txn) — its
+            # remaining members holding on is the mixed state
+            assert all(m.node == "" or health.state(m.node) == "healthy"
+                       or health.state(m.node) == "draining"
+                       for m in members), \
+                f"group {group} had fenced members but still holds " \
+                f"leases on unhealthy nodes"
+
+
+def assert_broker_invariants(broker, sim, store=None,
+                             health=None) -> None:
     """The broker-layer contract after any contention / lease-race /
     preemption / master-restart plan (rides on top of
     :func:`assert_invariants`, which owns the node-local guarantees):
@@ -345,7 +387,11 @@ def assert_broker_invariants(broker, sim, store=None) -> None:
        shards account exactly the cluster-ground-truth chips, and no
        waiter record outlives its resolution — what a failed-over peer
        would rehydrate is the truth, not a stale or doubled ledger.
+    4. **Node-death clauses** (``health`` given — the master's
+       NodeHealthTracker): see :func:`assert_node_death_invariants`.
     """
+    if health is not None:
+        assert_node_death_invariants(broker, health)
     from gpumounter_tpu.k8s import objects
     from gpumounter_tpu.utils import consts
     held: dict[tuple[str, str], int] = {}
@@ -401,7 +447,8 @@ def assert_broker_invariants(broker, sim, store=None) -> None:
             "transaction neither committed nor rolled back"
 
 
-def assert_slice_invariants(broker, sims, store=None) -> None:
+def assert_slice_invariants(broker, sims, store=None,
+                            health=None) -> None:
     """The elastic-slice contract after any slice chaos plan (leader
     killed mid-fan-out, competing gangs, resize races): **zero
     half-attached slices**, judged against cluster ground truth across
@@ -419,9 +466,14 @@ def assert_slice_invariants(broker, sims, store=None) -> None:
     4. ``store`` given: no slice txn record outlives its resolution and
        none is torn; persisted lease records match ground truth — what
        a failed-over peer would rehydrate is the truth.
+    5. ``health`` given: the node-death clauses
+       (:func:`assert_node_death_invariants`) — no lease on a dead
+       node, no group mixing fenced and live members.
     """
     from gpumounter_tpu.k8s import objects
     from gpumounter_tpu.utils import consts
+    if health is not None:
+        assert_node_death_invariants(broker, health)
     held: dict[tuple[str, str], int] = {}
     txn_holders: dict[str, set[tuple[str, str]]] = {}
     for sim in sims:

@@ -248,6 +248,9 @@ class WorkerRig:
                     exist_ok=True)
 
         self._actuator_kind = actuator
+        # DrainController (worker/drain.py), attached by stacks that
+        # exercise graceful drain (MultiNodeStack wires one per node).
+        self.drain = None
         if actuator == "recording":
             self.actuator = RecordingActuator()
         elif actuator == "procroot":
@@ -614,43 +617,54 @@ class MultiMasterStack:
 class MultiNodeStack:
     """N simulated TPU nodes (one WorkerRig + live gRPC worker each) behind
     ONE master — the multi-host slice topology (BASELINE config 5). Node i
-    is ``node-i`` holding pod ``workload-i``."""
+    is ``node-i`` holding pod ``workload-i``.
+
+    Node failure domain support: :meth:`kill_node` SIGKILLs a simulated
+    worker (gRPC + health sidecar down, nothing cleaned up — the fleet
+    scrape starts missing and the master's node-health machinery takes
+    it from there); :meth:`restart_node` boots a fresh "worker process"
+    over the same node state (same journal file, same gate backend —
+    the crash-restart semantics of ChaosRig.restart_worker, plus fresh
+    servers). Because this stack keeps the production's ONE apiserver
+    split across per-rig fakes (each rig's slave pods live in its own
+    sim), the broker's fence cleanup is bridged to delete a fenced
+    owner's slave pods in whichever rig's cluster holds them — exactly
+    what the single production apiserver would do."""
 
     def __init__(self, hosts: list, n_chips=4, health: bool = False,
                  broker_config=None, usage=False, gate=False):
+        from gpumounter_tpu.k8s import objects as k8s_objects
         from gpumounter_tpu.master.admission import AttachBroker
         from gpumounter_tpu.master.discovery import WorkerDirectory
         from gpumounter_tpu.master.gateway import MasterGateway
         from gpumounter_tpu.worker.grpc_server import build_server
-        from gpumounter_tpu.worker.main import start_health_server
 
+        self._objects = k8s_objects
         self.rigs: list[WorkerRig] = []
         self.grpc_servers = []
+        self.grpc_ports: list[int] = []
         # ``health=True``: each simulated worker gets its own real health
         # sidecar (ephemeral port) serving ITS journal — what the master's
         # fleet aggregator scrapes (the /eventz ring and /metrics registry
         # are process-global, exactly like a LiveStack's).
-        self.health_servers = []
-        health_bases: dict[str, str] = {}
+        self.health = health
+        self.health_servers: list = []
+        self._health_bases: dict[str, str] = {}
+        self.dead_nodes: set[int] = set()
         self.master_kube = FakeKubeClient()
         for i, host in enumerate(hosts):
             rig = WorkerRig(host, n_chips=n_chips, node=f"node-{i}",
                             pod_name=f"workload-{i}", usage=usage,
                             gate=gate)
+            self._attach_drain(rig)
+            self.rigs.append(rig)
             server, port = build_server(rig.service, port=0,
                                         address="127.0.0.1")
             server.start()
-            self.rigs.append(rig)
             self.grpc_servers.append(server)
-            if health:
-                hs = start_health_server(0, journal=rig.journal,
-                                         cache=rig.service.reads,
-                                         usage=rig.usage,
-                                         gate=rig.gate,
-                                         ready=True)
-                self.health_servers.append(hs)
-                health_bases[f"127.0.0.1:{port}"] = \
-                    f"http://127.0.0.1:{hs.server_port}"
+            self.grpc_ports.append(port)
+            self.health_servers.append(self._start_health(rig, port)
+                                       if health else None)
             self.master_kube.put_pod(worker_pod(
                 f"node-{i}", "127.0.0.1", name=f"w{i}", grpc_port=port))
             self.master_kube.put_pod(rig.pod)
@@ -658,21 +672,147 @@ class MultiNodeStack:
                   if broker_config is not None else None)
         self.gateway = MasterGateway(
             self.master_kube, WorkerDirectory(self.master_kube),
-            worker_tracez_base=(health_bases.get if health else None),
+            worker_tracez_base=(self._health_bases.get if health
+                                else None),
             broker=broker)
+        # split-view bridge (see class docstring): fencing deletes the
+        # owner's slave pods in the rig cluster that actually holds them
+        self.gateway.broker.fence_cleanup = self._fence_cleanup
         self.http_server = self.gateway.serve(port=0, address="127.0.0.1")
         self.base = f"http://127.0.0.1:{self.http_server.server_port}"
+
+    @staticmethod
+    def _attach_drain(rig: WorkerRig) -> None:
+        from gpumounter_tpu.worker.drain import DrainController
+        rig.drain = DrainController(rig.sim.node)
+        rig.service.drain = rig.drain
+
+    def _start_health(self, rig: WorkerRig, grpc_port: int):
+        from gpumounter_tpu.worker.main import start_health_server
+        hs = start_health_server(0, journal=rig.journal,
+                                 cache=rig.service.reads,
+                                 usage=rig.usage,
+                                 gate=rig.gate,
+                                 drain=getattr(rig, "drain", None),
+                                 ready=True)
+        self._health_bases[f"127.0.0.1:{grpc_port}"] = \
+            f"http://127.0.0.1:{hs.server_port}"
+        return hs
+
+    # -- workload / spare provisioning -----------------------------------------
+
+    def add_workload(self, i: int, name: str,
+                     spare: bool = False) -> objects.Pod:
+        """A second workload pod on node ``i``, provisioned (cgroup +
+        live pid) and visible to BOTH the master and the node's worker.
+        ``spare=True`` labels it as a slice-repair spare
+        (``tpumounter.io/slice-spare=true``) — what self-healing grows
+        a broken gang onto."""
+        rig = self.rigs[i]
+        pod = rig.sim.add_target_pod(
+            name=name, uid=f"uid-{name}",
+            container_id="containerd://" + (f"{i:02x}" * 32)[:64])
+        if spare:
+            pod["metadata"]["labels"][consts.SLICE_SPARE_LABEL_KEY] = \
+                consts.SLICE_SPARE_LABEL_VALUE
+            rig.sim.kube.put_pod(pod)
+        rig.provision_container(pod)
+        self.master_kube.put_pod(pod)
+        return pod
+
+    # -- node failure primitives -----------------------------------------------
+
+    def kill_node(self, i: int) -> None:
+        """SIGKILL node ``i``'s worker: gRPC server and health sidecar
+        go down mid-steady-state, nothing is cleaned up — its journal
+        file, gate backend and cluster state stay exactly as the crash
+        left them (restart_node boots over them)."""
+        self.dead_nodes.add(i)
+        self.grpc_servers[i].stop(grace=0)
+        hs = self.health_servers[i] if self.health else None
+        if hs is not None:
+            hs.shutdown()
+            # close the LISTENING socket too: shutdown() only stops the
+            # serve loop, leaving the backlog accepting connections that
+            # never answer — a dead process refuses instantly, and the
+            # fleet scrape must see that, not a 3s read timeout per tick
+            hs.server_close()
+
+    def restart_node(self, i: int) -> dict[str, int]:
+        """Boot a fresh "worker process" over node ``i``'s surviving
+        state: fresh journal object from the on-disk file, fresh
+        DeviceGate over the SAME backend (kernel maps survive a crash),
+        fresh service, startup replay — then fresh gRPC + health
+        servers on new ports, announced to the master. Returns the
+        replay outcome counts (the zombie-rejoin convergence the chaos
+        acceptance pins)."""
+        from gpumounter_tpu.worker.grpc_server import build_server
+        from gpumounter_tpu.worker.journal import AttachJournal
+        from gpumounter_tpu.worker.service import TPUMountService
+        rig = self.rigs[i]
+        journal = AttachJournal(rig.sim.settings.journal_path)
+        rig.journal = journal
+        if rig.gate is not None:
+            from gpumounter_tpu.actuation.gate import DeviceGate
+            rig.gate = DeviceGate(rig.cgroups, rig.gate_backend,
+                                  journal=journal, mode="auto",
+                                  node_name=rig.sim.node)
+            rig.mounter.gate = rig.gate
+        rig.service = TPUMountService(rig.allocator, rig.mounter,
+                                      rig.sim.kube, rig.sim.settings,
+                                      pool=rig.pool, journal=journal)
+        self._attach_drain(rig)
+        outcomes = rig.service.replay_journal()
+        server, port = build_server(rig.service, port=0,
+                                    address="127.0.0.1")
+        server.start()
+        self.grpc_servers[i] = server
+        self.grpc_ports[i] = port
+        if self.health:
+            self.health_servers[i] = self._start_health(rig, port)
+        self.master_kube.put_pod(worker_pod(
+            f"node-{i}", "127.0.0.1", name=f"w{i}", grpc_port=port))
+        self.gateway.directory.invalidate(f"node-{i}")
+        # force the directory to see the restarted worker NOW: the TTL
+        # refresh would take up to 15 wall-clock seconds, which manual-
+        # tick tests do not have
+        self.gateway.directory._refresh()
+        # the fleet's scrape breaker opened against the dead sidecar;
+        # the restarted one lives at a NEW address, so the failure
+        # history is the dead incarnation's (same rule the discovery
+        # negative cache applies) — drop it so recovery is immediate
+        with self.gateway.fleet._lock:
+            self.gateway.fleet._breakers.pop(f"node-{i}", None)
+        self.dead_nodes.discard(i)
+        return outcomes
+
+    def _fence_cleanup(self, namespace: str, pod: str) -> None:
+        """The "one apiserver" the production deployment has: delete the
+        fenced owner's slave pods in whichever rig's cluster holds them
+        (deleting releases the scheduler reservation via the sim's
+        on_delete hook, exactly like the real control loop)."""
+        selector = (f"{consts.OWNER_POD_LABEL_KEY}={pod},"
+                    f"{consts.OWNER_NAMESPACE_LABEL_KEY}={namespace}")
+        for rig in self.rigs:
+            pool_ns = rig.sim.settings.pool_namespace
+            for slave in rig.sim.kube.list_pods(pool_ns,
+                                                label_selector=selector):
+                rig.sim.kube.delete_pod(pool_ns,
+                                        self._objects.name(slave))
 
     def close(self) -> None:
         self.gateway.fleet.stop()
         self.gateway.broker.stop()
         self.http_server.shutdown()
         for server in self.health_servers:
+            if server is None:
+                continue
             try:
                 server.shutdown()
             except Exception:       # noqa: BLE001 — may be dead mid-test
                 pass
-        for server in self.grpc_servers:
-            server.stop(grace=0)
+        for i, server in enumerate(self.grpc_servers):
+            if i not in self.dead_nodes:
+                server.stop(grace=0)
         for rig in self.rigs:
             rig.close()

@@ -180,6 +180,16 @@ class Settings:
     # before the broker marks a lease idle). Only meaningful while
     # worker utilization telemetry is flowing.
     idle_lease_s: float = consts.DEFAULT_IDLE_LEASE_S
+    # Graceful worker drain (worker/drain.py): how long the SIGTERM /
+    # /drainz sequence waits for in-flight actuation to settle before
+    # the gRPC server goes down anyway.
+    drain_timeout_s: float = consts.DEFAULT_DRAIN_TIMEOUT_S
+    # Spot-termination watcher: path polled for the preemption notice;
+    # the file appearing triggers a proactive drain. "" = no watcher.
+    spot_termination_file: str = ""
+    # Slice self-healing budget (master/slicetxn.py): repair txns one
+    # group may consume before it is torn down as a unit instead.
+    slice_repair_budget: int = consts.DEFAULT_SLICE_REPAIR_BUDGET
     host: HostPaths = dataclasses.field(default_factory=HostPaths)
 
     @classmethod
@@ -281,6 +291,20 @@ class Settings:
             if s.idle_lease_s <= 0:
                 raise ValueError(
                     f"{consts.ENV_IDLE_LEASE_S} must be > 0, got {t!r}")
+        if t := env.get(consts.ENV_DRAIN_TIMEOUT_S):
+            s.drain_timeout_s = float(t)
+            if s.drain_timeout_s <= 0:
+                raise ValueError(
+                    f"{consts.ENV_DRAIN_TIMEOUT_S} must be > 0 (a zero "
+                    f"window would yank in-flight actuation), got {t!r}")
+        s.spot_termination_file = env.get(
+            consts.ENV_SPOT_TERMINATION_FILE, "")
+        if t := env.get(consts.ENV_SLICE_REPAIR_BUDGET):
+            s.slice_repair_budget = int(t)
+            if s.slice_repair_budget < 0:
+                raise ValueError(
+                    f"{consts.ENV_SLICE_REPAIR_BUDGET} must be >= 0 "
+                    f"(0 = never repair, always tear down), got {t!r}")
         if t := env.get(consts.ENV_INFORMER_FENCE_TIMEOUT_S):
             s.informer_fence_timeout_s = float(t)
         if p := env.get("TPU_WORKER_GRPC_PORT"):

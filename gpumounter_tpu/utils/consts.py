@@ -284,6 +284,64 @@ ENV_MESH_GEN_DIR = "TPU_MESH_GEN_DIR"
 # generation signal (the alternative to the worker's notification file).
 MESH_GENERATION_ANNOTATION = "tpumounter.io/mesh-generation"
 
+# --- Node failure domain (master/nodehealth.py, worker/drain.py) --------------
+# "1" (default): the master folds fleet scrape staleness with k8s Node
+# conditions/taints into a per-node healthy → suspect → dead state
+# machine — suspect cordons the node from NEW grants, dead fences its
+# leases and triggers slice self-healing. "0" removes the tracker
+# entirely: no node_health section on /fleetz, no new series, no
+# fencing — byte-for-byte the pre-subsystem behavior (pinned by test,
+# like TPU_GATE=legacy).
+ENV_NODE_HEALTH = "TPU_NODE_HEALTH"
+# Missed fleet scrapes before a previously-seen node turns suspect /
+# dead. Suspicion requires PRIOR liveness evidence (at least one
+# successful scrape): a node whose health port was never reachable is a
+# deploy problem, not a death — absence of telemetry must never fence.
+ENV_NODE_SUSPECT_TICKS = "TPU_NODE_SUSPECT_TICKS"
+ENV_NODE_DEAD_TICKS = "TPU_NODE_DEAD_TICKS"
+DEFAULT_NODE_SUSPECT_TICKS = 2
+DEFAULT_NODE_DEAD_TICKS = 5
+# Consecutive fresh scrapes (with clean k8s conditions) a suspect/dead
+# node must show before it is healthy again — the hysteresis that stops
+# a flapping health port from cycling cordon state per tick.
+DEFAULT_NODE_RECOVER_TICKS = 2
+# Throttle on per-node k8s Node condition/taint polls (GET nodes).
+DEFAULT_NODE_POLL_INTERVAL_S = 15.0
+# Node taints that announce imminent involuntary termination (spot /
+# preemption / scale-down): the tracker treats a tainted node as
+# cordoned and triggers proactive slice migration off it.
+TERMINATION_TAINT_KEYS = (
+    "cloud.google.com/impending-node-termination",
+    "ToBeDeletedByClusterAutoscaler",
+    "node.kubernetes.io/out-of-service",
+)
+# Failed reap attempts against a lease on a DEAD node before the broker
+# fences it instead of retrying the unreachable worker forever.
+REAP_FENCE_AFTER = 3
+# Per-group slice self-healing budget: repair transactions a group may
+# consume before the broker stops repairing and tears it down as a unit
+# (a crash-looping node must not grind the spare pool forever).
+ENV_SLICE_REPAIR_BUDGET = "TPU_SLICE_REPAIR_BUDGET"
+DEFAULT_SLICE_REPAIR_BUDGET = 3
+# Label marking a pod as a slice-repair spare: self-healing grows the
+# repaired gang onto Running pods carrying this label on healthy nodes.
+SLICE_SPARE_LABEL_KEY = "tpumounter.io/slice-spare"
+SLICE_SPARE_LABEL_VALUE = "true"
+# Worker-side graceful drain (worker/drain.py): how long the SIGTERM /
+# POST /drainz sequence waits for in-flight actuation to settle before
+# shutting the gRPC server down anyway.
+ENV_DRAIN_TIMEOUT_S = "TPU_DRAIN_TIMEOUT_S"
+DEFAULT_DRAIN_TIMEOUT_S = 30.0
+# Spot-termination watcher: when set, the worker polls this path and
+# begins a proactive drain the moment the file appears (a node-problem-
+# detector / metadata-watcher sidecar touches it on the ACPI/metadata
+# preemption notice). Empty/unset = no watcher thread.
+ENV_SPOT_TERMINATION_FILE = "TPU_SPOT_TERMINATION_FILE"
+# Marker the worker's draining-refusal gRPC detail starts with — the
+# gateway maps it to a typed 503 Draining instead of retrying the
+# UNAVAILABLE like a transport fault.
+DRAINING_DETAIL_PREFIX = "draining:"
+
 # Request headers naming the tenant/priority (query params ?tenant= /
 # ?priority= take precedence; both fall back to namespace / "normal").
 TENANT_HEADER = "X-Tpu-Tenant"

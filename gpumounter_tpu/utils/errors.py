@@ -81,6 +81,14 @@ class KubeletUnavailableError(TPUMounterError):
     """The kubelet PodResources socket is missing or unresponsive."""
 
 
+class WorkerDrainingError(TPUMounterError):
+    """The worker is draining (SIGTERM / POST /drainz / spot notice):
+    NEW attaches are refused — the gRPC adapter answers UNAVAILABLE with
+    a ``draining:`` detail the gateway maps to a typed 503 Draining
+    (never retried as a transport fault). Detaches keep flowing: drain
+    frees capacity, it must not wedge it."""
+
+
 class K8sApiError(TPUMounterError):
     """Non-404 failure talking to the kube-apiserver.
 

@@ -16,7 +16,8 @@ import grpc
 
 from gpumounter_tpu.api import tpu_mount_pb2 as pb
 from gpumounter_tpu.utils import consts
-from gpumounter_tpu.utils.errors import MountPolicyError, TPUMounterError
+from gpumounter_tpu.utils.errors import (MountPolicyError, TPUMounterError,
+                                         WorkerDrainingError)
 from gpumounter_tpu.utils.log import get_logger
 from gpumounter_tpu.utils.trace import Trace
 from gpumounter_tpu.worker.service import TPUMountService
@@ -53,6 +54,13 @@ def _add_handler(service: TPUMountService):
                                       request.is_entire_mount,
                                       txn_id=request.txn_id,
                                       request_id=rid if rid != "-" else "")
+        except WorkerDrainingError as e:
+            # the worker is going away gracefully (worker/drain.py):
+            # UNAVAILABLE with the draining: detail marker the gateway
+            # maps to a typed 503 Draining (and never retries — every
+            # retry would get the same answer until the drain ends)
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          consts.DRAINING_DETAIL_PREFIX + " " + str(e))
         except MountPolicyError as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         except TPUMounterError as e:

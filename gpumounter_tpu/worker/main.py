@@ -41,9 +41,40 @@ class _HealthHandler(BaseHTTPRequestHandler):
     events = None      # EventLog override; None = the process singleton
     usage = None       # ChipUsageSampler, set when TPU_USAGE is on
     gate = None        # DeviceGate, set when TPU_GATE != legacy
+    drain = None       # DrainController, set by main() (graceful drain)
 
     def log_message(self, *args):
         pass
+
+    def do_POST(self):
+        # POST /drainz: begin a graceful drain (idempotent; the full
+        # settle/flush sequence runs on its own thread so the request
+        # answers immediately with the current status). The SIGTERM
+        # handler runs the same sequence — this is the operator's/
+        # pre-stop hook's entry to it.
+        import json
+        drain = type(self).drain
+        if self.path.split("?", 1)[0] != "/drainz":
+            body, ctype, code = b"not found", "text/plain", 404
+        elif drain is None:
+            body = json.dumps({"enabled": False}).encode()
+            ctype, code = "application/json", 503
+        else:
+            started = drain.begin("drainz")
+            if started:
+                journal = type(self).journal
+                threading.Thread(
+                    target=lambda: drain.run(journal=journal,
+                                             reason="drainz"),
+                    daemon=True, name="tpumounter-drainz").start()
+            body = json.dumps({"enabled": True, "started": started,
+                               **drain.status()}).encode()
+            ctype, code = "application/json", 200
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def do_GET(self):
         if self.path == "/metrics":
@@ -145,11 +176,31 @@ class _HealthHandler(BaseHTTPRequestHandler):
                               else {"enabled": False}).encode()
             ctype = "application/json"
             code = 200
+        elif self.path == "/drainz":
+            # drain state: draining flag, in-flight actuation count,
+            # refused attaches — POST here begins the drain
+            import json
+            drain = type(self).drain
+            body = json.dumps({"enabled": True, **drain.status()}
+                              if drain is not None
+                              else {"enabled": False}).encode()
+            ctype = "application/json"
+            code = 200
         elif self.path in ("/healthz", "/readyz"):
-            ok = type(self).ready or self.path == "/healthz"
-            body = (b"ok" if ok else b"not ready")
+            drain = type(self).drain
+            draining = drain is not None and drain.draining
+            if self.path == "/healthz":
+                # a draining worker is ALIVE but leaving: say so — the
+                # master's fleet scrape folds this into the node state
+                # machine (cordon within one tick). 200, not 5xx: the
+                # process is healthy, just not accepting new grants.
+                body = b"draining" if draining else b"ok"
+                code = 200
+            else:
+                ok = type(self).ready and not draining
+                body = b"ok" if ok else b"not ready"
+                code = 200 if ok else 503
             ctype = "text/plain"
-            code = 200 if ok else 503
         else:
             body, ctype, code = b"not found", "text/plain", 404
         self.send_response(code)
@@ -169,7 +220,8 @@ def start_health_server(port: int, **state) -> ThreadingHTTPServer:
     handler = _HealthHandler
     if state:
         unknown = set(state) - {"journal", "cache", "pool", "agent",
-                                "events", "ready", "usage", "gate"}
+                                "events", "ready", "usage", "gate",
+                                "drain"}
         if unknown:
             raise TypeError(f"unknown health-server state: {unknown}")
         handler = type("_ScopedHealthHandler", (_HealthHandler,), state)
@@ -316,6 +368,45 @@ def main() -> None:
         logger.info("worker gRPC TLS enabled (mTLS=%s)",
                     bool(tls.ca_file))
     server, port = build_server(service, settings.worker_grpc_port, tls=tls)
+    # Graceful drain (worker/drain.py): SIGTERM (the DaemonSet's rolling
+    # restart / node shutdown) begins the drain sequence — stop admitting
+    # attaches, settle in-flight actuation, flush journal/events, report
+    # "draining" on healthz so the master cordons within one fleet tick —
+    # then stops the gRPC server. A spot-termination watcher triggers the
+    # same drain proactively when the preemption notice file appears.
+    import signal
+
+    from gpumounter_tpu.worker.drain import (DrainController,
+                                             SpotTerminationWatcher)
+    drainer = DrainController(settings.node_name,
+                              default_timeout_s=settings.drain_timeout_s)
+    service.drain = drainer
+    _HealthHandler.drain = drainer
+
+    def _drain_and_stop(reason: str) -> None:
+        drainer.run(journal=service.journal, reason=reason)
+        server.stop(grace=5.0)
+
+    def _on_sigterm(signum, frame):
+        threading.Thread(target=_drain_and_stop, args=("sigterm",),
+                         daemon=True,
+                         name="tpumounter-sigterm-drain").start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        # not the main thread (embedded runs): SIGTERM stays default;
+        # POST /drainz and the spot watcher still work
+        logger.warning("SIGTERM drain handler not installed (not on "
+                       "the main thread)")
+    spot_watcher = None
+    if settings.spot_termination_file:
+        spot_watcher = SpotTerminationWatcher(
+            settings.spot_termination_file,
+            on_terminate=lambda: _drain_and_stop("spot-termination"),
+        ).start()
+        logger.info("spot-termination watcher on %s",
+                    settings.spot_termination_file)
     server.start()
     _HealthHandler.ready = True
     logger.info("worker serving gRPC on :%d, health on :%d", port,
@@ -323,6 +414,8 @@ def main() -> None:
     try:
         server.wait_for_termination()
     finally:
+        if spot_watcher is not None:
+            spot_watcher.stop()
         if pool is not None:
             pool.stop()
         if sampler is not None:

@@ -125,7 +125,7 @@ class TPUMountService:
 
     def __init__(self, allocator: TPUAllocator, mounter: TPUMounter,
                  kube: KubeClient, settings: Settings | None = None,
-                 pool=None, journal=None):
+                 pool=None, journal=None, drain=None):
         self.allocator = allocator
         self.mounter = mounter
         self.kube = kube
@@ -144,6 +144,12 @@ class TPUMountService:
         # at the next boot (replay_journal) instead of leaking device
         # access. None ⇒ no journaling (unit rigs that predate it).
         self.journal = journal
+        # Optional DrainController (worker/drain.py): a draining worker
+        # refuses NEW attaches (typed 503 Draining at the gateway) and
+        # every RPC holds an in-flight token the drain sequence settles
+        # on. None ⇒ no drain semantics — byte-for-byte pre-drain
+        # behavior (unit rigs, and production with the subsystem off).
+        self.drain = drain
         # Per-request fencing: a gateway retry can arrive while the original
         # handler is still executing in this process (UNAVAILABLE from a
         # connection blip, not a worker death). Serialising same-request_id
@@ -183,6 +189,22 @@ class TPUMountService:
     def add_tpu(self, pod_name: str, namespace: str, tpu_num: int,
                 is_entire_mount: bool, txn_id: str = "",
                 request_id: str = "") -> AddOutcome:
+        # Drain gate BEFORE any tracing/accounting: a refused attach is
+        # a routine typed answer (503 Draining at the gateway), not a
+        # request this worker worked on. Raises WorkerDrainingError.
+        if self.drain is not None:
+            drain_token = self.drain.inflight("attach")
+        else:
+            drain_token = contextlib.nullcontext()
+        with drain_token:
+            return self._add_tpu_traced(pod_name, namespace, tpu_num,
+                                        is_entire_mount, txn_id,
+                                        request_id)
+
+    def _add_tpu_traced(self, pod_name: str, namespace: str,
+                        tpu_num: int, is_entire_mount: bool,
+                        txn_id: str = "",
+                        request_id: str = "") -> AddOutcome:
         trace = Trace("attach", request_id or txn_id)
         trace.root.attrs.update(pod=f"{namespace}/{pod_name}",
                                 tpus=tpu_num, entire=is_entire_mount)
@@ -395,6 +417,21 @@ class TPUMountService:
         ``lease-expired:...``) is propagated into the trace, the
         TPUDetached audit event and the journal's detach record, so "who
         took my chips away and why" is answerable from every surface."""
+        # detaches hold an in-flight token but are NEVER refused by a
+        # drain: freeing capacity is what a drain is for
+        if self.drain is not None:
+            drain_token = self.drain.inflight("detach")
+        else:
+            drain_token = contextlib.nullcontext()
+        with drain_token:
+            return self._remove_tpu_traced(pod_name, namespace, uuids,
+                                           force, txn_id, request_id,
+                                           cause)
+
+    def _remove_tpu_traced(self, pod_name: str, namespace: str,
+                           uuids: list[str], force: bool,
+                           txn_id: str = "", request_id: str = "",
+                           cause: str = "") -> RemoveOutcome:
         trace = Trace("detach", request_id or txn_id)
         trace.root.attrs.update(pod=f"{namespace}/{pod_name}",
                                 uuids=len(uuids), force=force)

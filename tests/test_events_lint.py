@@ -90,9 +90,11 @@ def test_every_journal_record_writer_emits_an_event():
 
 def test_every_admission_outcome_emits_an_event():
     # master/slicetxn.py records gang decisions (queue_timeout /
-    # granted_queued) into the same counter — same pairing contract
+    # granted_queued) and master/gateway.py the node-cordon denial into
+    # the same counter — same pairing contract
     offenders = []
-    for module in ("master/admission.py", "master/slicetxn.py"):
+    for module in ("master/admission.py", "master/slicetxn.py",
+                   "master/gateway.py"):
         funcs = _functions(_parse(module))
         for name, node in funcs.items():
             has_decision = False
@@ -136,7 +138,12 @@ def test_reclaim_paths_emit_events():
 
 
 def test_attach_and_detach_completions_emit_events():
+    # the emitting bodies live one hop under the public RPCs since the
+    # drain gate wrapped them (worker/drain.py — the refusal must not
+    # record an attach event it never worked on)
     funcs = _functions(_parse("worker/service.py"))
-    for name in ("TPUMountService.add_tpu", "TPUMountService.remove_tpu"):
+    for name in ("TPUMountService._add_tpu_traced",
+                 "TPUMountService._remove_tpu_traced"):
+        assert name in funcs, f"{name} vanished — update this lint"
         assert _emits_event(funcs[name]), \
             f"{name} completes without emitting a lifecycle event"

@@ -1,0 +1,440 @@
+"""Node failure domain, chaos acceptance (ISSUE 13):
+
+(a) kill a worker mid-steady-state — its single leases are fenced
+    within one suspect→dead window, the quota frees, and a restarted
+    worker converges its gate/journal with zero resurrected grants;
+(b) kill one member host of a live slice — the slice is repaired onto
+    a spare host under the SAME group lease with one mesh-generation
+    bump (and the elastic training loop continues with its loss
+    trajectory intact), or — with no spare capacity — the group is
+    torn down as a unit, never left half-alive;
+(c) drain a worker — zero failed in-flight attaches, the master
+    cordons the node within one fleet tick.
+
+All on MultiNodeStack with real gRPC workers and per-node health
+sidecars; the fleet tick is driven manually for determinism
+(TPU_FLEET_INTERVAL_S pinned huge)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.master.admission import BrokerConfig
+from gpumounter_tpu.testing import chaos
+from gpumounter_tpu.utils.config import HostPaths
+from gpumounter_tpu.utils.events import EVENTS
+
+
+def _host(tmp_path, i):
+    base = tmp_path / f"node{i}"
+    for sub in ("dev", "proc", "sys/fs/cgroup"):
+        (base / sub).mkdir(parents=True)
+    return HostPaths(dev_root=str(base / "dev"),
+                     proc_root=str(base / "proc"),
+                     sys_root=str(base / "sys"),
+                     cgroup_root=str(base / "sys" / "fs" / "cgroup"),
+                     kubelet_socket=str(base / "pr" / "kubelet.sock"))
+
+
+@pytest.fixture(autouse=True)
+def _manual_fleet_ticks(monkeypatch):
+    monkeypatch.setenv("TPU_FLEET_INTERVAL_S", "3600")
+
+
+def _req(base, path, method="GET", body=None, timeout=60):
+    req = urllib.request.Request(base + path, method=method, data=body)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _tick_until(stack, node, state, ticks=8):
+    nh = stack.gateway.nodehealth
+    for _ in range(ticks):
+        stack.gateway.fleet.tick()
+        if nh.state(node) == state:
+            return True
+    return nh.state(node) == state
+
+
+def _wait_for(predicate, timeout_s=15.0):
+    """Node-down handling (fencing, repair) runs on its own threads off
+    the fleet tick — assertions poll for the settled outcome."""
+    import time
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return predicate()
+
+
+# -- (a) kill a worker mid-steady-state ----------------------------------------
+
+def test_killed_worker_leases_fence_and_restart_converges(tmp_path):
+    from gpumounter_tpu.testing.sim import MultiNodeStack
+    stack = MultiNodeStack([_host(tmp_path, i) for i in range(2)],
+                           n_chips=4, health=True, gate=True,
+                           broker_config=BrokerConfig(
+                               quotas={"team": 4}))
+    try:
+        stack.gateway.fleet.tick()      # the node is observed ALIVE
+        st, p = _req(stack.base, "/addtpu/namespace/default/pod/"
+                                 "workload-1/tpu/4/isEntireMount/true"
+                                 "?tenant=team")
+        assert st == 200, p
+        broker = stack.gateway.broker
+        assert broker.leases.tenant_usage("team") == 4
+        # tenant at quota: a second attach would 429
+        st, p = _req(stack.base, "/addtpu/namespace/default/pod/"
+                                 "workload-0/tpu/4/isEntireMount/true"
+                                 "?tenant=team")
+        assert st == 429 and p["result"] == "QuotaExceeded", p
+
+        stack.kill_node(1)
+        nh = stack.gateway.nodehealth
+        assert _tick_until(stack, "node-1", "dead")
+        # fenced within the suspect→dead window: lease gone, quota free
+        assert _wait_for(lambda: broker.leases.get("default",
+                                                   "workload-1") is None)
+        assert broker.leases.tenant_usage("team") == 0
+        fences = [e for e in EVENTS.tail(200)
+                  if e["kind"] == "lease_fenced"
+                  and e.get("pod") == "workload-1"]
+        assert fences and fences[-1]["attrs"]["reason"] == "node-dead"
+        # the freed quota is usable NOW, on a healthy node
+        st, p = _req(stack.base, "/addtpu/namespace/default/pod/"
+                                 "workload-0/tpu/4/isEntireMount/true"
+                                 "?tenant=team")
+        assert st == 200, p
+        # the dead node is cordoned from NEW grants
+        st, p = _req(stack.base, "/addtpu/namespace/default/pod/"
+                                 "workload-1/tpu/1/isEntireMount/false")
+        assert st == 503 and p["result"] == "NodeCordoned", p
+
+        # zombie rejoin: the restarted worker replays its journal and
+        # converges the gate against the fenced ground truth — ZERO
+        # resurrected grants
+        outcomes = stack.restart_node(1)
+        rig = stack.rigs[1]
+        assert rig.gate.granted_uuids() == set(), outcomes
+        assert rig.sim.slave_pods() == []
+        assert rig.service.journal.backlog() == 0
+        chaos.assert_node_death_invariants(broker, nh)
+
+        # hysteresis recovery: fresh scrapes bring the node back and
+        # grants flow again
+        assert _tick_until(stack, "node-1", "healthy")
+        st, p = _req(stack.base, "/addtpu/namespace/default/pod/"
+                                 "workload-1/tpu/2/isEntireMount/false")
+        assert st == 200, p
+        # multi-node ground truth (the slice suite's generalisation)
+        chaos.assert_slice_invariants(broker,
+                                      [r.sim for r in stack.rigs],
+                                      health=nh)
+    finally:
+        stack.close()
+
+
+# -- (b) slice self-healing ----------------------------------------------------
+
+def test_slice_repairs_onto_spare_host_same_group_one_generation_bump(
+        tmp_path):
+    from gpumounter_tpu.testing.sim import MultiNodeStack
+    stack = MultiNodeStack([_host(tmp_path, i) for i in range(5)],
+                           n_chips=4, health=True, gate=True,
+                           broker_config=BrokerConfig())
+    try:
+        stack.add_workload(4, "spare-0", spare=True)
+        stack.gateway.fleet.tick()
+        body = json.dumps({
+            "pods": [{"namespace": "default", "pod": f"workload-{i}"}
+                     for i in range(4)],
+            "tpusPerHost": 4}).encode()
+        st, p = _req(stack.base, "/addtpuslice", "POST", body)
+        assert st == 200, p
+        group = p["group"]
+        st, sz = _req(stack.base, "/slicez")
+        assert sz["groups"][group]["generation"] == 1
+
+        stack.kill_node(2)
+        assert _tick_until(stack, "node-2", "dead")
+        assert _wait_for(
+            lambda: stack.gateway.slices.generation(group) == 2)
+        stack.gateway.slices.join_repairs()
+
+        st, sz = _req(stack.base, "/slicez")
+        info = sz["groups"].get(group)
+        assert info is not None, "group vanished instead of repairing"
+        members = {m["pod"] for m in info["members"]}
+        # SAME group lease, dead member replaced by the spare, exactly
+        # one mesh-generation bump (full actuation only)
+        assert members == {"workload-0", "workload-1", "workload-3",
+                           "spare-0"}
+        assert info["generation"] == 2
+        assert info["chips"] == 16
+        repairs = [e for e in EVENTS.tail(300)
+                   if e["kind"] == "slice_repair"
+                   and e["attrs"].get("group") == group]
+        assert [e["attrs"]["outcome"] for e in repairs] == ["repaired"]
+        nh = stack.gateway.nodehealth
+        chaos.assert_node_death_invariants(stack.gateway.broker, nh)
+        chaos.assert_slice_invariants(
+            stack.gateway.broker,
+            [r.sim for i, r in enumerate(stack.rigs) if i != 2],
+            health=nh)
+    finally:
+        stack.close()
+
+
+def test_slice_with_no_spare_capacity_tears_down_as_a_unit(tmp_path):
+    from gpumounter_tpu.testing.sim import MultiNodeStack
+    stack = MultiNodeStack([_host(tmp_path, i) for i in range(2)],
+                           n_chips=4, health=True,
+                           broker_config=BrokerConfig())
+    try:
+        stack.gateway.fleet.tick()
+        body = json.dumps({
+            "pods": [{"namespace": "default", "pod": "workload-0"},
+                     {"namespace": "default", "pod": "workload-1"}],
+            "tpusPerHost": 4}).encode()
+        st, p = _req(stack.base, "/addtpuslice", "POST", body)
+        assert st == 200, p
+        group = p["group"]
+
+        stack.kill_node(1)
+        assert _tick_until(stack, "node-1", "dead")
+        broker = stack.gateway.broker
+        assert _wait_for(
+            lambda: broker.leases.groups().get(group) is None)
+        stack.gateway.slices.join_repairs()
+
+        # no spare host: NEVER left half-alive — the whole group is
+        # gone, including the surviving member's lease and chips
+        assert broker.leases.leases() == []
+        assert stack.rigs[0].sim.slave_pods() == []
+        repairs = [e for e in EVENTS.tail(300)
+                   if e["kind"] == "slice_repair"
+                   and e["attrs"].get("group") == group]
+        assert [e["attrs"]["outcome"] for e in repairs] == ["torn_down"]
+        chaos.assert_node_death_invariants(broker,
+                                           stack.gateway.nodehealth)
+    finally:
+        stack.close()
+
+
+def test_training_loop_survives_member_host_death_via_repair(tmp_path):
+    """The 'repair the gang, don't restart the job' acceptance: a
+    jaxcheck training loop over a live 4-host slice keeps descending
+    through the death of one member host — self-healing re-forms the
+    gang onto the spare under the SAME group lease, the harness sees
+    exactly one generation bump, reshapes, and the step counter and
+    loss trajectory continue (mirrors test_elastic.py's resize e2e)."""
+    jax = pytest.importorskip("jax")
+    import numpy as np
+
+    from gpumounter_tpu.jaxcheck import elastic
+    from gpumounter_tpu.jaxcheck import train as train_lib
+    from gpumounter_tpu.testing.sim import MultiNodeStack
+    from tests.test_elastic import (TINY, _batch, full_attn_step_factory)
+
+    # 4 member hosts × 2 chips = the suite's 8 virtual devices; the
+    # spare host also carries 2 chips so the repaired slice is 8 again
+    stack = MultiNodeStack([_host(tmp_path, i) for i in range(5)],
+                           n_chips=2, health=True,
+                           broker_config=BrokerConfig())
+    harness = None
+    try:
+        stack.add_workload(4, "spare-0", spare=True)
+        stack.gateway.fleet.tick()
+        body = json.dumps({
+            "pods": [{"namespace": "default", "pod": f"workload-{i}"}
+                     for i in range(4)],
+            "tpusPerHost": 2}).encode()
+        st, p = _req(stack.base, "/addtpuslice", "POST", body)
+        assert st == 200, p
+        group = p["group"]
+        signal = elastic.MasterSliceSignal(stack.base, group)
+        assert signal.generation() == 1 and signal.chips() == 8
+
+        harness = elastic.ElasticHarness(
+            TINY, signal.generation, signal.chips,
+            optimizer=train_lib.make_optimizer(lr=1e-2),
+            step_factory=full_attn_step_factory).start()
+        assert harness.mesh.devices.shape == (1, 8, 1)
+        losses = []
+        for i in range(10):
+            harness.poll()
+            losses.append(harness.train_step(_batch(i)))
+
+        # one member host dies mid-training
+        stack.kill_node(2)
+        assert _tick_until(stack, "node-2", "dead")
+        assert _wait_for(
+            lambda: stack.gateway.slices.generation(group) == 2)
+        stack.gateway.slices.join_repairs()
+        st, sz = _req(stack.base, "/slicez")
+        info = sz["groups"][group]
+        assert {m["pod"] for m in info["members"]} == \
+            {"workload-0", "workload-1", "workload-3", "spare-0"}
+        assert info["generation"] == 2      # exactly one bump
+
+        embed_before = np.asarray(
+            jax.device_get(harness.state.params["embed"]))
+        assert harness.poll() is True       # the job re-forms, not dies
+        assert harness.mesh.devices.shape == (1, 8, 1)
+        np.testing.assert_array_equal(
+            embed_before,
+            np.asarray(jax.device_get(harness.state.params["embed"])))
+        assert int(harness.state.step) == 10     # trajectory continues
+        for i in range(10, 20):
+            harness.poll()
+            losses.append(harness.train_step(_batch(i)))
+        assert int(harness.state.step) == 20
+        assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+        assert harness.reshapes == 1
+    finally:
+        if harness is not None:
+            harness.close()
+        stack.close()
+
+
+# -- (c) graceful drain --------------------------------------------------------
+
+def test_drain_settles_inflight_and_master_cordons_within_one_tick(
+        tmp_path):
+    from gpumounter_tpu.testing.sim import MultiNodeStack
+    stack = MultiNodeStack([_host(tmp_path, i) for i in range(2)],
+                           n_chips=4, health=True,
+                           broker_config=BrokerConfig())
+    try:
+        stack.gateway.fleet.tick()
+        rig = stack.rigs[1]
+        rig.sim.schedule_delay_s = 0.3      # slow the in-flight attach
+
+        results = []
+
+        def inflight_attach():
+            results.append(_req(
+                stack.base, "/addtpu/namespace/default/pod/workload-1"
+                            "/tpu/2/isEntireMount/false"))
+
+        thread = threading.Thread(target=inflight_attach, daemon=True)
+        thread.start()
+        import time
+        time.sleep(0.1)                     # attach is mid-actuation
+        rig.drain.begin("test")
+        settled = rig.drain.wait_settled(10.0)
+        thread.join(timeout=10.0)
+        # ZERO failed in-flight attaches: the one that was mid-flight
+        # completed normally
+        assert settled is True
+        assert results and results[0][0] == 200, results
+        assert rig.drain.status()["inflight"] == 0
+
+        # the master cordons within ONE fleet tick of the healthz flip
+        nh = stack.gateway.nodehealth
+        stack.gateway.fleet.tick()
+        assert nh.state("node-1") == "draining"
+        st, p = _req(stack.base, "/addtpu/namespace/default/pod/"
+                                 "workload-1/tpu/1/isEntireMount/false")
+        assert st == 503 and p["result"] == "NodeCordoned", p
+        # live leases are untouched by the cordon, and the owner's own
+        # detach still flows through the draining worker
+        assert stack.gateway.broker.leases.get("default",
+                                               "workload-1") is not None
+        st, p = _req(stack.base, "/removetpu/namespace/default/pod/"
+                                 "workload-1/force/false", "POST", b"")
+        assert st == 200, p
+        assert rig.drain.status()["refused"] == 0
+    finally:
+        stack.close()
+
+
+def test_draining_slice_member_migrates_proactively(tmp_path):
+    """Spot/drain half of self-healing: the node still ANSWERS, so its
+    group member moves with a clean detach (no fence) before the node
+    dies — migration, not repair."""
+    from gpumounter_tpu.testing.sim import MultiNodeStack
+    tail = EVENTS.tail(1)
+    seq0 = tail[-1]["seq"] if tail else 0
+    stack = MultiNodeStack([_host(tmp_path, i) for i in range(3)],
+                           n_chips=4, health=True,
+                           broker_config=BrokerConfig())
+    try:
+        stack.add_workload(2, "spare-0", spare=True)
+        stack.gateway.fleet.tick()
+        body = json.dumps({
+            "pods": [{"namespace": "default", "pod": "workload-0"},
+                     {"namespace": "default", "pod": "workload-1"}],
+            "tpusPerHost": 4}).encode()
+        st, p = _req(stack.base, "/addtpuslice", "POST", body)
+        assert st == 200, p
+        group = p["group"]
+
+        # the worker on node-1 begins a graceful drain; the next fleet
+        # tick folds its healthz into the state machine and triggers
+        # proactive migration
+        stack.rigs[1].drain.begin("spot")
+        stack.gateway.fleet.tick()
+        assert stack.gateway.nodehealth.state("node-1") == "draining"
+        assert _wait_for(
+            lambda: stack.gateway.slices.generation(group) == 2)
+        stack.gateway.slices.join_repairs()
+
+        st, sz = _req(stack.base, "/slicez")
+        info = sz["groups"].get(group)
+        assert info is not None
+        members = {m["pod"] for m in info["members"]}
+        assert members == {"workload-0", "spare-0"}
+        assert info["generation"] == 2
+        # migrated cleanly: no fence happened, the member detached
+        # through its (still answering) worker
+        assert not [e for e in EVENTS.tail(300)
+                    if e["seq"] > seq0 and e["kind"] == "lease_fenced"
+                    and e.get("pod") == "workload-1"]
+        repairs = [e for e in EVENTS.tail(300)
+                   if e["kind"] == "slice_repair"
+                   and e["attrs"].get("group") == group]
+        assert [e["attrs"]["outcome"] for e in repairs] == ["migrated"]
+        assert stack.rigs[1].sim.slave_pods() == []
+    finally:
+        stack.close()
+
+
+def test_migration_with_no_spare_defers_and_never_tears_down(tmp_path):
+    """Migration is the NON-destructive half: the node still answers
+    and the gang still works, so no spare capacity means DO NOTHING —
+    routine maintenance must never destroy a healthy slice (only the
+    dead path tears down)."""
+    from gpumounter_tpu.testing.sim import MultiNodeStack
+    stack = MultiNodeStack([_host(tmp_path, i) for i in range(2)],
+                           n_chips=4, health=True,
+                           broker_config=BrokerConfig())
+    try:
+        stack.gateway.fleet.tick()
+        body = json.dumps({
+            "pods": [{"namespace": "default", "pod": "workload-0"},
+                     {"namespace": "default", "pod": "workload-1"}],
+            "tpusPerHost": 4}).encode()
+        st, p = _req(stack.base, "/addtpuslice", "POST", body)
+        assert st == 200, p
+        group = p["group"]
+        stack.rigs[1].drain.begin("maintenance")
+        stack.gateway.fleet.tick()
+        assert stack.gateway.nodehealth.state("node-1") == "draining"
+        stack.gateway.slices.join_repairs()
+        # deferred: both members still leased, chips still attached,
+        # generation untouched
+        members = {m.pod for ms in [stack.gateway.broker.leases.groups()
+                                    .get(group) or []] for m in ms}
+        assert members == {"workload-0", "workload-1"}
+        assert stack.gateway.slices.generation(group) == 1
+        assert len(stack.rigs[1].sim.slave_pods()) == 1
+    finally:
+        stack.close()

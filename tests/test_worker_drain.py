@@ -1,0 +1,264 @@
+"""Graceful worker drain (worker/drain.py): the controller's admit/
+settle semantics, the typed 503 Draining across the gRPC + gateway
+hops, the /drainz + healthz surfaces, the spot-termination watcher,
+and the fault-free byte-for-byte pin. (jaxcheck checkpoint drain lives
+in tests/test_drain.py — different subsystem.)"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import grpc
+import pytest
+
+from gpumounter_tpu.k8s.client import FakeKubeClient
+from gpumounter_tpu.master.discovery import WorkerDirectory
+from gpumounter_tpu.master.gateway import MasterGateway
+from gpumounter_tpu.testing.sim import (WorkerRig, make_target_pod,
+                                        worker_pod)
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.errors import WorkerDrainingError
+from gpumounter_tpu.utils.events import EVENTS
+from gpumounter_tpu.worker.drain import (DrainController,
+                                         SpotTerminationWatcher)
+from gpumounter_tpu.worker.grpc_server import WorkerClient, build_server
+from gpumounter_tpu.worker.main import start_health_server
+
+
+# -- DrainController unit ------------------------------------------------------
+
+def test_drain_refuses_new_attaches_but_admits_detaches():
+    drain = DrainController("unit-node")
+    with drain.inflight("attach"):
+        pass                            # admitting while healthy
+    drain.begin("test")
+    with pytest.raises(WorkerDrainingError):
+        with drain.inflight("attach"):
+            pass
+    with drain.inflight("detach"):      # drain frees capacity
+        pass
+    status = drain.status()
+    assert status["draining"] is True
+    assert status["refused"] == 1
+    assert status["inflight"] == 0
+
+
+def test_drain_waits_for_inflight_actuation_to_settle():
+    drain = DrainController("unit-node")
+    release = threading.Event()
+    entered = threading.Event()
+
+    def slow_attach():
+        with drain.inflight("attach"):
+            entered.set()
+            release.wait(5.0)
+
+    thread = threading.Thread(target=slow_attach, daemon=True)
+    thread.start()
+    assert entered.wait(2.0)
+    drain.begin("test")
+    assert drain.wait_settled(0.05) is False     # still in flight
+    release.set()
+    assert drain.wait_settled(2.0) is True
+    thread.join(timeout=2.0)
+
+
+def test_drain_run_sequence_flushes_and_events():
+    drain = DrainController("drain-seq-node")
+    assert drain.run(reason="unit") is True
+    kinds = [e["kind"] for e in EVENTS.tail(200)
+             if e.get("node") == "drain-seq-node"]
+    assert kinds == ["drain_begin", "drain_complete"]
+    assert drain.status()["completed_unix"] is not None
+    # idempotent: a second begin is a no-op
+    assert drain.begin("again") is False
+
+
+def test_spot_watcher_triggers_drain_on_notice_file(tmp_path):
+    fired = threading.Event()
+    notice = tmp_path / "preempted"
+    watcher = SpotTerminationWatcher(str(notice), fired.set,
+                                     poll_interval_s=0.01).start()
+    try:
+        time.sleep(0.05)
+        assert not fired.is_set()
+        notice.write_text("TRUE")
+        assert fired.wait(2.0)
+        assert watcher.fired
+    finally:
+        watcher.stop()
+
+
+# -- across the wire: worker refusal → typed 503 at the gateway ----------------
+
+@pytest.fixture
+def drain_stack(fake_host):
+    """WorkerRig with a DrainController + live gRPC worker + gateway."""
+    rig = WorkerRig(fake_host)
+    rig.drain = DrainController(rig.sim.node)
+    rig.service.drain = rig.drain
+    server, port = build_server(rig.service, port=0, address="127.0.0.1")
+    server.start()
+    master_kube = FakeKubeClient()
+    master_kube.put_pod(worker_pod("node-a", "127.0.0.1"))
+    master_kube.put_pod(make_target_pod())
+    gateway = MasterGateway(master_kube,
+                            WorkerDirectory(master_kube, grpc_port=port))
+    yield rig, gateway, port
+    server.stop(grace=0)
+    rig.close()
+
+
+ADD = "/addtpu/namespace/default/pod/workload/tpu/1/isEntireMount/false"
+REMOVE = "/removetpu/namespace/default/pod/workload/force/false"
+
+
+def test_draining_worker_answers_typed_503_draining(drain_stack):
+    rig, gateway, port = drain_stack
+    rig.drain.begin("test")
+    # raw gRPC: UNAVAILABLE with the draining: detail marker
+    with WorkerClient(f"127.0.0.1:{port}") as client:
+        with pytest.raises(grpc.RpcError) as err:
+            client.add_tpu("workload", "default", 1, False,
+                           request_id="rid-drain")
+        assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert err.value.details().startswith(
+            consts.DRAINING_DETAIL_PREFIX)
+    # gateway: typed 503 Draining + Retry-After hint, NOT a 502 —
+    # and exactly ONE worker round trip (no transport-fault retries)
+    status, payload = gateway.handle("GET", ADD)
+    assert status == 503
+    assert payload["result"] == "Draining"
+    assert payload["retry_after_s"] > 0
+
+
+def test_draining_worker_still_serves_detaches(drain_stack):
+    rig, gateway, _ = drain_stack
+    status, payload = gateway.handle("GET", ADD)
+    assert status == 200, payload
+    rig.drain.begin("test")
+    status, payload = gateway.handle("POST", REMOVE)
+    assert status == 200, payload
+    assert payload["result"] == "SUCCESS"
+    assert rig.drain.status()["refused"] == 0
+
+
+def test_drain_refusal_is_not_a_breaker_failure(drain_stack):
+    """Every retry of a draining worker gets the same answer — the
+    gateway must neither retry nor count it toward the breaker (a
+    draining node is healthy, not failing)."""
+    rig, gateway, port = drain_stack
+    rig.drain.begin("test")
+    for _ in range(gateway.breaker_failure_threshold + 2):
+        status, payload = gateway.handle("GET", ADD)
+        assert status == 503
+        assert payload["result"] == "Draining"
+    breaker = gateway._breaker(f"127.0.0.1:{port}")
+    breaker.allow()        # closed: would raise CircuitOpenError if open
+
+
+# -- health surfaces -----------------------------------------------------------
+
+def test_healthz_and_drainz_surfaces(fake_host):
+    rig = WorkerRig(fake_host)
+    drain = DrainController("node-a")
+    rig.service.drain = drain
+    server = start_health_server(0, journal=rig.journal, drain=drain,
+                                 ready=True)
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        with urllib.request.urlopen(base + "/healthz") as resp:
+            assert resp.read() == b"ok"
+        with urllib.request.urlopen(base + "/readyz") as resp:
+            assert resp.status == 200
+        with urllib.request.urlopen(base + "/drainz") as resp:
+            payload = json.loads(resp.read())
+        assert payload == {"enabled": True, **drain.status()}
+        # POST /drainz begins the drain
+        req = urllib.request.Request(base + "/drainz", method="POST",
+                                     data=b"")
+        with urllib.request.urlopen(req) as resp:
+            payload = json.loads(resp.read())
+        assert payload["started"] is True
+        assert payload["draining"] is True
+        # healthz says draining (still 200 — alive, just leaving);
+        # readyz flips not-ready so the kubelet stops routing
+        with urllib.request.urlopen(base + "/healthz") as resp:
+            assert resp.read() == b"draining"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(base + "/readyz")
+        assert err.value.code == 503
+        # a second POST reports started=False (idempotent)
+        with urllib.request.urlopen(req) as resp:
+            assert json.loads(resp.read())["started"] is False
+    finally:
+        server.shutdown()
+        rig.close()
+
+
+def test_drainz_without_controller_answers_disabled(fake_host):
+    rig = WorkerRig(fake_host)
+    server = start_health_server(0, journal=rig.journal, ready=True)
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        with urllib.request.urlopen(base + "/drainz") as resp:
+            assert json.loads(resp.read()) == {"enabled": False}
+        with urllib.request.urlopen(base + "/healthz") as resp:
+            assert resp.read() == b"ok"
+    finally:
+        server.shutdown()
+        rig.close()
+
+
+# -- byte-for-byte pin ---------------------------------------------------------
+
+def test_fault_free_path_with_idle_drain_is_byte_for_byte(fake_host,
+                                                          tmp_path):
+    """The drain subsystem wired but idle must not change ANYTHING
+    about a normal attach/detach: same outcomes, same journal records,
+    zero drain events."""
+    import copy
+
+    def run(with_drain: bool, host):
+        rig = WorkerRig(host)
+        if with_drain:
+            rig.drain = DrainController(rig.sim.node)
+            rig.service.drain = rig.drain
+        try:
+            add = rig.service.add_tpu("workload", "default", 2, False,
+                                      request_id="rid-b4b")
+            remove = rig.service.remove_tpu("workload", "default", [],
+                                            False, request_id="rid-b4b2")
+            records = copy.deepcopy(rig.journal.snapshot()["records"])
+            for record in records:
+                record.pop("ts", None)
+                record.pop("jid", None)
+                # slave-pod names carry a random suffix per run: the
+                # comparison cares about count + record shape
+                if "slaves" in record:
+                    record["slaves"] = len(record["slaves"])
+            return (add.result, sorted(c.uuid for c in add.chips),
+                    remove.result, records)
+        finally:
+            rig.close()
+
+    from gpumounter_tpu.utils.config import HostPaths
+    tail = EVENTS.tail(1)
+    seq0 = tail[-1]["seq"] if tail else 0
+    base = tmp_path / "b4b"
+    for sub in ("dev", "proc", "sys/fs/cgroup"):
+        (base / sub).mkdir(parents=True)
+    other = HostPaths(dev_root=str(base / "dev"),
+                      proc_root=str(base / "proc"),
+                      sys_root=str(base / "sys"),
+                      cgroup_root=str(base / "sys" / "fs" / "cgroup"),
+                      kubelet_socket=str(base / "pr" / "kubelet.sock"))
+    with_drain = run(True, fake_host)
+    without = run(False, other)
+    assert with_drain == without
+    assert not [e for e in EVENTS.tail(300)
+                if e["seq"] > seq0
+                and e["kind"] in ("drain_begin", "drain_complete",
+                                  "spot_termination")]

@@ -43,6 +43,7 @@ from gpumounter_tpu.k8s.client import KubeClient
 from gpumounter_tpu.k8s.informer import PodCacheReads
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.config import Settings
+from gpumounter_tpu.utils.parking import parked
 from gpumounter_tpu.utils.errors import (AllocationTimeoutError,
                                          DeviceNotFoundError,
                                          InsufficientTPUError, K8sApiError)
@@ -371,7 +372,10 @@ class TPUAllocator:
                 return out, pending
             logger.info("kubelet lists no devices yet for %s; retrying",
                         sorted(pending))
-            time.sleep(poll_s)
+            # parked (utils/parking.py): kubelet device-plugin lag is a
+            # pure wait — the handler thread's executor slot goes back
+            with parked("kubelet-lag"):
+                time.sleep(poll_s)
             poll_s = min(poll_s * 2, 2.0)
 
     # Node topology labels are set at nodepool creation and effectively

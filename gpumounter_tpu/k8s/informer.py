@@ -50,6 +50,7 @@ from gpumounter_tpu.k8s import objects
 from gpumounter_tpu.k8s.client import KubeClient, _match_label_selector
 from gpumounter_tpu.utils.errors import K8sApiError, PodNotFoundError
 from gpumounter_tpu.utils.log import get_logger
+from gpumounter_tpu.utils.parking import parked
 from gpumounter_tpu.utils.retry import retryable
 
 logger = get_logger("k8s.informer")
@@ -387,7 +388,9 @@ class PodCacheReads:
         if informer is None or informer.label_selector:
             return self.kube.get_pod(namespace, name)
         want = _rv_int(min_resource_version)
-        if not informer.wait_caught_up(want, self.fence_timeout_s):
+        with parked("informer-fence"):
+            caught_up = informer.wait_caught_up(want, self.fence_timeout_s)
+        if not caught_up:
             self._miss("get", "lag")
             return self.kube.get_pod(namespace, name)
         pod = informer.get(name)
@@ -413,7 +416,9 @@ class PodCacheReads:
         if informer is None:
             return self.kube.list_pods_with_version(namespace,
                                                     label_selector)
-        if not informer.wait_caught_up(None, self.fence_timeout_s):
+        with parked("informer-fence"):
+            caught_up = informer.wait_caught_up(None, self.fence_timeout_s)
+        if not caught_up:
             self._miss("list", "lag")
             return self.kube.list_pods_with_version(namespace,
                                                     label_selector)
@@ -443,10 +448,21 @@ class PodCacheReads:
             # cache that hasn't yet applied this process's own creates —
             # it would prune just-created pods as gone. Cache lagging the
             # fence ⇒ the legacy LIST-seeded path sees ground truth.
-            if informer.wait_caught_up(None, self.fence_timeout_s):
-                return informer.wait_for(
-                    lambda: step(informer.matching(label_selector)),
-                    timeout_s)
+            # Informer-backed waits run parked (utils/parking.py): the
+            # thread sleeps on the shared stream's condition — a handler
+            # parked here hands its executor slot back. The LIST-seeded
+            # fallback below is deliberately NOT parked: it does real
+            # apiserver work (LIST + watch processing) per waiter, and
+            # uncharging it would let thousands of concurrent watch
+            # loops run exactly when the slow path is most expensive.
+            with parked("informer-fence"):
+                caught_up = informer.wait_caught_up(None,
+                                                    self.fence_timeout_s)
+            if caught_up:
+                with parked("pod-wait"):
+                    return informer.wait_for(
+                        lambda: step(informer.matching(label_selector)),
+                        timeout_s)
             self._miss("wait", "lag")
         return self._wait_pods_watch(namespace, label_selector, step,
                                      timeout_s, watch_chunk_s)

@@ -50,6 +50,7 @@ import time
 
 from gpumounter_tpu.k8s import objects
 from gpumounter_tpu.master.lease import Lease, LeaseTable
+from gpumounter_tpu.master.waiterindex import WaiterQueue, _rank
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.errors import (K8sApiError, QueueFullError,
                                          QuotaExceededError,
@@ -65,13 +66,6 @@ logger = get_logger("master.admission")
 # removed it or someone (owner detach, reconciler) beat us to it. The
 # distinction matters for counters, not for lease bookkeeping.
 _DETACH_GONE = ("SUCCESS", "TPU_NOT_FOUND", "POD_NOT_FOUND")
-
-
-def _rank(priority: str) -> int:
-    try:
-        return consts.PRIORITIES.index(priority)
-    except ValueError:
-        return consts.PRIORITIES.index(consts.DEFAULT_PRIORITY)
 
 
 @dataclasses.dataclass
@@ -96,6 +90,11 @@ class BrokerConfig:
     # Slice self-healing budget (master/slicetxn.py repair_group):
     # repair txns one group may consume before teardown-as-a-unit.
     slice_repair_budget: int = consts.DEFAULT_SLICE_REPAIR_BUDGET
+    # Indexed waiter wakeup (master/waiterindex.py): capacity signals
+    # examine only candidates the freed capacity could satisfy instead
+    # of rescanning the whole queue. Selection order is pinned
+    # equivalent; False (TPU_WAITER_INDEX=0) reverts to the linear scan.
+    waiter_index: bool = True
     tick_interval_s: float = 1.0
     pool_namespace: str = consts.DEFAULT_POOL_NAMESPACE
     resource_name: str = consts.TPU_RESOURCE_NAME
@@ -110,6 +109,7 @@ class BrokerConfig:
                    gang_hold_s=settings.gang_hold_s,
                    idle_lease_s=settings.idle_lease_s,
                    slice_repair_budget=settings.slice_repair_budget,
+                   waiter_index=settings.waiter_index,
                    pool_namespace=settings.pool_namespace,
                    resource_name=settings.resource_name)
 
@@ -166,7 +166,9 @@ class AttachBroker:
         self.config = config or BrokerConfig()
         self.leases = LeaseTable()
         self._lock = threading.Lock()
-        self._waiters: list[_Waiter] = []
+        # Parked waiters: insertion-ordered membership + the bucketed
+        # wakeup index (master/waiterindex.py). All access under _lock.
+        self._waiters = WaiterQueue(indexed=self.config.waiter_index)
         # Capacity generation: bumped whenever chips may have freed (or
         # preemption candidates appeared). Waiters retry at most once per
         # generation, so one freed slave pod wakes one chain of retries,
@@ -269,6 +271,11 @@ class AttachBroker:
         self.election = election
         self.leases.store = store
         self.leases.on_fenced = self._on_fenced
+        if store is not None:
+            # the group-commit coalescer's fence surface: a fused batch
+            # bounced off a higher fence demotes this replica's shard
+            # exactly like a per-record write raising StoreFencedError
+            store.on_fenced = self._on_fenced
 
     def bind_attempt_factory(self, factory) -> None:
         self._attempt_factory = factory
@@ -662,8 +669,9 @@ class AttachBroker:
             payload["lease_expires_in_s"] = round(remaining, 1)
         payload["tenant"] = tenant
         # a recorded lease is ALSO a new preemption candidate: give any
-        # parked high-priority waiter a chance to act on it
-        self.signal_capacity()
+        # parked high-priority waiter a chance to act on it — on THIS
+        # node; nothing freed anywhere else
+        self.signal_capacity(node=node)
 
     def _attach_queued(self, tenant: str, priority: str, namespace: str,
                        pod: str, chips: int, node: str, rid: str,
@@ -678,7 +686,7 @@ class AttachBroker:
             waiter = _Waiter(tenant, priority, chips, node, rid,
                              namespace, pod, gen=gen0, entire=entire,
                              timeout_s=timeout)
-            self._waiters.append(waiter)
+            self._waiters.add(waiter)
             if self._gen != gen0:
                 # capacity freed between the failed attempt and the
                 # enqueue — that wakeup is gone; self-arm instead of
@@ -790,7 +798,7 @@ class AttachBroker:
         """The one queue-full gate (single waiters and gangs share it):
         returns the current same-priority depth, or raises
         :class:`QueueFullError` with the derived hint."""
-        depth = sum(1 for w in self._waiters if w.priority == priority)
+        depth = self._waiters.count(priority)
         if depth >= self.config.queue_depth:
             REGISTRY.admission_decisions.inc(tenant=tenant,
                                              outcome="queue_full")
@@ -825,7 +833,7 @@ class AttachBroker:
                              gen=self._gen if gen0 is None else gen0,
                              entire=True, timeout_s=timeout_s)
             waiter.gang = True
-            self._waiters.append(waiter)
+            self._waiters.add(waiter)
             if gen0 is not None and self._gen != gen0:
                 waiter.tried_gen = self._gen
                 waiter.event.set()
@@ -873,13 +881,19 @@ class AttachBroker:
 
     # -- capacity signalling / fair dequeue ------------------------------------
 
-    def signal_capacity(self) -> None:
+    def signal_capacity(self, node: str | None = None,
+                        chips: int = 0) -> None:
         """Chips may have freed (detach / expiry / preemption) or the
         preemption candidate set changed: open a new retry generation and
-        wake the first waiter in priority-then-fair order."""
+        wake the first waiter in priority-then-fair order. ``node`` and
+        ``chips`` are locality hints — where capacity freed and how much
+        — that let the waiter index (master/waiterindex.py) examine only
+        candidates the capacity could actually satisfy; with no hints
+        (or the index off) every waiter is a candidate, the historical
+        behavior."""
         with self._lock:
             self._gen += 1
-            self._signal_next_locked()
+            self._signal_next_locked(node=node, chips=chips)
 
     def _signal_next(self, exclude: _Waiter | None = None) -> None:
         with self._lock:
@@ -887,35 +901,28 @@ class AttachBroker:
                 exclude.tried_gen = self._gen
             self._signal_next_locked()
 
-    def _signal_next_locked(self) -> None:
-        candidates = [w for w in self._waiters
-                      if w.tried_gen < self._gen and not w.event.is_set()]
-        if not candidates:
+    def _signal_next_locked(self, node: str | None = None,
+                            chips: int = 0) -> None:
+        if not self._waiters:
             return
-        usage = self.leases.usage()
-
-        def fair_share(waiter: _Waiter) -> float:
-            # weighted fairness: live usage normalised by quota — the
-            # tenant consuming the smallest share of its entitlement goes
-            # first; unlimited tenants weigh by raw usage
-            quota = self.quota(waiter.tenant)
-            return usage.get(waiter.tenant, 0) / (quota or 1e9)
-
-        chosen = min(candidates,
-                     key=lambda w: (-_rank(w.priority), fair_share(w),
-                                    w.enqueued_at))
+        chosen, evaluated = self._waiters.select(
+            self._gen, node=node or None, chips=chips,
+            usage_fn=self.leases.usage, quota_fn=self.quota)
+        REGISTRY.wakeup_signals.inc()
+        if evaluated:
+            REGISTRY.wakeup_evaluations.inc(float(evaluated))
+        if chosen is None:
+            return
         chosen.tried_gen = self._gen
         chosen.event.set()
 
     def _refresh_queue_gauges_locked(self) -> None:
         now = time.monotonic()
         for priority in consts.PRIORITIES:
-            REGISTRY.queue_depth.set(
-                sum(1 for w in self._waiters if w.priority == priority),
-                priority=priority)
-        REGISTRY.gang_queue_depth.set(
-            sum(1 for w in self._waiters if w.gang))
-        oldest = min((w.enqueued_at for w in self._waiters), default=None)
+            REGISTRY.queue_depth.set(self._waiters.count(priority),
+                                     priority=priority)
+        REGISTRY.gang_queue_depth.set(self._waiters.gang_count())
+        oldest = self._waiters.oldest_enqueued_at()
         REGISTRY.queue_oldest_age.set(
             0.0 if oldest is None else round(now - oldest, 3))
 
@@ -964,7 +971,7 @@ class AttachBroker:
                             namespace=victim.namespace, pod=victim.pod,
                             chips=victim.chips, victim_tenant=victim.tenant,
                             victim_priority=victim.priority, result=result)
-            self.signal_capacity()
+            self.signal_capacity(node=victim.node, chips=victim.chips)
             return True
         logger.warning("preemption of %s/%s did not free chips: %s",
                        victim.namespace, victim.pod, result)
@@ -1068,8 +1075,13 @@ class AttachBroker:
         without a lease on record (pre-broker attach), freed chips are
         freed chips. Peer shards get a capacity poke too: their parked
         gangs may span the node these chips just freed on."""
-        self.leases.release(namespace, pod, uuids)
-        self.signal_capacity()
+        lease = self.leases.get(namespace, pod)
+        released = self.leases.release(namespace, pod, uuids)
+        # locality hints from the lease the detach resolved against; a
+        # pre-broker attach (no lease) signals globally as before
+        self.signal_capacity(
+            node=lease.node if lease is not None else None,
+            chips=released)
         self.poke_peers()
 
     # -- node failure domain: lease fencing (master/nodehealth.py) -------------
@@ -1111,7 +1123,7 @@ class AttachBroker:
                        "reclaimed without a worker detach",
                        lease.namespace, lease.pod, reason, lease.chips,
                        lease.node or "?")
-        self.signal_capacity()
+        self.signal_capacity(node=lease.node, chips=lease.chips)
         self.poke_peers()
         return True
 
@@ -1227,6 +1239,12 @@ class AttachBroker:
         if self._loop is not None:
             self._loop.join(timeout=2.0)
             self._loop = None
+        if self.store is not None:
+            # stops the group-commit coalescer thread; deliberately no
+            # final flush — stop() is also the crash path (kill()
+            # semantics in the chaos stacks), and unflushed pending is
+            # exactly the documented best-effort durability window
+            self.store.stop()
 
     def _run(self) -> None:
         while not self._stop.wait(self.config.tick_interval_s):
@@ -1258,6 +1276,12 @@ class AttachBroker:
             if self._reap(lease, now):
                 reaped += 1
         if self.store is not None:
+            # group-commit backstop: the coalescer thread normally
+            # flushes within its bounded delay; the tick re-drives it so
+            # a wedged/dead flusher degrades to tick-cadence durability
+            # instead of never-durable (flush_pending never raises — the
+            # fence surface is the on_fenced callback bound in bind_ha)
+            self.store.flush_pending()
             try:
                 self.store.flush_dirty()
                 # batched heartbeat persistence (lease.py renew():
@@ -1419,7 +1443,7 @@ class AttachBroker:
             EVENTS.emit("lease_expired", rid=lease.rid,
                         tenant=lease.tenant, namespace=lease.namespace,
                         pod=lease.pod, chips=lease.chips, result=result)
-            self.signal_capacity()
+            self.signal_capacity(node=lease.node, chips=lease.chips)
             return True
         # busy devices / transport trouble: back off linearly, keep the
         # lease visible in /brokerz as stuck rather than silently immortal

@@ -190,7 +190,9 @@ class MasterGateway:
             else:
                 self.election = NullElection(self.ha.shards)
             store = (IntentStore(kube, self.ring, self.ha.namespace,
-                                 election=self.election)
+                                 election=self.election,
+                                 group_commit_delay_s=self.ha.
+                                 group_commit_delay_s)
                      if self.ha.store else None)
             self.broker.bind_ha(store, self.ring, self.election)
             self.broker.bind_attempt_factory(self._adopted_attempt)

@@ -40,6 +40,12 @@ class HAConfig:
     renew_interval_s: float = consts.DEFAULT_ELECTION_RENEW_S
     lease_duration_s: float = consts.DEFAULT_ELECTION_TTL_S
     namespace: str = consts.DEFAULT_POOL_NAMESPACE
+    # Intent-store group commit (master/store.py): bounded coalescing
+    # delay before queued record mutations fuse into ONE CAS per shard.
+    # 0 here (direct HAConfig construction — existing rigs/tests) keeps
+    # the PR 8 per-record path byte-for-byte; from_settings carries the
+    # production default (TPU_STORE_GROUP_COMMIT, on unless "0").
+    group_commit_delay_s: float = 0.0
 
     def __post_init__(self):
         if self.shards < 1:
@@ -58,7 +64,8 @@ class HAConfig:
                    forward=settings.shard_forward,
                    renew_interval_s=settings.election_renew_s,
                    lease_duration_s=settings.election_ttl_s,
-                   namespace=settings.pool_namespace)
+                   namespace=settings.pool_namespace,
+                   group_commit_delay_s=settings.store_group_commit_s)
 
     @property
     def enabled(self) -> bool:

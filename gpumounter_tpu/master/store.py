@@ -228,7 +228,9 @@ class IntentStore:
     """
 
     def __init__(self, kube, ring, namespace: str | None = None,
-                 election=None):
+                 election=None, group_commit_delay_s: float = 0.0,
+                 group_commit_max_keys: int =
+                 consts.STORE_GROUP_COMMIT_MAX_KEYS):
         from gpumounter_tpu.master.election import NullElection
         self.kube = kube
         self.ring = ring
@@ -250,6 +252,37 @@ class IntentStore:
         # detection — only a MOVED stamp is a nudge)
         self._poke_sent: dict[int, float] = {}
         self._poke_seen: dict[int, str] = {}
+        # Group commit (the 10k admission path, GPUOS-style operation
+        # fusion): with delay > 0, per-record mutations coalesce in a
+        # per-shard pending map (last-writer-wins per key) and land as
+        # ONE fenced CAS per shard — flushed by the coalescer thread
+        # within the bounded delay, at the size threshold, and by the
+        # broker tick as the backstop. 0 (the default; the
+        # TPU_STORE_GROUP_COMMIT=0 revert) keeps the per-record CAS
+        # path byte-for-byte.
+        self.group_commit_delay_s = group_commit_delay_s
+        self.group_commit_max_keys = group_commit_max_keys
+        self._pending: dict[int, dict[str, str | None]] = {}
+        self._pending_count = 0          # distinct queued keys, O(1)
+        self._pending_first: float | None = None
+        self._flush_cond = threading.Condition(self._lock)
+        # serializes whole flushes (swap + CAS): two concurrent flushes
+        # could otherwise land one key's batches out of order and
+        # resurrect a superseded value
+        self._flush_mutex = threading.Lock()
+        self._flusher: threading.Thread | None = None
+        self._stop_flag = False
+        # bound by the broker (bind_ha): a batch bounced off a higher
+        # fence demotes the shard exactly like a per-record write would
+        # — the coalescer surfaces it through this callback instead of
+        # raising on its own thread.
+        self.on_fenced = None
+        self.group_commits = 0
+        if self.group_commit_delay_s > 0:
+            self._flusher = threading.Thread(
+                target=self._flusher_run, daemon=True,
+                name="tpumounter-store-coalescer")
+            self._flusher.start()
 
     # -- naming ----------------------------------------------------------------
 
@@ -262,13 +295,13 @@ class IntentStore:
     # -- write-through ---------------------------------------------------------
 
     def put_lease(self, record: LeaseRecord) -> bool:
-        return self._write(self.shard_of(record.namespace),
-                           record.annotation_key, record.to_json())
+        return self._mutate(self.shard_of(record.namespace),
+                            record.annotation_key, record.to_json())
 
     def delete_lease(self, namespace: str, pod: str) -> bool:
         key = (consts.STORE_LEASE_ANNOTATION_PREFIX
                + _digest(f"{namespace}/{pod}"))
-        return self._write(self.shard_of(namespace), key, None)
+        return self._mutate(self.shard_of(namespace), key, None)
 
     def put_leases(self, records: list[LeaseRecord]) -> None:
         """Batched write-through: all of one shard's records land in ONE
@@ -280,6 +313,16 @@ class IntentStore:
         for record in records:
             by_shard.setdefault(self.shard_of(record.namespace),
                                 []).append(record)
+        # Serialized against the coalescer's whole flush cycle: a flush
+        # that already SWAPPED its batches out (and is mid-CAS) holds
+        # keys the purge below can no longer see — landing this fresh
+        # sync concurrently would let that stale batch overwrite it.
+        with self._flush_mutex:
+            self._put_leases_locked(by_shard)
+        self._export_lag_locked_free()
+
+    def _put_leases_locked(self,
+                           by_shard: dict[int, list[LeaseRecord]]) -> None:
         for shard, group in by_shard.items():
             if self.election.enabled and self.election.token(shard) is None:
                 continue
@@ -296,26 +339,178 @@ class IntentStore:
             REGISTRY.store_cas.inc(op="put", outcome="ok")
             with self._lock:
                 # the batch supersedes any parked mutation for its keys
+                # — dirty AND coalescer-pending alike (a stale pending
+                # put flushing after this fresh sync would regress the
+                # records it just wrote)
                 self._dirty = [d for d in self._dirty
                                if not (d[0] == shard and d[1] in changes)]
+                shard_pending = self._pending.get(shard)
+                if shard_pending:
+                    for key in changes:
+                        # membership check, not pop-default: a queued
+                        # DELETE's value is None too
+                        if key in shard_pending:
+                            del shard_pending[key]
+                            self._pending_count -= 1
+                    if not shard_pending:
+                        self._pending.pop(shard, None)
+                    if not self._pending:
+                        self._pending_first = None   # see forget_shard
             self._export_records(shard)
-        self._export_lag_locked_free()
 
     def put_waiter(self, record: WaiterRecord) -> bool:
-        return self._write(self.shard_of(record.namespace),
-                           record.annotation_key, record.to_json())
+        return self._mutate(self.shard_of(record.namespace),
+                            record.annotation_key, record.to_json())
 
     def delete_waiter(self, namespace: str, rid: str) -> bool:
         key = consts.STORE_WAITER_ANNOTATION_PREFIX + _digest(rid)
-        return self._write(self.shard_of(namespace), key, None)
+        return self._mutate(self.shard_of(namespace), key, None)
 
     def put_slice_txn(self, record: SliceTxnRecord) -> bool:
-        return self._write(self.shard_of(record.namespace),
-                           record.annotation_key, record.to_json())
+        return self._mutate(self.shard_of(record.namespace),
+                            record.annotation_key, record.to_json())
 
     def delete_slice_txn(self, namespace: str, txn_id: str) -> bool:
         key = consts.STORE_SLICE_ANNOTATION_PREFIX + _digest(txn_id)
-        return self._write(self.shard_of(namespace), key, None)
+        return self._mutate(self.shard_of(namespace), key, None)
+
+    # -- group commit (the coalescer seam) -------------------------------------
+
+    def _mutate(self, shard: int, key: str, value: str | None) -> bool:
+        """THE per-record mutation seam (tests/test_store_lint.py pins
+        that every record write crosses it): group commit queues the
+        mutation for the next fused per-shard CAS; with the coalescer
+        off this is the legacy synchronous per-record write —
+        sanctioned direct ``_write``, the TPU_STORE_GROUP_COMMIT=0
+        byte-for-byte path."""
+        if self.group_commit_delay_s > 0:
+            self._enqueue(shard, key, value)
+            return True
+        return self._write(shard, key, value)
+
+    def _enqueue(self, shard: int, key: str, value: str | None) -> None:
+        """Queue one mutation for the coalescer, last-writer-wins per
+        key — the SAME discipline the dirty queue applies, extended
+        across both structures: a newer pending value supersedes any
+        parked dirty mutation for its key, so the two can never replay
+        out of order against each other."""
+        with self._flush_cond:
+            batch = self._pending.setdefault(shard, {})
+            if key not in batch:
+                self._pending_count += 1
+            batch[key] = value
+            first = self._pending_first is None
+            if first:
+                self._pending_first = time.monotonic()
+            self._dirty = [d for d in self._dirty
+                           if not (d[0] == shard and d[1] == key)]
+            # wake the flusher only when its wait condition changed:
+            # the empty→nonempty transition (arms the delay window) or
+            # the size threshold (flushes early) — NOT once per record,
+            # which would be a spurious wakeup per mutation at exactly
+            # the rates the coalescer exists to absorb
+            if first or self._pending_count >= self.group_commit_max_keys:
+                self._flush_cond.notify_all()
+
+    def _flusher_run(self) -> None:
+        while True:
+            with self._flush_cond:
+                while not self._pending and not self._stop_flag:
+                    self._flush_cond.wait(timeout=0.5)
+                if self._stop_flag:
+                    return
+                # bounded delay from the OLDEST queued mutation; the
+                # size threshold (or stop) flushes early
+                while True:
+                    first = self._pending_first
+                    if first is None or self._stop_flag \
+                            or self._pending_count \
+                            >= self.group_commit_max_keys:
+                        break
+                    remaining = (first + self.group_commit_delay_s
+                                 - time.monotonic())
+                    if remaining <= 0:
+                        break
+                    self._flush_cond.wait(timeout=remaining)
+                    if not self._pending:
+                        break
+                if self._stop_flag:
+                    return
+                if not self._pending:
+                    continue
+            self.flush_pending()
+
+    def flush_pending(self) -> int:
+        """Land every coalesced mutation: ONE fenced CAS per shard
+        carrying the shard's whole pending batch. Driven by the
+        coalescer thread (bounded delay / size threshold) and by the
+        broker tick as the backstop; callable directly by tests.
+        Never raises — a batch refused by the fence parks dirty and
+        surfaces through ``on_fenced`` (demotion), exactly the
+        per-record discipline; apiserver trouble parks dirty for
+        ``flush_dirty``. Returns mutations landed."""
+        with self._flush_mutex:
+            with self._lock:
+                batches = self._pending
+                self._pending = {}
+                self._pending_count = 0
+                self._pending_first = None
+            landed = 0
+            for shard, changes in sorted(batches.items()):
+                if self.election.enabled \
+                        and self.election.token(shard) is None:
+                    # no live token: leadership decayed (or the shard
+                    # moved) — writing would be unfenced. Park; the
+                    # dirty flush keeps decayed-shard entries for the
+                    # resume and drops them only on a REAL hand-off.
+                    for key, value in changes.items():
+                        self._park(shard, key, value)
+                    continue
+                try:
+                    self._cas(shard, changes)
+                except StoreFencedError as e:
+                    REGISTRY.store_cas.inc(op="batch", outcome="fenced")
+                    for key, value in changes.items():
+                        self._park(shard, key, value)
+                    if e.token != -1 and self.on_fenced is not None:
+                        # genuinely deposed (a peer's higher fence):
+                        # demote — which forgets the shard and with it
+                        # the mutations just parked
+                        self.on_fenced(e)
+                    continue
+                except K8sApiError as e:
+                    REGISTRY.store_cas.inc(op="batch", outcome="error")
+                    logger.warning("group commit for shard %d parked "
+                                   "dirty (%d key(s)): %s", shard,
+                                   len(changes), e)
+                    for key, value in changes.items():
+                        self._park(shard, key, value)
+                    continue
+                REGISTRY.store_cas.inc(op="batch", outcome="ok")
+                self.group_commits += 1
+                landed += len(changes)
+                with self._lock:
+                    # the batch supersedes any parked mutation for its
+                    # keys (same rule as a landed per-record write)
+                    self._dirty = [d for d in self._dirty
+                                   if not (d[0] == shard
+                                           and d[1] in changes)]
+                self._export_records(shard)
+            self._export_lag_locked_free()
+            return landed
+
+    def stop(self) -> None:
+        """Stop the coalescer thread WITHOUT flushing: pending
+        mutations die with the process exactly as a crash would lose
+        them — kill() test semantics and the documented best-effort
+        durability window (docs/guide/Performance.md). Tests wanting
+        determinism call :meth:`flush_pending` first."""
+        with self._flush_cond:
+            self._stop_flag = True
+            self._flush_cond.notify_all()
+        if self._flusher is not None:
+            self._flusher.join(timeout=2.0)
+            self._flusher = None
 
     # -- cross-shard capacity pokes --------------------------------------------
 
@@ -587,6 +782,14 @@ class IntentStore:
         with self._lock:
             self._observed.pop(shard, None)
             self._dirty = [d for d in self._dirty if d[0] != shard]
+            # coalescer-pending mutations are the new leader's problem
+            # now too — flushing ours would only bounce off the fence
+            self._pending_count -= len(self._pending.pop(shard, {}) or {})
+            if not self._pending:
+                # the delay window re-arms from the NEXT enqueue; a
+                # stale stamp would both skip its notify and collapse
+                # the next batch's coalescing window
+                self._pending_first = None
             # stale poke baseline would mis-read the new leader's first
             # stamp as "unchanged" on a later reacquire
             self._poke_seen.pop(shard, None)
@@ -703,10 +906,20 @@ class IntentStore:
     def snapshot(self) -> dict:
         with self._lock:
             dirty = len(self._dirty)
-        return {
+            pending = self._pending_count
+        out = {
             "namespace": self.namespace,
             "shards": self.ring.shards,
             "dirty": dirty,
             "lag_s": round(self.lag_s(), 3),
             "torn_records": self.torn_records,
         }
+        if self.group_commit_delay_s > 0:
+            # keys present only with the coalescer ON, so the
+            # TPU_STORE_GROUP_COMMIT=0 payload stays byte-for-byte PR 8
+            out["group_commit"] = {
+                "delay_s": self.group_commit_delay_s,
+                "pending": pending,
+                "commits": self.group_commits,
+            }
+        return out

@@ -216,7 +216,8 @@ class WorkerRig:
                  kubelet_lag_s=0.0, warm_pool: dict[str, int] | None = None,
                  informer: bool = False, agent: bool = False,
                  usage=False, usage_interval_s: float = 0.25,
-                 gate=False):
+                 gate=False, grpc_workers: int | None = None,
+                 grpc_async: bool | None = None):
         from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
         from gpumounter_tpu.actuation.mount import TPUMounter
         from gpumounter_tpu.actuation.nsenter import (ProcRootActuator,
@@ -232,6 +233,14 @@ class WorkerRig:
                                  if use_kubelet_socket else None))
         self.sim.settings.host = fake_host
         self.host = fake_host
+        # gRPC executor knobs (the TPU_GRPC_WORKERS / TPU_GRPC_ASYNC
+        # pair): carried on the rig's Settings so LiveStack's
+        # grpc_workers=None / grpc_mode="settings" defaults read them —
+        # the same Settings → server plumbing worker/main.py runs.
+        if grpc_workers is not None:
+            self.sim.settings.grpc_workers = grpc_workers
+        if grpc_async is not None:
+            self.sim.settings.grpc_async = grpc_async
         self.pod = self.sim.add_target_pod(name=pod_name)
         self.pod_name = pod_name
         self.pid = pid
@@ -410,7 +419,11 @@ class LiveStack:
     ``base`` is the master's URL; close() tears everything down."""
 
     def __init__(self, rig: WorkerRig, broker_config=None,
-                 shared_kube: bool = False, grpc_workers: int = 8):
+                 shared_kube: bool = False,
+                 grpc_workers: int | None = 8,
+                 grpc_mode: str = "threadpool",
+                 gateway_workers: int | None = None,
+                 gateway_max_conns: int | None = None):
         from gpumounter_tpu.master.admission import AttachBroker
         from gpumounter_tpu.master.discovery import WorkerDirectory
         from gpumounter_tpu.master.gateway import MasterGateway
@@ -418,9 +431,22 @@ class LiveStack:
         from gpumounter_tpu.worker.main import start_health_server
 
         self.rig = rig
+        # ``grpc_mode="parking"`` = the production worker executor
+        # (worker/main.py TPU_GRPC_ASYNC default): grpc_workers becomes
+        # the ACTIVE-thread budget, slow waits park. The default stays
+        # the historical thread pool so existing rigs are byte-for-byte;
+        # ``grpc_workers=None`` / ``grpc_mode="settings"`` defer to the
+        # rig's Settings (the WorkerRig(grpc_workers=, grpc_async=)
+        # plumbing — exactly what worker/main.py reads from env).
+        if grpc_workers is None:
+            grpc_workers = rig.sim.settings.grpc_workers
+        if grpc_mode == "settings":
+            grpc_mode = ("parking" if rig.sim.settings.grpc_async
+                         else "threadpool")
         self.grpc_server, grpc_port = build_server(rig.service, port=0,
                                                    address="127.0.0.1",
-                                                   max_workers=grpc_workers)
+                                                   max_workers=grpc_workers,
+                                                   mode=grpc_mode)
         self.grpc_port = grpc_port
         self.grpc_server.start()
         # the worker's real health/metrics/tracez sidecar port, on an
@@ -455,7 +481,9 @@ class LiveStack:
             worker_tracez_base=lambda target:
                 f"http://127.0.0.1:{health_port}",
             broker=broker)
-        self.http_server = self.gateway.serve(port=0, address="127.0.0.1")
+        self.http_server = self.gateway.serve(
+            port=0, address="127.0.0.1", workers=gateway_workers,
+            max_conns=gateway_max_conns)
         self.base = f"http://127.0.0.1:{self.http_server.server_port}"
 
     def close(self) -> None:
@@ -493,7 +521,8 @@ class MultiMasterStack:
                  forward: str = "proxy",
                  renew_interval_s: float = 0.15,
                  lease_duration_s: float = 0.45,
-                 rigs: list[WorkerRig] | None = None):
+                 rigs: list[WorkerRig] | None = None,
+                 group_commit_s: float = 0.0):
         import dataclasses
 
         from gpumounter_tpu.master.admission import AttachBroker
@@ -538,7 +567,10 @@ class MultiMasterStack:
                 replica=f"master-{i}", forward=forward,
                 renew_interval_s=renew_interval_s,
                 lease_duration_s=lease_duration_s,
-                namespace=self.rig.sim.settings.pool_namespace)
+                namespace=self.rig.sim.settings.pool_namespace,
+                # 0 (default) = the PR 8 per-record CAS path; the
+                # group-commit bench/tests pass a real delay
+                group_commit_delay_s=group_commit_s)
             config = (dataclasses.replace(
                 broker_config, quotas=dict(broker_config.quotas))
                 if broker_config is not None else None)

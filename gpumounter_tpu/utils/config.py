@@ -190,6 +190,19 @@ class Settings:
     # Slice self-healing budget (master/slicetxn.py): repair txns one
     # group may consume before it is torn down as a unit instead.
     slice_repair_budget: int = consts.DEFAULT_SLICE_REPAIR_BUDGET
+    # The 10k admission path (utils/parking.py, master/waiterindex.py,
+    # master/store.py group commit). Plain Settings() keeps every
+    # historical default for direct-construction rigs (thread-pool gRPC
+    # server, per-record store CAS); from_env turns the parking executor
+    # and the store coalescer ON — TPU_GRPC_ASYNC=0 /
+    # TPU_STORE_GROUP_COMMIT=0 revert each byte-for-byte. The waiter
+    # index defaults ON everywhere (its selection is pinned equivalent
+    # to the linear scan); TPU_WAITER_INDEX=0 reverts it.
+    grpc_workers: int = consts.DEFAULT_GRPC_WORKERS
+    grpc_async: bool = False
+    grpc_max_parked: int = consts.DEFAULT_GRPC_MAX_PARKED
+    waiter_index: bool = True
+    store_group_commit_s: float = 0.0
     host: HostPaths = dataclasses.field(default_factory=HostPaths)
 
     @classmethod
@@ -299,6 +312,32 @@ class Settings:
                     f"window would yank in-flight actuation), got {t!r}")
         s.spot_termination_file = env.get(
             consts.ENV_SPOT_TERMINATION_FILE, "")
+        if t := env.get(consts.ENV_GRPC_WORKERS):
+            s.grpc_workers = int(t)
+            if s.grpc_workers < 1:
+                raise ValueError(
+                    f"{consts.ENV_GRPC_WORKERS} must be >= 1, got {t!r}")
+        s.grpc_async = env.get(consts.ENV_GRPC_ASYNC, "1") != "0"
+        if t := env.get(consts.ENV_GRPC_MAX_PARKED):
+            s.grpc_max_parked = int(t)
+        # validated as a PAIR regardless of which knob was set: a large
+        # TPU_GRPC_WORKERS alone must fail here with the env names, not
+        # later in ParkingExecutor with a generic message
+        if s.grpc_max_parked < s.grpc_workers:
+            raise ValueError(
+                f"{consts.ENV_GRPC_MAX_PARKED} ({s.grpc_max_parked}) "
+                f"must be >= {consts.ENV_GRPC_WORKERS} "
+                f"({s.grpc_workers})")
+        s.waiter_index = env.get(consts.ENV_WAITER_INDEX, "1") != "0"
+        raw_gc = env.get(consts.ENV_STORE_GROUP_COMMIT)
+        if raw_gc is None:
+            s.store_group_commit_s = consts.DEFAULT_STORE_GROUP_COMMIT_S
+        else:
+            s.store_group_commit_s = float(raw_gc)
+            if s.store_group_commit_s < 0:
+                raise ValueError(
+                    f"{consts.ENV_STORE_GROUP_COMMIT} must be >= 0 "
+                    f"seconds (0 = per-record CAS), got {raw_gc!r}")
         if t := env.get(consts.ENV_SLICE_REPAIR_BUDGET):
             s.slice_repair_budget = int(t)
             if s.slice_repair_budget < 0:

@@ -117,6 +117,38 @@ ENV_LEASE_TTL_S = "TPU_LEASE_TTL_S"
 ENV_QUEUE_TIMEOUT_S = "TPU_QUEUE_TIMEOUT_S"
 # Bound of each per-priority FIFO; a full queue answers 429 + Retry-After.
 ENV_QUEUE_DEPTH = "TPU_QUEUE_DEPTH"
+# Indexed waiter wakeup (master/waiterindex.py): "1" (default) keys the
+# broker's parked waiters by (node, chip-count, priority, tenant) so a
+# capacity signal examines only candidates the freed capacity could
+# satisfy; "0" reverts to the linear whole-queue rescan byte-for-byte.
+ENV_WAITER_INDEX = "TPU_WAITER_INDEX"
+
+# --- The 10k admission path (async worker + store group commit) ---------------
+# Active-thread budget of the worker's gRPC executor. Under the parking
+# executor (TPU_GRPC_ASYNC=1, the default) this bounds threads RUNNING
+# un-parked — in-flight RPCs parked in slow waits are not charged;
+# under the legacy thread-pool fallback it is the fixed pool size
+# (the historical hard-coded 8).
+ENV_GRPC_WORKERS = "TPU_GRPC_WORKERS"
+DEFAULT_GRPC_WORKERS = 8
+# "1" (production default): the parking executor serves the worker's
+# gRPC handlers — slow waits (slave-pod scheduling, informer fences,
+# kubelet lag, keyed locks) release their executor slot so thousands of
+# RPCs can be in flight over a small active budget. "0" reverts to the
+# fixed ThreadPoolExecutor byte-for-byte.
+ENV_GRPC_ASYNC = "TPU_GRPC_ASYNC"
+# Total thread ceiling of the parking executor (the in-flight RPC bound;
+# parked threads cost a stack each, not scheduler pressure).
+ENV_GRPC_MAX_PARKED = "TPU_GRPC_MAX_PARKED"
+DEFAULT_GRPC_MAX_PARKED = 4096
+# Intent-store group commit (master/store.py): bounded coalescing delay
+# in seconds before queued per-record mutations are fused into ONE
+# fenced CAS per shard (GPUOS-style operation fusion). "0" disables —
+# every mutation is its own CAS, the PR 8 per-record path byte-for-byte.
+ENV_STORE_GROUP_COMMIT = "TPU_STORE_GROUP_COMMIT"
+DEFAULT_STORE_GROUP_COMMIT_S = 0.01
+# Pending-mutation count that flushes the coalescer before the delay.
+STORE_GROUP_COMMIT_MAX_KEYS = 128
 
 # --- Kernel-enforced device gate (actuation/gate.py) --------------------------
 # "auto" (default): every device grant/revoke crosses the DeviceGate seam

@@ -506,6 +506,15 @@ class Registry:
             "tpumounter_gateway_rejected_total",
             "Connections refused by the gateway front's admission bound")
         self.gateway_rejected.inc(0.0)   # pre-seed: see orphans_reclaimed
+        # Parking executor (utils/parking.py): worker RPC handler threads
+        # currently parked in a slow wait (scheduling, informer fence,
+        # keyed lock) with their active slot released. High parked with
+        # low active = the async worker doing its job; high parked with
+        # the queue growing = the node is genuinely capacity-bound.
+        self.worker_rpc_parked = Gauge(
+            "tpumounter_worker_rpc_parked",
+            "Worker RPC handler threads parked in a slow wait (active "
+            "slot released back to the executor budget)")
         # Attach broker (master/admission.py): every admission verdict by
         # tenant and outcome (granted / over_quota / queue_full /
         # queue_timeout) — the per-tenant denial rate is the first thing a
@@ -531,6 +540,21 @@ class Registry:
             "tpumounter_queue_wait_seconds",
             "Time a contended attach spent queued in the broker before "
             "completing or timing out, by tenant")
+        # Indexed waiter wakeup (master/waiterindex.py): how many parked
+        # waiters each capacity signal had to examine before choosing.
+        # evaluations/signals is the bench's wakeup_evaluations_per_signal
+        # — with the index it scales with the signalling node's own
+        # candidates, not total parked waiters (the PR 6-era rescan).
+        self.wakeup_signals = Counter(
+            "tpumounter_wakeup_signals_total",
+            "Capacity signals that scanned the waiter queue for a "
+            "candidate to wake")
+        self.wakeup_signals.inc(0.0)     # pre-seed: see orphans_reclaimed
+        self.wakeup_evaluations = Counter(
+            "tpumounter_wakeup_evaluations_total",
+            "Parked waiters examined across all capacity signals (the "
+            "per-signal cost of choosing whom to wake)")
+        self.wakeup_evaluations.inc(0.0)
         self.preemptions = Counter(
             "tpumounter_preemptions_total",
             "Live attachments detached by the broker to make room for a "

@@ -230,10 +230,41 @@ def build_server(service: TPUMountService,
                  port: int = consts.WORKER_GRPC_PORT,
                  address: str = "[::]",
                  max_workers: int = 8,
-                 tls: TlsConfig | None = None) -> tuple[grpc.Server, int]:
-    """Returns (server, bound_port); port 0 picks a free port (tests)."""
-    server = grpc.server(
-        concurrent.futures.ThreadPoolExecutor(max_workers=max_workers))
+                 tls: TlsConfig | None = None,
+                 mode: str = "threadpool",
+                 max_parked: int = consts.DEFAULT_GRPC_MAX_PARKED
+                 ) -> tuple[grpc.Server, int]:
+    """Returns (server, bound_port); port 0 picks a free port (tests).
+
+    ``mode="threadpool"`` (default here; rigs and the TPU_GRPC_ASYNC=0
+    fallback) is the historical fixed pool: ``max_workers`` threads,
+    each occupied for its RPC's full wall time. ``mode="parking"`` (the
+    production default via worker/main.py) serves handlers from a
+    :class:`~gpumounter_tpu.utils.parking.ParkingExecutor`:
+    ``max_workers`` becomes the ACTIVE-thread budget and slow waits
+    release their slot, so in-flight RPCs are bounded by ``max_parked``
+    instead of the thread count — the 10k admission path's worker half.
+    """
+    if mode == "parking":
+        from gpumounter_tpu.utils.parking import ParkingExecutor
+        executor = ParkingExecutor(max_active=max_workers,
+                                   max_threads=max_parked)
+        # max_parked really IS the in-flight bound: gRPC refuses RPC
+        # number max_parked+1 with RESOURCE_EXHAUSTED (the gateway maps
+        # it to 429 + Retry-After through the PR 3 classifier) instead
+        # of queueing it unboundedly behind the thread ceiling
+        server = grpc.server(executor,
+                             maximum_concurrent_rpcs=max_parked)
+    elif mode == "threadpool":
+        executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_workers)
+        server = grpc.server(executor)
+    else:
+        raise ValueError(f"unknown gRPC server mode {mode!r}: "
+                         "want parking|threadpool")
+    # introspection handle for tests and /drainz-adjacent tooling; None
+    # under the legacy pool (so its absence IS the off-path pin)
+    server.parking_executor = (executor if mode == "parking" else None)
     handler = grpc.method_handlers_generic_handler(SERVICE_NAME, {
         "AddTPU": grpc.unary_unary_rpc_method_handler(
             _add_handler(service),

@@ -367,7 +367,19 @@ def main() -> None:
     if tls:
         logger.info("worker gRPC TLS enabled (mTLS=%s)",
                     bool(tls.ca_file))
-    server, port = build_server(service, settings.worker_grpc_port, tls=tls)
+    # The 10k admission path (utils/parking.py): the parking executor is
+    # the production default — TPU_GRPC_WORKERS bounds ACTIVE threads
+    # while parked waits ride free. TPU_GRPC_ASYNC=0 reverts to the
+    # fixed thread pool (where TPU_GRPC_WORKERS is simply its size —
+    # the formerly hard-coded 8, now deployable).
+    server, port = build_server(
+        service, settings.worker_grpc_port, tls=tls,
+        max_workers=settings.grpc_workers,
+        mode="parking" if settings.grpc_async else "threadpool",
+        max_parked=settings.grpc_max_parked)
+    logger.info("worker gRPC executor: %s (workers=%d)",
+                "parking" if settings.grpc_async else "threadpool",
+                settings.grpc_workers)
     # Graceful drain (worker/drain.py): SIGTERM (the DaemonSet's rolling
     # restart / node shutdown) begins the drain sequence — stop admitting
     # attaches, settle in-flight actuation, flush journal/events, report

@@ -105,14 +105,25 @@ class KeyedLocks:
 
     @contextlib.contextmanager
     def hold(self, key):
+        from gpumounter_tpu.utils.parking import parked
         with self._guard:
             entry = self._entries.get(key)
             if entry is None:
                 entry = self._entries[key] = [threading.Lock(), 0]
             entry[1] += 1
         try:
-            with entry[0]:
+            # The ACQUISITION is a parked wait (utils/parking.py): a
+            # thread blocked on a key another request holds must not
+            # charge the executor's active budget — the holder may
+            # itself be parked, and charging its waiters could consume
+            # every slot and deadlock the holder's un-park. No-op
+            # outside the parking executor.
+            with parked("keyed-lock"):
+                entry[0].acquire()
+            try:
                 yield
+            finally:
+                entry[0].release()
         finally:
             with self._guard:
                 entry[1] -= 1

@@ -43,3 +43,39 @@ def test_multimaster_config_scales_admission(monkeypatch):
     assert out["multimaster_store_write_rtt_s"] == \
         bench.MM_STORE_WRITE_RTT_S
     assert out["multimaster_clients"] == 12
+    # ISSUE 14 acceptance riding the same config: group commit must fuse
+    # the CAS stream below one op per admission (per-record pays ~2)
+    # WITHOUT moving the 2-vs-1 scaling bar asserted above.
+    assert out["store_cas_per_admission"] < 1.0
+    assert out["multimaster_cas_per_admission_per_record"] > \
+        out["store_cas_per_admission"]
+
+
+def test_sustained_config_parks_the_worker_at_scale():
+    """ISSUE 14 smoke at suite scale: the parking-mode sustained config
+    (the 2k bench shape, shrunk to 80 clients for suite time) completes
+    with zero errors over an 8-thread ACTIVE budget, and the executor
+    actually parked waits (in-flight > budget, structurally proven)."""
+    out = bench.measure_sustained(clients=80, grpc_mode="parking",
+                                  grpc_workers=8,
+                                  key="sustained_attach_smoke",
+                                  inflight_bar=40)
+    detail = out["sustained_attach_smoke"]
+    assert detail["errors"] == 0
+    assert detail["clients"] == 80
+    assert detail["worker_active_budget"] == 8
+    assert out["sustained_attach_smoke_rps"] > 0
+    # waits really routed through the parking seam (the hard overlap
+    # bound — parked >> budget — is pinned in test_worker_parking.py
+    # where the rig injects kubelet lag; this instantaneous-sim smoke
+    # only proves the production wiring parks at all)
+    assert detail["worker_peak_parked"] >= 1, detail
+
+
+def test_contention_config_reports_wakeup_economics():
+    """The indexed-wakeup keys ride the contention config: signals are
+    counted and the per-signal evaluation cost is a small constant-ish
+    figure (bucket fronts), not the parked-queue size."""
+    out = bench.measure_contention(cycles=1)
+    assert out["wakeup_signals"] > 0
+    assert 0 < out["wakeup_evaluations_per_signal"] < 20

@@ -560,11 +560,17 @@ def test_tick_routes_flush_dirty_fence_to_demotion(fake_host):
     broker.bind_ha(None, ShardRing(1), election)
 
     class _FencingStore:
+        def flush_pending(self):
+            return 0              # group-commit backstop: nothing queued
+
         def flush_dirty(self):
             raise StoreFencedError(0, 1, 7)
 
         def rehydrate(self, shard):
             return [], [], 0
+
+        def stop(self):
+            pass
 
     broker.store = _FencingStore()
     broker._rehydrated_shards.add(0)

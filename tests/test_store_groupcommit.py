@@ -1,0 +1,360 @@
+"""Group-committed intent-store writes (master/store.py coalescer):
+queued per-record mutations fuse into ONE fenced CAS per shard within a
+bounded delay — GPUOS-style operation fusion — while every durability
+rule PR 8 established keeps holding: last-writer-wins per key across
+the pending/dirty pair, decayed-leadership refusal (no unfenced write,
+ever), deposed-leader demotion, apiserver-outage degradation to the
+dirty queue, and the TPU_STORE_GROUP_COMMIT=0 off-path byte-for-byte
+per-record CAS."""
+
+import time
+
+import pytest
+
+from gpumounter_tpu.k8s.client import FakeKubeClient
+from gpumounter_tpu.master.shardring import HAConfig, ShardRing
+from gpumounter_tpu.master.store import IntentStore
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.config import Settings
+from gpumounter_tpu.utils.errors import K8sApiError
+
+from tests.test_store import NS, lease_record, waiter_record
+
+
+def make_store(kube=None, shards=1, election=None, delay=60.0,
+               max_keys=consts.STORE_GROUP_COMMIT_MAX_KEYS):
+    """delay=60 parks the coalescer thread out of the way so tests
+    drive flush_pending() deterministically; the timing test builds its
+    own short-delay store."""
+    kube = kube or FakeKubeClient()
+    return kube, IntentStore(kube, ShardRing(shards), NS,
+                             election=election,
+                             group_commit_delay_s=delay,
+                             group_commit_max_keys=max_keys)
+
+
+def test_coalesced_mutations_land_as_one_cas_per_shard():
+    kube, store = make_store()
+    try:
+        before = kube.cm_calls
+        store.put_lease(lease_record())
+        store.put_lease(lease_record(pod="workload-2"))
+        store.put_waiter(waiter_record())
+        store.put_waiter(waiter_record(rid="w-rid-2", pod="c2"))
+        assert kube.cm_calls == before          # nothing touched yet
+        landed = store.flush_pending()
+        assert landed == 4
+        # one CAS: the create round-trip (no prior GET — the map did
+        # not exist, observe answers from the 404 path, then ONE POST)
+        assert kube.cm_calls - before <= 2
+        leases, waiters, torn = store.rehydrate(0)
+        assert torn == 0
+        assert sorted(le.pod for le in leases) == \
+            ["workload", "workload-2"]
+        assert sorted(w.rid for w in waiters) == ["w-rid-1", "w-rid-2"]
+        # byte-identical round trip, exactly the per-record guarantee
+        assert [le for le in leases if le.pod == "workload"][0] == \
+            lease_record()
+    finally:
+        store.stop()
+
+
+def test_last_writer_wins_per_key_within_a_batch():
+    kube, store = make_store()
+    try:
+        record = waiter_record()
+        store.put_waiter(record)
+        store.delete_waiter(record.namespace, record.rid)
+        store.put_lease(lease_record())
+        store.flush_pending()
+        leases, waiters, _ = store.rehydrate(0)
+        assert waiters == []      # the delete superseded the put
+        assert len(leases) == 1
+    finally:
+        store.stop()
+
+
+def test_bounded_delay_flushes_without_being_driven():
+    kube, store = make_store(delay=0.02)
+    try:
+        store.put_lease(lease_record())
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            try:
+                leases, _, _ = store.rehydrate(0)
+            except K8sApiError:
+                leases = []
+            if leases:
+                break
+            time.sleep(0.005)
+        assert leases, "coalescer never flushed within the bounded delay"
+    finally:
+        store.stop()
+
+
+def test_size_threshold_flushes_before_the_delay():
+    kube, store = make_store(delay=30.0, max_keys=3)
+    try:
+        for i in range(3):
+            store.put_lease(lease_record(pod=f"w{i}"))
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            try:
+                if len(store.rehydrate(0)[0]) == 3:
+                    break
+            except K8sApiError:
+                pass
+            time.sleep(0.005)
+        assert len(store.rehydrate(0)[0]) == 3, \
+            "size threshold did not trigger an early flush"
+    finally:
+        store.stop()
+
+
+def test_off_path_is_the_per_record_cas_byte_for_byte():
+    """TPU_STORE_GROUP_COMMIT=0 (delay 0): every mutation is its own
+    synchronous CAS, no coalescer thread, no pending state, and the
+    snapshot payload carries no group_commit key — PR 8 exactly."""
+    kube = FakeKubeClient()
+    store = IntentStore(kube, ShardRing(1), NS)       # defaults: off
+    assert store._flusher is None
+    before = kube.cm_calls
+    store.put_waiter(waiter_record())
+    assert kube.cm_calls > before                     # landed inline
+    assert store._pending == {}
+    assert "group_commit" not in store.snapshot()
+    _, waiters, _ = store.rehydrate(0)
+    assert len(waiters) == 1
+
+
+def test_apiserver_outage_parks_batch_dirty_and_replay_converges():
+    """The crash half of the acceptance: the coalescer dies mid-flush
+    (every patch/create bounces off a dead apiserver) → the whole batch
+    parks in the dirty queue, lag shows, and the broker-tick replay
+    (flush_dirty) lands the records byte-identically once the apiserver
+    heals. No torn records either way — each CAS is one atomic
+    annotation merge."""
+    kube, store = make_store()
+    try:
+        real_create = kube.create_config_map
+        real_patch = kube.patch_config_map
+
+        def down(*a, **k):
+            raise K8sApiError(503, "apiserver down", cause="refused")
+
+        kube.create_config_map = down
+        kube.patch_config_map = down
+        store.put_lease(lease_record())
+        store.put_waiter(waiter_record())
+        assert store.flush_pending() == 0
+        assert store.snapshot()["dirty"] == 2
+        assert store.lag_s() > 0
+        # still down: the dirty replay defers, nothing is lost
+        assert store.flush_dirty() == 0
+        kube.create_config_map = real_create
+        kube.patch_config_map = real_patch
+        assert store.flush_dirty() == 2
+        leases, waiters, torn = store.rehydrate(0)
+        assert torn == 0
+        assert leases == [lease_record()]
+        assert [w.rid for w in waiters] == ["w-rid-1"]
+        assert store.snapshot()["dirty"] == 0
+    finally:
+        store.stop()
+
+
+def test_pending_supersedes_dirty_for_the_same_key():
+    """Last-writer-wins ACROSS the two queues: a key parked dirty by an
+    outage must not replay over the newer value queued in the
+    coalescer — enqueueing purges the stale dirty entry."""
+    kube, store = make_store()
+    try:
+        def down(*a, **k):
+            raise K8sApiError(503, "down", cause="refused")
+        real_create = kube.create_config_map
+        kube.create_config_map = down
+        kube.patch_config_map = down
+        store.put_lease(lease_record(chips=1, uuids=["0"]))
+        store.flush_pending()                  # parks the stale value
+        assert store.snapshot()["dirty"] == 1
+        kube.create_config_map = real_create
+        store.put_lease(lease_record(chips=3, uuids=["0", "2", "7"]))
+        assert store.snapshot()["dirty"] == 0  # purged by the enqueue
+        store.flush_pending()
+        assert store.flush_dirty() == 0        # nothing stale to replay
+        leases, _, _ = store.rehydrate(0)
+        assert leases[0].chips == 3
+    finally:
+        store.stop()
+
+
+class _Election:
+    """Minimal election surface the store consults: enabled + token,
+    plus the leaders()/replica pair flush_dirty's hand-off check reads."""
+
+    def __init__(self, token):
+        self.enabled = True
+        self.replica = "m-0"
+        self._token = token
+
+    def token(self, shard):
+        return self._token
+
+    def leaders(self):
+        return {}
+
+
+def test_decayed_leadership_parks_instead_of_writing_unfenced():
+    """The PR 8 refusal rule survives fusion: no live token → the fused
+    batch must NOT land (it would be unfenced — the split-brain hole);
+    it parks and the resumed leadership replays it."""
+    kube = FakeKubeClient()
+    election = _Election(token=None)
+    store = IntentStore(kube, ShardRing(1), NS, election=election,
+                        group_commit_delay_s=60.0)
+    try:
+        before = kube.cm_calls
+        store.put_waiter(waiter_record())
+        store.flush_pending()
+        assert kube.cm_calls == before          # zero configmap traffic
+        assert store.snapshot()["dirty"] == 1
+        election._token = 3                     # leadership resumed
+        assert store.flush_dirty() == 1
+        _, waiters, _ = store.rehydrate(0)
+        assert len(waiters) == 1
+        annotations = kube.get_config_map(
+            NS, store.cm_name(0))["metadata"]["annotations"]
+        assert annotations[consts.STORE_FENCE_ANNOTATION] == "3"
+    finally:
+        store.stop()
+
+
+def test_deposed_batch_parks_and_fires_on_fenced():
+    """A fused batch bouncing off a HIGHER fence = this replica was
+    deposed: the coalescer surfaces it through on_fenced (the broker
+    demotes) instead of raising on its own thread, and the batch parks
+    for the hand-off logic to discard."""
+    kube = FakeKubeClient()
+    election = _Election(token=2)
+    store = IntentStore(kube, ShardRing(1), NS, election=election,
+                        group_commit_delay_s=60.0)
+    try:
+        # a peer already wrote fence 7
+        kube.create_config_map(NS, {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": store.cm_name(0),
+                         "annotations": {
+                             consts.STORE_FENCE_ANNOTATION: "7"}}})
+        fences = []
+        store.on_fenced = fences.append
+        store.put_waiter(waiter_record())
+        store.flush_pending()
+        assert len(fences) == 1
+        assert fences[0].shard == 0 and fences[0].fence == 7
+        assert store.snapshot()["dirty"] == 1   # parked, not lost
+    finally:
+        store.stop()
+
+
+def test_broker_tick_is_the_flush_backstop(fake_host):
+    """A dead coalescer thread degrades durability to tick cadence, not
+    to never: stop the flusher, mutate, and a broker tick lands the
+    pending batch (flush_pending is the tick's first store step)."""
+    from gpumounter_tpu.master.admission import AttachBroker, BrokerConfig
+    from gpumounter_tpu.master.election import NullElection
+    kube = FakeKubeClient()
+    ring = ShardRing(1)
+    store = IntentStore(kube, ring, NS, group_commit_delay_s=60.0)
+    broker = AttachBroker(kube, BrokerConfig())
+    broker.bind_ha(store, ring, None)
+    store.stop()                       # the "flusher died" half
+    broker.leases.record("default", "workload", "teamA", "normal",
+                         ["0", "1"], node="node-a", rid="r1")
+    assert store._pending               # queued, nobody to flush it
+    broker.tick()
+    leases, _, _ = store.rehydrate(0)
+    assert [le.pod for le in leases] == ["workload"]
+    assert store.on_fenced == broker._on_fenced
+
+
+def test_group_commit_knob_plumbs_from_env():
+    assert Settings().store_group_commit_s == 0.0
+    assert Settings.from_env({}).store_group_commit_s == \
+        consts.DEFAULT_STORE_GROUP_COMMIT_S
+    assert Settings.from_env(
+        {"TPU_STORE_GROUP_COMMIT": "0"}).store_group_commit_s == 0.0
+    assert Settings.from_env(
+        {"TPU_STORE_GROUP_COMMIT": "0.02"}).store_group_commit_s == 0.02
+    with pytest.raises(ValueError):
+        Settings.from_env({"TPU_STORE_GROUP_COMMIT": "-1"})
+    assert HAConfig().group_commit_delay_s == 0.0
+    ha = HAConfig.from_settings(Settings.from_env({}))
+    assert ha.group_commit_delay_s == consts.DEFAULT_STORE_GROUP_COMMIT_S
+
+
+def test_coalesced_stack_holds_broker_invariants_across_outage(fake_host):
+    """Acceptance: a full master stack running group commit takes an
+    apiserver outage mid-stream (the coalescer's flush dies), keeps
+    admitting, and after the heal the dirty replay converges — cluster
+    ground truth, lease table and store agree
+    (assert_broker_invariants(store=))."""
+    from gpumounter_tpu.master.admission import BrokerConfig
+    from gpumounter_tpu.testing.chaos import assert_broker_invariants
+    from gpumounter_tpu.testing.sim import MultiMasterStack, WorkerRig
+    import http.client
+    import json
+
+    rig = WorkerRig(fake_host, n_chips=4, informer=False)
+    stack = MultiMasterStack(rig, masters=1, shards=1,
+                             broker_config=BrokerConfig(),
+                             store=True, election=True,
+                             group_commit_s=0.005)
+    try:
+        stack.wait_converged()
+        base = stack.bases[0]
+        host, _, port = base.rpartition("//")[2].rpartition(":")
+
+        def req(method, path):
+            conn = http.client.HTTPConnection(host, int(port), timeout=30)
+            conn.request(method, path, body=b"")
+            body = json.loads(conn.getresponse().read())
+            conn.close()
+            return body
+
+        pod2 = rig.sim.add_target_pod(name="workload-b", uid="uid-b")
+        rig.provision_container(pod2)
+        assert req("GET", "/addtpu/namespace/default/pod/workload"
+                   "/tpu/2/isEntireMount/false")["result"] == "SUCCESS"
+        kube = stack.kube
+        real_patch = kube.patch_config_map
+        real_create = kube.create_config_map
+
+        def down(*a, **k):
+            raise K8sApiError(503, "apiserver down", cause="refused")
+
+        store = stack.gateways[0].broker.store
+        kube.patch_config_map = down
+        kube.create_config_map = down
+        # admission keeps flowing THROUGH the outage (durability
+        # degrades, availability does not — the PR 8 contract)
+        assert req("GET", "/addtpu/namespace/default/pod/workload-b"
+                   "/tpu/2/isEntireMount/false")["result"] == "SUCCESS"
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and \
+                store.snapshot()["dirty"] == 0:
+            store.flush_pending()
+            time.sleep(0.01)
+        assert store.snapshot()["dirty"] > 0
+        kube.patch_config_map = real_patch
+        kube.create_config_map = real_create
+        store.flush_pending()
+        stack.gateways[0].broker.tick()         # dirty replay
+        assert store.snapshot()["dirty"] == 0
+        assert_broker_invariants(stack.gateways[0].broker, rig.sim,
+                                 store=store)
+        leases, _, torn = store.rehydrate(0)
+        assert torn == 0
+        assert sorted(le.pod for le in leases) == \
+            ["workload", "workload-b"]
+    finally:
+        stack.close()

@@ -115,14 +115,16 @@ def test_waiter_park_and_resolve_sites_persist_intent():
         "_run_adopted can leave a resolved intent record behind"
     # parking happens in exactly two places: the single-attach queue
     # path (persisted as a waiter record above) and the gang path, whose
-    # durable intent is the slice TXN record — pinned below
+    # durable intent is the slice TXN record — pinned below. (The queue
+    # became a WaiterQueue in the 10k-admission PR; ``_waiters.add`` is
+    # the one enqueue verb.)
     appenders = {
         qual.split(".", 1)[0] + "." + qual.split(".")[1]
         for qual, funcdef in funcs.items()
         if qual.startswith("AttachBroker.")
         and any(isinstance(n, ast.Call)
                 and isinstance(n.func, ast.Attribute)
-                and n.func.attr == "append"
+                and n.func.attr == "add"
                 and isinstance(n.func.value, ast.Attribute)
                 and n.func.value.attr == "_waiters"
                 for n in ast.walk(funcdef))}
@@ -182,6 +184,61 @@ def test_store_cas_is_one_seam_with_the_fence_check_inside():
         "_cas no longer enforces the fencing token"
     # and the public write path reaches it
     assert "_cas" in _names_used(_functions(store)["IntentStore._write"])
+
+
+def test_record_mutations_route_through_the_coalescer_seam():
+    """The 10k-admission group-commit contract: NO request-thread code
+    path issues a per-record CAS. Every record mutation crosses
+    ``IntentStore._mutate`` (the coalescer seam); ``_write`` — the
+    per-record CAS — is reachable only from that seam (the sanctioned
+    TPU_STORE_GROUP_COMMIT=0 off-path) and the dirty replay; and
+    ``_cas`` itself has exactly four sanctioned-with-reason callers:
+      _write        — the per-record off-path + dirty replay
+      put_leases    — already one CAS per shard by construction
+      poke_peers    — the fence-exempt capacity stamp (no record state)
+      flush_pending — the group-commit flush (ONE fused CAS per shard)
+    A new direct caller is a new serialization point on the per-shard
+    CAS stream and fails here instead of shipping."""
+    funcs = _functions(store)
+    for qual in ("IntentStore.put_lease", "IntentStore.delete_lease",
+                 "IntentStore.put_waiter", "IntentStore.delete_waiter",
+                 "IntentStore.put_slice_txn",
+                 "IntentStore.delete_slice_txn"):
+        names = _names_used(funcs[qual])
+        assert "_mutate" in names, \
+            f"{qual} mutates a record without the coalescer seam"
+        assert not ({"_cas", "_write"} & names), \
+            f"{qual} bypasses the coalescer seam with a direct CAS"
+    # _put_leases_locked's _write is its DEGRADATION path only: a
+    # failed batch falls back to per-record writes so each record gets
+    # its own dirty-parking — not a hot-path caller. (put_leases runs
+    # its CAS under _flush_mutex so an in-flight coalescer flush can
+    # never land a stale batch over the fresh sync.)
+    assert _referencing_functions(store, "_write") == \
+        {"IntentStore._mutate", "IntentStore.flush_dirty",
+         "IntentStore._put_leases_locked"}
+    assert _referencing_functions(store, "_cas") == {
+        "IntentStore._write", "IntentStore._put_leases_locked",
+        "IntentStore.poke_peers", "IntentStore.flush_pending"}
+    assert "_flush_mutex" in _names_used(
+        _functions(store)["IntentStore.put_leases"]), \
+        "put_leases lost its serialization against the coalescer flush"
+
+
+def test_group_commit_flush_keeps_the_durability_rules():
+    """The fused flush must keep the per-record disciplines: park on
+    no-live-token AND on apiserver failure (the dirty queue), surface a
+    real fence through on_fenced (demotion) — and the broker tick
+    drives flush_pending as the backstop before the dirty replay."""
+    flush = _functions(store)["IntentStore.flush_pending"]
+    names = _names_used(flush)
+    assert "_park" in names, \
+        "a refused fused batch must park dirty, not vanish"
+    assert "on_fenced" in names and "StoreFencedError" in names, \
+        "flush_pending no longer surfaces fences for demotion"
+    tick = _functions(admission)["AttachBroker.tick"]
+    assert "flush_pending" in _names_used(tick), \
+        "the broker tick lost the group-commit flush backstop"
 
 
 def test_election_lock_writes_carry_the_full_annotation_set():

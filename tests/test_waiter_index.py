@@ -1,0 +1,239 @@
+"""Indexed waiter wakeup (master/waiterindex.py): the selection order is
+the broker's fairness CONTRACT, so the index must be provably the same
+scheduler as the linear scan it replaces — pinned here by a randomized
+equivalence drive (1k park/wake/timeout/preempt interleavings against a
+brute-force reference), plus the perf property the index exists for:
+a capacity signal's evaluation cost scales with the signalling node's
+own candidates, not total parked waiters."""
+
+import random
+import threading
+
+from gpumounter_tpu.master.waiterindex import WaiterQueue, _rank
+from gpumounter_tpu.utils import consts
+
+
+class W:
+    """The selection-relevant surface of admission._Waiter."""
+
+    _counter = [0]
+
+    def __init__(self, tenant="t0", priority="normal", chips=1,
+                 node="node-a", gang=False):
+        self.tenant = tenant
+        self.priority = priority
+        self.chips = chips
+        self.node = "" if gang else node
+        self.gang = gang
+        W._counter[0] += 1
+        self.enqueued_at = float(W._counter[0])
+        self.tried_gen = 0
+        self.event = threading.Event()
+
+    def __repr__(self):
+        return (f"W({self.tenant},{self.priority},c{self.chips},"
+                f"{self.node or 'gang'},@{self.enqueued_at})")
+
+
+def reference_select(ordered, gen, node=None, chips=0, usage=None,
+                     quotas=None):
+    """Brute force over the enqueue-ordered list — the spec the index
+    must match: generation/event eligibility, node locality (node-less
+    waiters always eligible), strict priority, chip-coverage preference
+    WITHIN the winning priority, then smallest fair share, then
+    earliest enqueue."""
+    usage = usage or {}
+    quotas = quotas or {}
+    cands = [w for w in ordered
+             if w.tried_gen < gen and not w.event.is_set()]
+    if node is not None:
+        cands = [w for w in cands if not w.node or w.node == node]
+    if not cands:
+        return None
+    top = max(_rank(w.priority) for w in cands)
+    cands = [w for w in cands if _rank(w.priority) == top]
+    if chips > 0:
+        covered = [w for w in cands if w.chips <= chips]
+        if covered:
+            cands = covered
+
+    def share(w):
+        return usage.get(w.tenant, 0) / (quotas.get(w.tenant) or 1e9)
+
+    return min(cands, key=lambda w: (share(w), ordered.index(w)))
+
+
+TENANTS = ("teamA", "teamB", "teamC", "hog")
+NODES = ("node-a", "node-b", "node-c")
+
+
+def test_randomized_equivalence_1k_interleavings():
+    """The acceptance pin: across 1k randomized park / wake / timeout /
+    preempt interleavings, the index and the brute-force list scan pick
+    the SAME waiter for every signal — including node/chips-hinted
+    signals, and (hint-less) the legacy linear path too."""
+    rng = random.Random(0xA11CE)
+    indexed = WaiterQueue(indexed=True)
+    linear = WaiterQueue(indexed=False)
+    ordered: list[W] = []
+    usage = {t: 0 for t in TENANTS}
+    quotas = {"teamA": 8, "teamB": 4, "teamC": 2}   # hog unlimited
+    gen = 0
+    selects = 0
+    for step in range(1000):
+        op = rng.random()
+        if op < 0.45 or not ordered:
+            # park (sometimes a node-less gang)
+            w = W(tenant=rng.choice(TENANTS),
+                  priority=rng.choice(consts.PRIORITIES),
+                  chips=rng.randint(1, 8),
+                  node=rng.choice(NODES),
+                  gang=rng.random() < 0.1)
+            w.tried_gen = gen        # parks at the current generation
+            ordered.append(w)
+            indexed.add(w)
+            linear.add(w)
+        elif op < 0.60:
+            # timeout / grant / preempted departure
+            w = rng.choice(ordered)
+            ordered.remove(w)
+            indexed.remove(w)
+            linear.remove(w)
+        elif op < 0.70:
+            # a woken waiter retried and failed: consumes its wake
+            woken = [w for w in ordered if w.event.is_set()]
+            if woken:
+                rng.choice(woken).event.clear()
+        elif op < 0.80:
+            # lease churn moves the fair-share landscape
+            usage[rng.choice(TENANTS)] = rng.randint(0, 10)
+        else:
+            # capacity signal, randomly hinted
+            gen += 1
+            node = rng.choice((None,) + NODES)
+            chips = rng.choice((0, 0, 1, 2, 4, 8))
+            expect = reference_select(ordered, gen, node=node,
+                                      chips=chips, usage=usage,
+                                      quotas=quotas)
+            got, _ = indexed.select(gen, node=node, chips=chips,
+                                    usage_fn=lambda: dict(usage),
+                                    quota_fn=quotas.get)
+            assert got is expect, \
+                (f"step {step}: index chose {got}, reference chose "
+                 f"{expect} (gen={gen} node={node} chips={chips})")
+            if node is None and chips == 0:
+                lin, _ = linear.select(gen,
+                                       usage_fn=lambda: dict(usage),
+                                       quota_fn=quotas.get)
+                assert lin is expect, \
+                    f"step {step}: linear path diverged: {lin}"
+            if got is not None:
+                got.tried_gen = gen
+                got.event.set()
+            selects += 1
+    assert selects > 100                     # the drive actually drove
+
+
+def test_evaluations_scale_with_node_candidates_not_total():
+    """The perf pin: 1000 waiters parked on node-b must not be examined
+    by a node-a signal — the index touches node-a's candidates (plus
+    node-less gangs), the linear scan pays the whole queue."""
+    indexed = WaiterQueue(indexed=True)
+    linear = WaiterQueue(indexed=False)
+    for i in range(1000):
+        w = W(tenant=TENANTS[i % 3], node="node-b", chips=1 + i % 4)
+        indexed.add(w)
+        linear.add(w)
+    locals_ = [W(tenant=TENANTS[i % 2], node="node-a") for i in range(5)]
+    gang = W(tenant="teamC", gang=True)
+    for w in (*locals_, gang):
+        indexed.add(w)
+        linear.add(w)
+    chosen, evaluated = indexed.select(1, node="node-a", chips=1)
+    assert chosen in (*locals_, gang)
+    # bucket fronts only: a handful of examinations, not the 1006-scan
+    assert evaluated <= 3 * len(TENANTS) * len(consts.PRIORITIES), \
+        f"indexed signal examined {evaluated} waiters"
+    _, linear_cost = linear.select(1)
+    assert linear_cost == 1006      # what the rescan used to pay
+
+
+def test_membership_surface_matches_the_list_it_replaced():
+    q = WaiterQueue()
+    a, b = W(priority="high"), W(priority="low", gang=True)
+    q.add(a)
+    q.add(b)
+    assert list(q) == [a, b] and len(q) == 2 and a in q
+    assert q == [a, b] and not (q == [b, a])
+    assert q.count("high") == 1 and q.count("low") == 1
+    assert q.gang_count() == 1
+    assert q.oldest_enqueued_at() == a.enqueued_at
+    q.remove(a)
+    q.remove(a)                     # tolerant, like the guarded remove
+    assert q == [b] and q.count("high") == 0
+    q.remove(b)
+    assert q == [] and q.oldest_enqueued_at() is None \
+        and q.gang_count() == 0
+
+
+def test_generation_and_event_filters_hold():
+    """A waiter that was already woken this generation (tried_gen) or
+    holds an unconsumed wake (event set) is not a candidate — the baton
+    discipline the broker's wakeup chain is built on."""
+    q = WaiterQueue()
+    first, second = W(tenant="teamA"), W(tenant="teamB")
+    q.add(first)
+    q.add(second)
+    got, _ = q.select(1)
+    assert got is first             # equal shares -> earliest enqueue
+    got.tried_gen = 1
+    got.event.set()
+    got, _ = q.select(1)
+    assert got is second            # first is no longer eligible
+    second.tried_gen = 1
+    second.event.set()
+    got, _ = q.select(1)
+    assert got is None
+    first.event.clear()
+    second.event.clear()
+    got, _ = q.select(2)            # new generation re-arms both
+    assert got is first
+
+
+def test_chip_coverage_preference_never_inverts_priority():
+    """2 freed chips prefer a 2-chip candidate over an 8-chip one —
+    but only WITHIN a priority: a high 8-chip waiter still beats a
+    normal 2-chip waiter (it may preempt its way to the rest)."""
+    q = WaiterQueue()
+    big_high = W(priority="high", chips=8, node="node-a")
+    small_normal = W(priority="normal", chips=2, node="node-a")
+    q.add(big_high)
+    q.add(small_normal)
+    got, _ = q.select(1, node="node-a", chips=2)
+    assert got is big_high
+    q2 = WaiterQueue()
+    big = W(priority="normal", chips=8, node="node-a")
+    small = W(priority="normal", chips=2, node="node-a")
+    q2.add(big)
+    q2.add(small)
+    got, _ = q2.select(1, node="node-a", chips=2)
+    assert got is small             # coverage preference within the tier
+    got, _ = q2.select(1, node="node-a", chips=1)
+    assert got is big               # nothing covered: earliest enqueue
+
+
+def test_waiter_index_knob_plumbs_from_env():
+    from gpumounter_tpu.master.admission import BrokerConfig
+    from gpumounter_tpu.utils.config import Settings
+    assert Settings().waiter_index is True
+    assert Settings.from_env({}).waiter_index is True
+    assert Settings.from_env({"TPU_WAITER_INDEX": "0"}).waiter_index \
+        is False
+    assert BrokerConfig().waiter_index is True
+    off = BrokerConfig.from_settings(
+        Settings.from_env({"TPU_WAITER_INDEX": "0"}))
+    assert off.waiter_index is False
+    from gpumounter_tpu.master.admission import AttachBroker
+    from gpumounter_tpu.k8s.client import FakeKubeClient
+    broker = AttachBroker(FakeKubeClient(), off)
+    assert broker._waiters.indexed is False

@@ -1,0 +1,267 @@
+"""Async-capable worker service (utils/parking.py + grpc_server mode):
+the parking executor carries many in-flight RPCs over a small ACTIVE
+budget — slow waits (slave-pod scheduling, informer fences, kubelet
+lag, keyed locks) release their slot — while the service semantics the
+restructure must preserve (drain's in-flight tokens, per-rid
+idempotency, per-pod serialisation) keep holding. The thread-pool path
+stays the byte-for-byte default-off fallback."""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.config import Settings
+from gpumounter_tpu.utils.parking import ParkingExecutor, parked
+
+
+# -- executor unit -------------------------------------------------------------
+
+def test_active_budget_bounds_running_threads():
+    ex = ParkingExecutor(max_active=2)
+    running = []
+    lock = threading.Lock()
+    peak = [0]
+
+    def task():
+        with lock:
+            running.append(1)
+            peak[0] = max(peak[0], len(running))
+        time.sleep(0.05)
+        with lock:
+            running.pop()
+
+    futures = [ex.submit(task) for _ in range(8)]
+    for f in futures:
+        f.result(timeout=10)
+    assert peak[0] <= 2
+    assert ex.status()["peak_active"] <= 2
+    ex.shutdown()
+
+
+def test_parked_waits_release_their_slot():
+    """The point of the whole mechanism: 16 RPC-shaped tasks all parked
+    in a wait at once over an active budget of 2 — in-flight capacity
+    decoupled from the thread budget."""
+    ex = ParkingExecutor(max_active=2)
+    release = threading.Event()
+
+    def task():
+        with parked("test-wait"):
+            release.wait(timeout=30)
+        return "done"
+
+    futures = [ex.submit(task) for _ in range(16)]
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and ex.status()["parked"] < 16:
+        time.sleep(0.005)
+    status = ex.status()
+    assert status["parked"] == 16, status   # 16 in flight, budget 2
+    assert status["active"] == 0
+    release.set()
+    assert [f.result(timeout=10) for f in futures] == ["done"] * 16
+    assert ex.status()["peak_parked"] == 16
+    ex.shutdown()
+
+
+def test_parked_is_reentrant_and_noop_off_executor():
+    # off-executor: plain passthrough (the legacy-server byte-for-byte
+    # guarantee — every instrumented wait site runs this path there)
+    with parked("outer"):
+        with parked("inner"):
+            pass
+    ex = ParkingExecutor(max_active=1)
+    depths = {}
+
+    def task():
+        with parked("outer"):
+            depths["outer"] = ex.status()["parked"]
+            with parked("inner"):
+                depths["inner"] = ex.status()["parked"]
+        return True
+
+    assert ex.submit(task).result(timeout=10)
+    assert depths == {"outer": 1, "inner": 1}   # released exactly once
+    ex.shutdown()
+
+
+def test_unpark_reacquires_within_the_budget():
+    """A thread leaving its wait queues for a slot like anyone else —
+    the budget holds through the park/unpark cycle."""
+    ex = ParkingExecutor(max_active=1)
+    gate = threading.Event()
+    order = []
+
+    def parker():
+        with parked("w"):
+            gate.wait(timeout=30)
+        order.append("parker-resumed")
+
+    def runner():
+        order.append("runner-ran")
+        gate.set()
+        time.sleep(0.05)        # holds the ONE slot while gate is set
+
+    f1 = ex.submit(parker)
+    while ex.status()["parked"] < 1:
+        time.sleep(0.005)
+    f2 = ex.submit(runner)      # takes the slot the parker released
+    f1.result(timeout=10)
+    f2.result(timeout=10)
+    assert order == ["runner-ran", "parker-resumed"]
+    ex.shutdown()
+
+
+# -- the worker service over the parking server --------------------------------
+
+@pytest.fixture
+def parking_stack(fake_host):
+    """A live gRPC worker in parking mode with an ACTIVE budget of 2
+    over a sim whose kubelet lags device assignment — the wait the
+    allocator parks through."""
+    from gpumounter_tpu.testing.sim import WorkerRig
+    from gpumounter_tpu.worker.grpc_server import WorkerClient, build_server
+    rig = WorkerRig(fake_host, n_chips=8, kubelet_lag_s=0.6,
+                    informer=True)
+    server, port = build_server(rig.service, port=0, address="127.0.0.1",
+                                max_workers=2, mode="parking")
+    server.start()
+    client = WorkerClient(f"127.0.0.1:{port}", timeout_s=60)
+    try:
+        yield rig, server, client, port
+    finally:
+        client.close()
+        server.stop(grace=0)
+        rig.close()
+
+
+def test_concurrent_slow_attaches_overlap_beyond_the_budget(
+        parking_stack):
+    """6 attaches whose kubelet lag dominates, budget 2: under the old
+    fixed pool they would run 2 at a time (>= 3 lag windows); parking
+    overlaps them all. Pinned structurally (peak_parked) AND by wall
+    clock staying under the serialized bound."""
+    rig, server, _, port = parking_stack
+    pods = []
+    for i in range(6):
+        pod = rig.sim.add_target_pod(name=f"load-{i}", uid=f"uid-l{i}")
+        rig.provision_container(pod)
+        pods.append(f"load-{i}")
+    results = {}
+
+    def one(pod):
+        from gpumounter_tpu.worker.grpc_server import WorkerClient
+        with WorkerClient(f"127.0.0.1:{port}", timeout_s=60) as c:
+            results[pod] = c.add_tpu(pod, "default", 1, False,
+                                     request_id=f"rid-{pod}")
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=one, args=(p,)) for p in pods]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    wall = time.monotonic() - t0
+    assert len(results) == 6
+    for pod, resp in results.items():
+        assert consts.AddResult(resp.result) == consts.AddResult.SUCCESS, \
+            (pod, resp)
+    # serialized bound: ceil(6/2) lag windows = 1.8s; overlapped runs
+    # pay ~one window + overhead
+    assert wall < 1.7, f"parking attaches serialized: {wall:.2f}s"
+    assert server.parking_executor.peak_parked >= 3, \
+        server.parking_executor.status()
+
+
+def test_drain_tokens_survive_the_parking_restructure(parking_stack):
+    """The drain gate still runs on the handler path: a draining worker
+    refuses NEW attaches with the draining: detail through the parking
+    server exactly like the thread-pool one."""
+    from gpumounter_tpu.worker.drain import DrainController
+    rig, _, client, _port = parking_stack
+    drain = DrainController(rig.sim.node)
+    rig.service.drain = drain
+    drain.begin("test")
+    with pytest.raises(grpc.RpcError) as err:
+        client.add_tpu("workload", "default", 1, False,
+                       request_id="rid-drained")
+    assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+    assert err.value.details().startswith(consts.DRAINING_DETAIL_PREFIX)
+    assert drain.status()["refused"] == 1
+    assert drain.status()["inflight"] == 0      # token released
+
+
+def test_per_rid_idempotency_survives_the_parking_restructure(
+        parking_stack):
+    """Two concurrent attaches under ONE request id serialize on the
+    request lock (a parked wait, budget-exempt) and resolve to the SAME
+    grant — zero double-actuation, the retry contract the gateway
+    relies on."""
+    rig, server, _, port = parking_stack
+    results = []
+
+    def one():
+        from gpumounter_tpu.worker.grpc_server import WorkerClient
+        with WorkerClient(f"127.0.0.1:{port}", timeout_s=60) as c:
+            results.append(c.add_tpu("workload", "default", 2, True,
+                                     request_id="rid-same"))
+
+    threads = [threading.Thread(target=one) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert len(results) == 2
+    ids = [sorted(r.device_ids) for r in results]
+    assert ids[0] == ids[1] and len(ids[0]) == 2, ids
+    # ONE slave-pod set: the retry adopted, it did not double-attach
+    assert len(rig.sim.slave_pods()) == 1
+
+
+# -- knobs / off-path ----------------------------------------------------------
+
+def test_threadpool_remains_the_default_off_path(fake_host):
+    from gpumounter_tpu.testing.sim import WorkerRig
+    from gpumounter_tpu.worker.grpc_server import build_server
+    rig = WorkerRig(fake_host)
+    server, _ = build_server(rig.service, port=0, address="127.0.0.1")
+    assert server.parking_executor is None      # the off-path pin
+    server.stop(grace=0)
+    with pytest.raises(ValueError):
+        build_server(rig.service, port=0, mode="warp")
+    rig.close()
+
+
+def test_grpc_knobs_plumb_through_the_rigs(fake_host):
+    """The Settings → WorkerRig → LiveStack plumbing mirrors
+    worker/main.py's Settings → build_server wiring: a rig built with
+    the knobs carries them on its Settings, and a LiveStack deferring
+    to settings builds the matching server."""
+    from gpumounter_tpu.testing.sim import LiveStack, WorkerRig
+    rig = WorkerRig(fake_host, grpc_workers=3, grpc_async=True)
+    assert rig.sim.settings.grpc_workers == 3
+    assert rig.sim.settings.grpc_async is True
+    stack = LiveStack(rig, grpc_workers=None, grpc_mode="settings")
+    try:
+        executor = stack.grpc_server.parking_executor
+        assert executor is not None and executor.max_active == 3
+    finally:
+        stack.close()
+
+
+def test_grpc_knobs_plumb_from_env():
+    assert Settings().grpc_async is False       # direct construction
+    assert Settings().grpc_workers == consts.DEFAULT_GRPC_WORKERS
+    env = Settings.from_env({})
+    assert env.grpc_async is True               # production default ON
+    assert env.grpc_workers == consts.DEFAULT_GRPC_WORKERS
+    off = Settings.from_env({"TPU_GRPC_ASYNC": "0",
+                             "TPU_GRPC_WORKERS": "32"})
+    assert off.grpc_async is False and off.grpc_workers == 32
+    with pytest.raises(ValueError):
+        Settings.from_env({"TPU_GRPC_WORKERS": "0"})
+    with pytest.raises(ValueError):
+        Settings.from_env({"TPU_GRPC_WORKERS": "64",
+                           "TPU_GRPC_MAX_PARKED": "8"})

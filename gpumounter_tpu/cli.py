@@ -312,6 +312,19 @@ def _slice_status(args) -> int:
             f"  group {group}: tenant={info.get('tenant')} "
             f"generation={info.get('generation')} "
             f"chips={info.get('chips')}")
+        barrier = info.get("barrier")
+        if barrier:
+            joined = len(barrier.get("joined") or [])
+            expected = barrier.get("expected")
+            missing = ", ".join(barrier.get("missing") or [])
+            lines.append(
+                f"    barrier gen {barrier.get('generation')}: "
+                f"{joined}/{expected} re-federated"
+                + (f" ({barrier.get('age_s')}s)"
+                   if barrier.get("age_s") is not None else "")
+                + (f" STUCK — waiting on: {missing}"
+                   if barrier.get("stuck") else
+                   (f", waiting on: {missing}" if missing else "")))
         for member in info.get("members", []):
             expires = member.get("expires_in_s")
             lines.append(
@@ -327,7 +340,8 @@ def _slice_status(args) -> int:
             f"{len(txn.get('pods') or [])} host(s) committed, "
             f"age {txn.get('age_s')}s rid={txn.get('rid')}")
     rc = _finish(status, payload, args.json, "\n".join(lines))
-    if rc == 0 and int(txns.get("stranded") or 0) > 0:
+    if rc == 0 and (int(txns.get("stranded") or 0) > 0
+                    or int(payload.get("stuck_barriers") or 0) > 0):
         return 1
     return rc
 
@@ -1283,6 +1297,25 @@ def cmd_doctor(args) -> int:
                   f"slices: {len(groups)} group(s) live, {pending} "
                   f"txn(s) in flight, {gangs} gang(s) queued, 0 "
                   "stranded")
+        # A re-federation barrier incomplete past
+        # TPU_RESIZE_BARRIER_TIMEOUT_S: some member never re-federated
+        # after a resize — killed mid-transition, or its process wedged.
+        # Survivors are parked (they cannot restore without the full
+        # world); resolution is a new generation without the missing
+        # member (resize or slice self-healing). WARN, not CRIT: the
+        # protocol is holding — that is the barrier doing its job.
+        for group, info in sorted(groups.items()):
+            barrier = (info or {}).get("barrier") or {}
+            if barrier.get("stuck"):
+                missing = ", ".join(barrier.get("missing") or [])
+                check("warn",
+                      f"slice group {group}: re-federation barrier for "
+                      f"generation {barrier.get('generation')} stuck "
+                      f"at {len(barrier.get('joined') or [])}/"
+                      f"{barrier.get('expected')} for "
+                      f"{barrier.get('age_s')}s — waiting on: "
+                      f"{missing}; resize (or let slice self-healing) "
+                      "move the generation past the missing member")
 
     # SLO burn rates (utils/slo.py, ticked by the master's fleet loop):
     # CURRENT state — a fast 5m burn means a tenant is eating its error

@@ -1,4 +1,4 @@
-"""Graceful device drain for hot-detach (BASELINE config 4).
+"""Graceful device drain for hot-detach and elastic resize.
 
 Detaching chips out from under a live JAX process invalidates every array on
 them. The safe sequence — which this module packages — is:
@@ -10,17 +10,56 @@ them. The safe sequence — which this module packages — is:
     4. (optional) AddTPU again  — same or different chip count
     5. ``restore(path, mesh)``  — checkpoint → new device set, resharded
 
-Restore reshards onto whatever mesh the *new* device set supports — detach 4
-chips and reattach 2 and the state comes back sharded over 2. Checkpoints are
-a host-side pickle of the numpy-ified pytree: structure-preserving for any
-(TrainState, optax, dict) tree without pulling a checkpoint framework into
-the probe's dependency set; swap in orbax for production-size models.
+Two checkpoint formats live here:
+
+**Legacy single-file** (``drain``/``restore``): a host-side pickle of the
+numpy-ified pytree — structure-preserving for any (TrainState, optax, dict)
+tree without pulling a checkpoint framework into the probe's dependency
+set. Written atomically (tmp + fsync + rename): a crash mid-``drain`` can
+never leave a torn checkpoint in place of a good one.
+
+**Sharded streaming** (``drain_sharded``/``restore_sharded``): the
+multi-process format real resizes need. Every process writes ONE shard
+file containing only the addressable array shards it owns (``replica_id
+== 0`` — replicas deduplicated the orbax way), then process 0 commits a
+``manifest.json`` (generation, world size, per-shard SHA-256 checksums)
+and atomically repoints the ``LATEST`` marker. Restore validates the
+manifest and every checksum BEFORE assembling anything; a torn or
+missing shard is a **typed error** (:class:`TornShardError` /
+:class:`ManifestError` / :class:`WrongGenerationError`), never a silent
+partial tree — callers roll back to the last fully-valid generation
+(:func:`restore_last_good`), which is kept on disk until the next
+generation commits. Restore reshards old-N-process shards onto whatever
+mesh the new world supports via ``NamedSharding`` placement
+(``jaxcheck/dist.put_global``), so a 2-process checkpoint restores onto
+a 4-process mesh and back.
+
+Layout under a checkpoint root::
+
+    root/
+      LATEST                      <- "gen-7\n" (atomic pointer, fsync'd)
+      gen-7/
+        manifest.json             <- committed by process 0, LAST
+        shard-00000-of-00002.pkl  <- process 0's replica-0 shards
+        shard-00001-of-00002.pkl
+      gen-6/ ...                  <- previous generation: the rollback
+                                     target, pruned only when gen-8 commits
+
+Deletion discipline (pinned by tests/test_federation_lint.py): no restore
+path ever unlinks anything — pruning happens exclusively in the commit
+step, strictly AFTER the new generation's manifest and LATEST pointer are
+durable, and always keeps the newly committed generation plus its
+predecessor. A checkpoint that is the sole surviving copy of the state is
+therefore never deleted.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import pickle
+import re
 import tempfile
 from typing import Any
 
@@ -31,23 +70,92 @@ from gpumounter_tpu.utils.log import get_logger
 
 logger = get_logger("jaxcheck.drain")
 
+SHARDED_FORMAT = "tpumounter-sharded-v1"
+_GEN_DIR_RE = re.compile(r"gen-(\d+)$")
 
-def drain(tree: Any, path: str) -> Any:
-    """Device pytree → host numpy pytree, persisted at ``path`` (written
-    atomically — a crash mid-detach must not eat the only copy). Returns the
-    host tree."""
-    host_tree = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+# -- typed checkpoint errors ---------------------------------------------------
+
+
+class CheckpointError(Exception):
+    """Base for every sharded-checkpoint failure. Catching this and
+    falling back to :func:`restore_last_good` is the whole rollback
+    contract — a CheckpointError NEVER delivers a partial tree."""
+
+
+class ManifestError(CheckpointError):
+    """The generation's manifest is missing, unparsable, or names an
+    unknown format — the commit never happened or was torn."""
+
+
+class TornShardError(CheckpointError):
+    """A shard file named by a committed manifest is missing, truncated,
+    or fails its checksum — the generation cannot be trusted."""
+
+
+class WrongGenerationError(CheckpointError):
+    """The committed checkpoint's generation is not the one the caller
+    expected to restore (the world moved on mid-transition)."""
+
+
+class NoCheckpointError(CheckpointError):
+    """No fully-valid generation exists under the root at all."""
+
+
+# -- atomic file primitives ----------------------------------------------------
+
+
+def _fsync_dir(directory: str) -> None:
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """tmp + fsync + rename, then fsync the directory: after this
+    returns the bytes are durable AND the name flip was atomic — a crash
+    at any instant leaves either the old file or the new one, never a
+    truncated hybrid."""
     directory = os.path.dirname(os.path.abspath(path))
     os.makedirs(directory, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".draining")
     try:
         with os.fdopen(fd, "wb") as f:
-            pickle.dump(host_tree, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    _fsync_dir(directory)
+
+
+def _sha256(path: str) -> str:
+    digest = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+# -- legacy single-file checkpoint (BASELINE config 4) -------------------------
+
+
+def drain(tree: Any, path: str) -> Any:
+    """Device pytree → host numpy pytree, persisted at ``path`` (written
+    atomically with tmp + fsync + rename — a crash mid-detach must not
+    eat the only copy OR leave a torn file where a good one was).
+    Returns the host tree."""
+    host_tree = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+    _atomic_write(path, pickle.dumps(host_tree,
+                                     protocol=pickle.HIGHEST_PROTOCOL))
     leaves = jax.tree.leaves(host_tree)
     logger.info("drained %d arrays (%.1f MB) to %s", len(leaves),
                 sum(a.nbytes for a in leaves if hasattr(a, "nbytes")) / 1e6,
@@ -90,3 +198,318 @@ def drain_restore_cycle(tree: Any, shardings: Any = None,
     if own_tmp and os.path.exists(path):
         os.unlink(path)
     return restored
+
+
+# -- sharded checkpoint streaming ----------------------------------------------
+
+
+def _gen_dir(root: str, generation: int) -> str:
+    return os.path.join(root, f"gen-{int(generation)}")
+
+
+def _shard_name(process_index: int, process_count: int) -> str:
+    return f"shard-{process_index:05d}-of-{process_count:05d}.pkl"
+
+
+def _is_shard_leaf(x) -> bool:
+    return isinstance(x, dict) and "entries" in x and "shape" in x
+
+
+def _leaf_to_shards(leaf, process_index: int):
+    """One state leaf → this process's contribution: the replica-0
+    addressable shards (device arrays — replicas deduplicated, so
+    across all processes the entries tile the global array exactly
+    once), or — for host leaves every process holds identically — the
+    whole value from process 0 only."""
+    if isinstance(leaf, jax.Array):
+        entries = []
+        for shard in leaf.addressable_shards:
+            if shard.replica_id != 0:
+                continue
+            index = [[s.start, s.stop] for s in shard.index] \
+                if shard.index else []
+            entries.append({"index": index,
+                            "data": np.asarray(shard.data)})
+        return {"shape": list(leaf.shape), "dtype": str(leaf.dtype),
+                "entries": entries}
+    value = np.asarray(leaf)
+    entries = [] if process_index != 0 else [
+        {"index": [[0, n] for n in value.shape], "data": value}]
+    return {"shape": list(value.shape), "dtype": str(value.dtype),
+            "entries": entries}
+
+
+def drain_sharded(tree: Any, root: str, generation: int, *,
+                  process_index: int | None = None,
+                  process_count: int | None = None,
+                  sync_fn=None) -> str:
+    """Stream this process's shards of ``tree`` into generation
+    ``generation`` under ``root`` and (on process 0) commit the
+    manifest. Every member of the (still-live) world calls this BEFORE
+    tearing its backend down; ``sync_fn`` is the cross-process barrier
+    (``multihost_utils.sync_global_devices`` closure) guaranteeing all
+    shard files are durable before process 0 commits — pass None in a
+    single-process world.
+
+    Returns the committed (or written, for process != 0) generation
+    directory. The previous generation is KEPT: pruning keeps the new
+    commit plus its predecessor, so a crash anywhere in the next
+    transition still has a fully-valid checkpoint to roll back to."""
+    if process_index is None:
+        process_index = jax.process_index()
+    if process_count is None:
+        process_count = jax.process_count()
+    gen_dir = _gen_dir(root, generation)
+    os.makedirs(gen_dir, exist_ok=True)
+    shard_tree = jax.tree.map(
+        lambda leaf: _leaf_to_shards(leaf, process_index), tree)
+    name = _shard_name(process_index, process_count)
+    _atomic_write(os.path.join(gen_dir, name),
+                  pickle.dumps({"format": SHARDED_FORMAT,
+                                "process": process_index,
+                                "tree": shard_tree},
+                               protocol=pickle.HIGHEST_PROTOCOL))
+    logger.info("drained shard %s of generation %d to %s", name,
+                generation, gen_dir)
+    if sync_fn is not None:
+        sync_fn()               # every member's shard is durable
+    if process_index == 0:
+        commit_manifest(root, generation, process_count)
+    if sync_fn is not None:
+        sync_fn()               # nobody proceeds before the commit
+    return gen_dir
+
+
+def commit_manifest(root: str, generation: int,
+                    process_count: int) -> dict:
+    """The commit point: hash every shard file, write the manifest, flip
+    ``LATEST``, THEN prune superseded generations (keeping this one and
+    its predecessor). Run by process 0 only, strictly after every
+    member's shard is durable."""
+    gen_dir = _gen_dir(root, generation)
+    shards = {}
+    for i in range(process_count):
+        name = _shard_name(i, process_count)
+        path = os.path.join(gen_dir, name)
+        if not os.path.exists(path):
+            raise TornShardError(
+                f"cannot commit generation {generation}: shard {name} "
+                "was never written (a member died mid-drain?)")
+        shards[name] = {"sha256": _sha256(path),
+                        "bytes": os.path.getsize(path)}
+    manifest = {
+        "format": SHARDED_FORMAT,
+        "generation": int(generation),
+        "process_count": int(process_count),
+        "shards": shards,
+    }
+    _atomic_write(os.path.join(gen_dir, "manifest.json"),
+                  json.dumps(manifest, indent=1).encode())
+    _atomic_write(os.path.join(root, "LATEST"),
+                  f"gen-{int(generation)}\n".encode())
+    _prune_generations(root, keep=int(generation))
+    logger.info("committed sharded checkpoint generation %d (%d shard "
+                "file(s))", generation, process_count)
+    return manifest
+
+
+def _prune_generations(root: str, keep: int) -> None:
+    """Delete generation dirs superseded by the just-committed ``keep``
+    — called ONLY from the commit path, after the new manifest and
+    LATEST are durable, and always sparing ``keep`` plus the newest
+    COMMITTED generation below it (the rollback target). Committed
+    means the manifest parses: a torn dir a crashed transition left
+    behind (shards, no manifest) is junk, not a rollback target — and
+    sparing it instead of the real last-good would silently shorten
+    the rollback chain to nothing. The lint pins that no restore path
+    can reach here."""
+    import shutil
+    gens = sorted(list_generations(root))
+    spare = {keep}
+    for gen in sorted((g for g in gens if g < keep), reverse=True):
+        try:
+            _load_manifest(root, gen)
+        except CheckpointError:
+            continue
+        spare.add(gen)
+        break
+    for gen in gens:
+        if gen in spare:
+            continue
+        shutil.rmtree(_gen_dir(root, gen), ignore_errors=True)
+        logger.info("pruned superseded checkpoint generation %d", gen)
+
+
+def list_generations(root: str) -> list[int]:
+    """Every generation directory under ``root`` (committed or not),
+    ascending."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        match = _GEN_DIR_RE.fullmatch(name)
+        if match and os.path.isdir(os.path.join(root, name)):
+            out.append(int(match.group(1)))
+    return sorted(out)
+
+
+def latest_generation(root: str) -> int | None:
+    """The committed generation the ``LATEST`` pointer names, or None
+    when nothing has ever committed here."""
+    try:
+        with open(os.path.join(root, "LATEST")) as f:
+            text = f.read().strip()
+    except OSError:
+        return None
+    match = _GEN_DIR_RE.fullmatch(text)
+    return int(match.group(1)) if match else None
+
+
+def _load_manifest(root: str, generation: int) -> dict:
+    path = os.path.join(_gen_dir(root, generation), "manifest.json")
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except OSError as e:
+        raise ManifestError(
+            f"generation {generation} has no readable manifest "
+            f"({e}): the commit never happened") from e
+    except ValueError as e:
+        raise ManifestError(
+            f"generation {generation} manifest is corrupt: {e}") from e
+    if manifest.get("format") != SHARDED_FORMAT:
+        raise ManifestError(
+            f"generation {generation} manifest names unknown format "
+            f"{manifest.get('format')!r}")
+    if int(manifest.get("generation", -1)) != int(generation):
+        raise ManifestError(
+            f"generation dir {generation} holds a manifest stamped "
+            f"{manifest.get('generation')!r}")
+    return manifest
+
+
+def _verify_shards(root: str, generation: int, manifest: dict) -> None:
+    gen_dir = _gen_dir(root, generation)
+    for name, meta in (manifest.get("shards") or {}).items():
+        path = os.path.join(gen_dir, name)
+        if not os.path.exists(path):
+            raise TornShardError(
+                f"generation {generation}: shard {name} is missing")
+        if os.path.getsize(path) != int(meta.get("bytes", -1)):
+            raise TornShardError(
+                f"generation {generation}: shard {name} is truncated "
+                f"({os.path.getsize(path)} bytes, manifest says "
+                f"{meta.get('bytes')})")
+        if _sha256(path) != meta.get("sha256"):
+            raise TornShardError(
+                f"generation {generation}: shard {name} fails its "
+                "checksum")
+
+
+def _assemble_leaf(parts: list[dict]) -> np.ndarray:
+    """Shard entries (across every process's shard file) → the full
+    host array; coverage is validated so a manifest that somehow passed
+    checksums but lost entries still cannot yield a partial tree."""
+    shape = tuple(parts[0]["shape"])
+    dtype = np.dtype(parts[0]["dtype"])
+    if shape == ():
+        for part in parts:
+            for entry in part["entries"]:
+                return np.asarray(entry["data"], dtype=dtype)
+        raise TornShardError("scalar leaf has no shard entry")
+    out = np.empty(shape, dtype=dtype)
+    covered = 0
+    for part in parts:
+        for entry in part["entries"]:
+            index = tuple(slice(start, stop)
+                          for start, stop in entry["index"])
+            data = np.asarray(entry["data"], dtype=dtype)
+            out[index] = data
+            covered += data.size
+    if covered != out.size:
+        raise TornShardError(
+            f"shard entries cover {covered} of {out.size} elements — "
+            "replica-0 shards no longer tile the array")
+    return out
+
+
+def _load_generation(root: str, generation: int,
+                     shardings: Any = None) -> Any:
+    """Validate + assemble ONE generation. Raises a typed
+    CheckpointError; never returns a partial tree, never deletes
+    anything (the no-unlink lint pins this path)."""
+    manifest = _load_manifest(root, generation)
+    _verify_shards(root, generation, manifest)
+    gen_dir = _gen_dir(root, generation)
+    trees = []
+    for name in manifest["shards"]:
+        try:
+            with open(os.path.join(gen_dir, name), "rb") as f:
+                payload = pickle.load(f)
+        except (OSError, pickle.UnpicklingError, EOFError) as e:
+            raise TornShardError(
+                f"generation {generation}: shard {name} unreadable: "
+                f"{e}") from e
+        if payload.get("format") != SHARDED_FORMAT:
+            raise TornShardError(
+                f"generation {generation}: shard {name} names format "
+                f"{payload.get('format')!r}")
+        trees.append(payload["tree"])
+    host_tree = jax.tree.map(lambda *parts: _assemble_leaf(list(parts)),
+                             *trees, is_leaf=_is_shard_leaf)
+    if shardings is None:
+        return host_tree
+    from gpumounter_tpu.jaxcheck.dist import put_global
+
+    def place(host, sharding):
+        if sharding is None:
+            return host
+        return put_global(host, sharding)
+    return jax.tree.map(place, host_tree, shardings)
+
+
+def restore_sharded(root: str, shardings: Any = None, *,
+                    expect_generation: int | None = None) -> Any:
+    """The committed checkpoint → device pytree resharded onto the
+    CURRENT mesh (old-N shards onto a new-M world — ``shardings`` is
+    the template pytree of ``NamedSharding``s the new mesh wants).
+    ``expect_generation`` pins the generation a re-federated member is
+    transitioning to; a mismatch raises :class:`WrongGenerationError`
+    so the caller can fall back to :func:`restore_last_good` instead of
+    silently restoring a stale world's state as the new one's."""
+    generation = latest_generation(root)
+    if generation is None:
+        gens = list_generations(root)
+        if not gens:
+            raise NoCheckpointError(f"no checkpoint under {root}")
+        raise ManifestError(
+            f"{root} has generation dir(s) {gens} but no LATEST "
+            "pointer: nothing ever committed")
+    if expect_generation is not None \
+            and int(generation) != int(expect_generation):
+        raise WrongGenerationError(
+            f"committed checkpoint is generation {generation}, caller "
+            f"expected {expect_generation}")
+    return _load_generation(root, generation, shardings)
+
+
+def restore_last_good(root: str,
+                      shardings: Any = None) -> tuple[Any, int]:
+    """Walk generations newest → oldest and return ``(tree,
+    generation)`` for the first fully-valid one — the rollback target
+    after a torn/missing-shard or wrong-generation failure. Raises
+    :class:`NoCheckpointError` when nothing valid survives anywhere."""
+    last_error: CheckpointError | None = None
+    for generation in sorted(list_generations(root), reverse=True):
+        try:
+            return _load_generation(root, generation, shardings), \
+                generation
+        except CheckpointError as e:
+            logger.warning("generation %d not restorable (%s); trying "
+                           "older", generation, e)
+            last_error = e
+    raise NoCheckpointError(
+        f"no fully-valid checkpoint generation under {root}"
+        + (f" (last failure: {last_error})" if last_error else ""))

@@ -190,12 +190,22 @@ class ElasticHarness:
 
     # -- lifecycle -------------------------------------------------------------
 
-    def start(self) -> "ElasticHarness":
+    def start(self, resume: bool = False) -> "ElasticHarness":
         """Initialise state + step over the current chip set; records
-        the current generation as the baseline."""
+        the current generation as the baseline. ``resume=True`` restores
+        from an existing checkpoint instead of a fresh init when one is
+        present — the crash-between-drain-and-restore recovery path: the
+        checkpoint was the sole surviving copy, and the next boot picks
+        the restore back up rather than resetting the trajectory."""
         self.generation = self.generation_fn()
-        self._build(fresh=True)
+        self._build(fresh=not (resume and self._resumable()))
         return self
+
+    def _resumable(self) -> bool:
+        """Whether a checkpoint exists to resume from (subclasses with
+        other formats override)."""
+        return os.path.exists(self.checkpoint_path) \
+            and os.path.getsize(self.checkpoint_path) > 0
 
     def _current_mesh(self):
         chips = int(self.chips_fn())
@@ -218,8 +228,7 @@ class ElasticHarness:
         else:
             shardings = state_shardings(self.cfg, self.mesh,
                                         self.optimizer, self.seed)
-            self.state = drain_lib.restore(self.checkpoint_path,
-                                           shardings)
+            self.state = self._restore(shardings)
         size = self.mesh.devices.size
         logger.info("elastic mesh %s over %d device(s)%s",
                     dict(zip(self.mesh.axis_names, self.mesh.devices.shape)),
@@ -239,19 +248,40 @@ class ElasticHarness:
 
     def reshape(self, generation=None) -> None:
         old = self.mesh.devices.size if self.mesh is not None else 0
-        drain_lib.drain(self.state, self.checkpoint_path)
+        if generation is None:
+            generation = self.generation_fn()
+        self._drain(generation)
         # release every reference into the old backend BEFORE dropping
         # it — live arrays on dead backends are the classic reshape bug
         self.state = None
         self.step_fn = None
-        if self.reinitialize is not None:
-            self.reinitialize()
+        # a teardown may retarget (the federated harness chases a
+        # superseded barrier to the newest generation)
+        generation = self._teardown(generation) or generation
         self._build(fresh=False)
-        self.generation = (self.generation_fn()
-                           if generation is None else generation)
+        self.generation = generation
         self.reshapes += 1
         logger.info("reshaped %d -> %d devices at generation %r", old,
                     self.mesh.devices.size, self.generation)
+
+    # -- reshape hooks (overridden by the multi-process federation
+    # harness, jaxcheck/federation.py) -----------------------------------------
+
+    def _drain(self, generation) -> None:
+        """Checkpoint the live state before the backend drops (default:
+        the legacy single-file atomic pickle)."""
+        drain_lib.drain(self.state, self.checkpoint_path)
+
+    def _teardown(self, generation) -> None:
+        """Drop the old device world (default: the injected backend
+        re-init; a CPU sim passes None — its virtual devices never
+        change)."""
+        if self.reinitialize is not None:
+            self.reinitialize()
+
+    def _restore(self, shardings):
+        """Checkpoint → state resharded onto the CURRENT mesh."""
+        return drain_lib.restore(self.checkpoint_path, shardings)
 
     # -- training --------------------------------------------------------------
 

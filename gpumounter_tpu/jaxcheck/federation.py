@@ -1,0 +1,686 @@
+"""Crash-safe multi-process re-federation for elastic resize.
+
+``jaxcheck/elastic.py`` made a SINGLE process survive a ``POST
+/slice/resize`` (drain → rebuild → restore resharded). A real v5p slice
+spans host processes federated by ``jax.distributed``, and there a
+resize is a coordinated teardown of the whole world: every member must
+drain its shards, leave the old world, and re-run
+``jax.distributed.initialize`` with the NEW world size and coordinator —
+and **no member may restore before every member of the new generation
+has re-federated**, or the restore's collectives hang against absentees
+(and a stale-generation straggler would corrupt the new world).
+
+This module is the member side of that protocol; the barrier itself
+lives in the control plane (``master/slicetxn.py``), anchored beside the
+slice group's intent records so the master is the source of truth:
+
+1. the resize actuates and bumps the mesh generation G → G+1; the
+   master **arms a barrier** for G+1 naming the new membership
+2. each member observes the bump (``/slicez`` or the worker's
+   notification file), agrees on it with its peers via a collective
+   (:class:`WorldAgreement` — so nobody drains while a peer is mid-step),
+   drains its shards (``drain.drain_sharded``: per-process shard files,
+   process 0 commits the manifest), and tears down its backend +
+   distributed client (``probe.shutdown_distributed``)
+3. each member **joins** the barrier (``POST /slice/barrier``) with the
+   coordinator address it would serve if elected; a stale-generation
+   join is refused (:class:`StaleGenerationError`), a member resized out
+   of the slice is refused (:class:`MembershipRefusedError`) and exits
+4. when the LAST member joins, the barrier completes and answers every
+   poller the **federation plan**: ordered membership (= process ids),
+   world size, and the elected coordinator (member 0's address — a dead
+   coordinator is re-elected by arming the next generation without it)
+5. members run ``jax.distributed.initialize`` with the plan and restore
+   the checkpoint resharded onto the new mesh
+   (``drain.restore_sharded``); a torn/missing shard or a generation
+   mismatch rolls back to the last-good generation — never a partial
+   restore
+
+A member SIGKILLed mid-transition simply never joins; the barrier stays
+incomplete past ``TPU_RESIZE_BARRIER_TIMEOUT_S`` (doctor WARNs with the
+missing member names) until the control plane moves the generation again
+— an operator resize or PR 13's ``repair_group``, which drives this SAME
+protocol on its own generation bump. Survivors waiting on the stale
+barrier see it superseded, retarget, and re-form.
+
+CLI (what the multi-process e2e spawns, one per member process)::
+
+    python -m gpumounter_tpu.jaxcheck.federation \
+        --master http://MASTER --group GROUP --member ns/pod \
+        --checkpoint-root /ckpt --local-devices 2 --status-file out.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any
+
+import jax
+import numpy as np
+
+from gpumounter_tpu.jaxcheck import drain as drain_lib
+from gpumounter_tpu.jaxcheck import elastic as elastic_lib
+from gpumounter_tpu.jaxcheck import model as model_lib
+from gpumounter_tpu.jaxcheck import probe as probe_lib
+from gpumounter_tpu.jaxcheck import train as train_lib
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("jaxcheck.federation")
+
+# THE control plane's stuck-barrier window (consts.py documents the
+# invariant): members poll with the same deadline the master judges
+# stuckness by, so the two sides never desynchronize
+DEFAULT_BARRIER_TIMEOUT_S = consts.DEFAULT_RESIZE_BARRIER_TIMEOUT_S
+
+
+# -- typed protocol errors -----------------------------------------------------
+
+
+class FederationError(Exception):
+    """Base for re-federation protocol failures."""
+
+
+class StaleGenerationError(FederationError):
+    """The barrier refused this member's generation as already
+    superseded — the member must retarget to ``current`` (re-observing
+    the signal) instead of corrupting the newer world."""
+
+    def __init__(self, message: str, current: int | None = None):
+        super().__init__(message)
+        self.current = current
+
+
+class UnknownGenerationError(FederationError):
+    """The barrier sits at an OLDER generation than this member
+    observed (the master's view is catching up — e.g. a lazily
+    re-armed barrier derived from a lagging annotation). Not a fault:
+    the member keeps its target and re-joins until the master's
+    barrier reaches it (or supersedes past it)."""
+
+    def __init__(self, message: str, current: int | None = None):
+        super().__init__(message)
+        self.current = current
+
+
+class MembershipRefusedError(FederationError):
+    """This member is not part of the barrier's generation — it was
+    resized out of the slice and should exit cleanly."""
+
+
+class BarrierTimeoutError(FederationError):
+    """The barrier did not complete within the wait window (a member
+    died mid-transition, or the resize stalled)."""
+
+
+# -- plumbing ------------------------------------------------------------------
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """A currently-free TCP port on ``host`` — the coordinator address a
+    member proposes when enrolling (production pods advertise a fixed
+    port on the pod IP instead)."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def configure_cpu_world(local_devices: int) -> None:
+    """The hardware-free member mode: CPU backend, gloo cross-process
+    collectives, ``local_devices`` virtual devices per process. Must run
+    before the first backend use. Older jax carries no
+    ``jax_num_cpu_devices`` config — there the XLA flag env var (set
+    before backend init) is the only knob, so both are attempted."""
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except AttributeError:
+        pass
+    try:
+        jax.config.update("jax_num_cpu_devices", local_devices)
+    except AttributeError:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{local_devices}").strip()
+
+
+class WorldAgreement:
+    """Collective agreement on the observed generation: every process
+    contributes what it read from the signal and the MINIMUM wins, so no
+    process begins draining while a peer (that has not yet seen the
+    bump) is about to block in a training-step collective. Single
+    process: the identity."""
+
+    def agree(self, value: int) -> int:
+        if jax.process_count() <= 1:
+            return int(value)
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            np.asarray(value, dtype=np.int64))
+        return int(np.min(gathered))
+
+
+# -- the barrier client --------------------------------------------------------
+
+
+class BarrierClient:
+    """The member side of the master's re-federation barrier
+    (``/slice/barrier``, master/slicetxn.py)."""
+
+    def __init__(self, master_base: str, group: str, member: str,
+                 timeout_s: float = 5.0):
+        self.base = master_base.rstrip("/")
+        self.group = group
+        self.member = member
+        self.timeout_s = timeout_s
+
+    def _request(self, method: str, path: str,
+                 body: dict | None = None) -> tuple[int, dict]:
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(f"{self.base}{path}", data=data,
+                                     method=method)
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read() or b"{}")
+            except ValueError:
+                return e.code, {}
+
+    def join(self, generation: int, address: str) -> dict:
+        """Enroll this member in the barrier for ``generation``. Raises
+        the typed refusals; transient transport trouble raises OSError
+        for the caller's retry loop."""
+        status, payload = self._request(
+            "POST", "/slice/barrier",
+            {"group": self.group, "generation": int(generation),
+             "member": self.member, "address": address})
+        if status == 200:
+            return payload
+        result = payload.get("result", "")
+        if result == "StaleGeneration":
+            raise StaleGenerationError(
+                f"barrier refused generation {generation}: current is "
+                f"{payload.get('current')}",
+                current=payload.get("current"))
+        if result == "UnknownGeneration":
+            raise UnknownGenerationError(
+                f"barrier has not reached generation {generation} yet "
+                f"(at {payload.get('current')})",
+                current=payload.get("current"))
+        if result == "NotAMember":
+            raise MembershipRefusedError(
+                f"{self.member} is not in the generation-"
+                f"{payload.get('generation', generation)} membership "
+                f"{payload.get('members')}")
+        if status == 404 and result in ("SliceNotFound",
+                                        "BarrierNotFound"):
+            # the group itself is gone — torn down as a unit (no-spare
+            # repair, operator removetpuslice) while this member was
+            # between worlds. That is a clean end, not a transport
+            # fault: exit like any resized-out member.
+            raise MembershipRefusedError(
+                f"slice group {self.group} no longer exists "
+                f"({result}): torn down while re-federating")
+        raise OSError(f"barrier join failed: HTTP {status} {payload}")
+
+    def status(self) -> dict | None:
+        namespace = self.member.split("/", 1)[0]
+        try:
+            status, payload = self._request(
+                "GET", f"/slice/barrier?group={self.group}"
+                       f"&namespace={namespace}")
+        except OSError:
+            return None
+        return payload if status == 200 else None
+
+    def wait(self, generation: int, *, timeout_s: float,
+             poll_s: float = 0.2) -> dict:
+        """Poll until the barrier for ``generation`` completes; returns
+        the federation plan. A barrier that moved PAST the target raises
+        :class:`StaleGenerationError` (retarget); never completing
+        within ``timeout_s`` raises :class:`BarrierTimeoutError`."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            payload = self.status()
+            if payload is not None:
+                current = payload.get("generation")
+                if current is not None and int(current) > int(generation):
+                    raise StaleGenerationError(
+                        f"barrier moved to generation {current} while "
+                        f"waiting on {generation}", current=int(current))
+                if int(current or -1) == int(generation) \
+                        and payload.get("complete"):
+                    return payload.get("plan") or {}
+            if time.monotonic() >= deadline:
+                joined = (payload or {}).get("joined")
+                raise BarrierTimeoutError(
+                    f"barrier for generation {generation} incomplete "
+                    f"after {timeout_s:.0f}s (joined: {joined})")
+            time.sleep(poll_s)
+
+
+class Refederator:
+    """Owns one member's transitions between jax.distributed worlds:
+    teardown → barrier → initialize-with-the-plan. ``barrier=None`` is
+    the single-process degenerate mode (backend re-init only —
+    what the CPU sim e2e of PR 9 exercises)."""
+
+    def __init__(self, barrier: BarrierClient | None, *,
+                 cpu_devices_per_process: int | None = None,
+                 bind_host: str = "127.0.0.1",
+                 barrier_timeout_s: float = DEFAULT_BARRIER_TIMEOUT_S,
+                 hold_dir: str | None = None):
+        self.barrier = barrier
+        self.cpu_devices_per_process = cpu_devices_per_process
+        self.bind_host = bind_host
+        self.barrier_timeout_s = barrier_timeout_s
+        # test seam: when set, the member pauses between teardown and
+        # barrier join until `<hold_dir>/go-<generation>` exists, after
+        # announcing itself via `<hold_dir>/<member>.ready-<generation>`
+        # — how the fault-injection e2e lands a SIGKILL deterministically
+        # in the mid-resize window
+        self.hold_dir = hold_dir
+        self.plan: dict | None = None
+        self.federated = False
+
+    # -- the transition --------------------------------------------------------
+
+    def refederate(self, generation: int) -> dict | None:
+        """Leave the old world and join the new one at ``generation``.
+        Returns the federation plan (None in single-process mode).
+        Raises :class:`MembershipRefusedError` when this member was
+        resized out; internally retargets on supersede (the returned
+        plan's ``generation`` is authoritative)."""
+        if self.federated:
+            probe_lib.shutdown_distributed()
+        elif self.barrier is None:
+            # single-process degenerate mode: plain backend re-init.
+            # (A federated member's FIRST call must touch NOTHING: with
+            # gloo configured, any backend query before
+            # jax.distributed.initialize fails — the client does not
+            # exist yet.)
+            probe_lib.reinitialize_backend()
+        self.federated = False
+        if self.barrier is None:
+            return None
+        target = int(generation)
+        while True:
+            self._hold(target)
+            address = f"{self.bind_host}:{free_port(self.bind_host)}"
+            try:
+                payload = self.join_with_retry(target, address)
+                if payload.get("complete"):
+                    plan = payload.get("plan") or {}
+                else:
+                    plan = self.barrier.wait(
+                        target, timeout_s=self.barrier_timeout_s)
+            except StaleGenerationError as e:
+                # the world moved while we were between worlds: chase it
+                target = int(e.current) if e.current else target + 1
+                logger.warning("barrier superseded mid-join; "
+                               "retargeting to generation %d", target)
+                continue
+            except UnknownGenerationError as e:
+                # the master's barrier is BEHIND what we observed (a
+                # lazily re-armed barrier from a lagging annotation):
+                # keep the target and re-join until it catches up —
+                # never retarget DOWN, that would drain into an old
+                # world
+                logger.warning("barrier behind (at %s, want %d); "
+                               "re-joining shortly", e.current, target)
+                time.sleep(0.2)
+                continue
+            except BarrierTimeoutError:
+                # a peer died mid-transition: the control plane will
+                # move the generation (repair/resize); keep polling —
+                # restoring without the full world would hang anyway
+                logger.warning(
+                    "barrier for generation %d timed out; re-checking",
+                    target)
+                continue
+            break
+        self._initialize(plan)
+        plan = dict(plan)
+        plan["generation"] = target
+        self.plan = plan
+        return plan
+
+    def join_with_retry(self, generation: int, address: str,
+                        attempts: int = 5) -> dict:
+        for attempt in range(attempts):
+            try:
+                return self.barrier.join(generation, address)
+            except OSError:
+                if attempt == attempts - 1:
+                    raise
+                time.sleep(0.2 * (attempt + 1))
+        raise AssertionError("unreachable")
+
+    def _initialize(self, plan: dict) -> None:
+        members = list(plan.get("members") or [])
+        member = self.barrier.member if self.barrier else None
+        if member not in members:
+            raise MembershipRefusedError(
+                f"{member} missing from completed plan {members}")
+        process_id = members.index(member)
+        if self.cpu_devices_per_process:
+            configure_cpu_world(self.cpu_devices_per_process)
+        jax.distributed.initialize(
+            coordinator_address=plan["coordinator"],
+            num_processes=int(plan["num_processes"]),
+            process_id=process_id)
+        probe_lib.reinitialize_backend()
+        self.federated = True
+        logger.info("re-federated as process %d/%d (coordinator %s): "
+                    "%d global device(s)", process_id,
+                    plan["num_processes"], plan["coordinator"],
+                    jax.device_count())
+
+    def _hold(self, generation: int) -> None:
+        if not self.hold_dir or self.barrier is None:
+            return
+        ready = os.path.join(
+            self.hold_dir,
+            f"{self.barrier.member.replace('/', '--')}"
+            f".ready-{generation}")
+        go = os.path.join(self.hold_dir, f"go-{generation}")
+        with open(ready, "w") as f:
+            f.write(str(time.time()))
+        while not os.path.exists(go):
+            time.sleep(0.05)
+
+
+# -- the federated harness -----------------------------------------------------
+
+
+class FederatedElasticHarness(elastic_lib.ElasticHarness):
+    """The multi-process :class:`~gpumounter_tpu.jaxcheck.elastic.
+    ElasticHarness`: drain streams per-process shards
+    (``drain.drain_sharded``), teardown runs the re-federation protocol
+    (:class:`Refederator`), restore reshards the committed checkpoint
+    onto the new world's mesh — falling back to the last-good
+    generation on any typed checkpoint failure, never a partial tree."""
+
+    def __init__(self, cfg, generation_fn, chips_fn, *,
+                 refederator: Refederator, checkpoint_root: str,
+                 optimizer=None, step_factory=None,
+                 data: int = 1, model: int = 1, seed: int = 0):
+        super().__init__(cfg, generation_fn, chips_fn,
+                         optimizer=optimizer, step_factory=step_factory,
+                         reinitialize=None,
+                         checkpoint_path=os.path.join(
+                             checkpoint_root, "legacy.ckpt"),
+                         data=data, model=model, seed=seed)
+        self.refederator = refederator
+        self.checkpoint_root = checkpoint_root
+        self.restored_generation: int | None = None
+        self.rolled_back = False
+        self._target_generation: int | None = None
+
+    # -- hooks -----------------------------------------------------------------
+
+    def _resumable(self) -> bool:
+        return drain_lib.latest_generation(self.checkpoint_root) \
+            is not None
+
+    def _sync_fn(self, generation):
+        if jax.process_count() <= 1:
+            return None
+        from jax.experimental import multihost_utils
+        counter = [0]
+
+        def sync() -> None:
+            counter[0] += 1
+            multihost_utils.sync_global_devices(
+                f"tpumounter-drain-{generation}-{counter[0]}")
+        return sync
+
+    def _drain(self, generation) -> None:
+        drain_lib.drain_sharded(self.state, self.checkpoint_root,
+                                int(generation),
+                                sync_fn=self._sync_fn(generation))
+
+    def _teardown(self, generation):
+        plan = self.refederator.refederate(int(generation))
+        self._target_generation = (int(plan["generation"])
+                                   if plan else int(generation))
+        return self._target_generation
+
+    def _restore(self, shardings):
+        self.rolled_back = False
+        try:
+            tree = drain_lib.restore_sharded(
+                self.checkpoint_root, shardings,
+                expect_generation=self._target_generation)
+            self.restored_generation = drain_lib.latest_generation(
+                self.checkpoint_root)
+            return tree
+        except drain_lib.NoCheckpointError:
+            raise
+        except drain_lib.CheckpointError as e:
+            # torn shard / corrupt manifest / generation mismatch: the
+            # LAST-GOOD generation is the rollback target — restored
+            # whole or not at all
+            logger.warning("checkpoint restore failed (%s); rolling "
+                           "back to the last-good generation", e)
+            tree, generation = drain_lib.restore_last_good(
+                self.checkpoint_root, shardings)
+            self.restored_generation = generation
+            self.rolled_back = True
+            return tree
+
+
+# -- the member process (CLI) --------------------------------------------------
+
+
+class MemberRunner:
+    """One slice member's training process, end to end: wait for the
+    slice, federate, (resume-)restore, then step — reshaping through
+    the full protocol on every generation bump, exiting cleanly when
+    resized out. The status file (JSONL, one object per event) is the
+    observable the multi-process e2e asserts on: steps, losses,
+    generations, world sizes, restore fingerprints."""
+
+    def __init__(self, master_base: str, group: str, member: str,
+                 checkpoint_root: str, *, local_devices: int = 2,
+                 status_path: str | None = None,
+                 stop_path: str | None = None,
+                 hold_dir: str | None = None,
+                 max_steps: int | None = None,
+                 barrier_timeout_s: float = DEFAULT_BARRIER_TIMEOUT_S,
+                 lr: float = 1e-2, seq_len: int = 48, batch: int = 4,
+                 cfg=None, step_factory=None):
+        self.master_base = master_base
+        self.group = group
+        self.member = member
+        self.checkpoint_root = checkpoint_root
+        self.local_devices = local_devices
+        self.status_path = status_path
+        self.stop_path = stop_path
+        self.hold_dir = hold_dir
+        self.max_steps = max_steps
+        self.barrier_timeout_s = barrier_timeout_s
+        self.lr = lr
+        self.seq_len = seq_len
+        self.batch = batch
+        self.cfg = cfg or model_lib.ModelConfig(
+            vocab=64, d_model=32, n_heads=4, n_layers=1, d_ff=64)
+        self.step_factory = step_factory
+        self.signal = elastic_lib.MasterSliceSignal(master_base, group)
+        self.agreement = WorldAgreement()
+
+    def _log(self, phase: str, **fields) -> None:
+        record = {"member": self.member, "phase": phase,
+                  "unix": round(time.time(), 3), **fields}
+        if self.status_path:
+            with open(self.status_path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+                f.flush()
+        logger.info("member %s: %s %s", self.member, phase, fields)
+
+    def _fingerprint(self, state) -> float:
+        import jax.numpy as jnp
+        embed = state.params["embed"]
+        return float(jnp.sum(jnp.abs(embed)))
+
+    def _batch(self, step: int):
+        key = jax.random.fold_in(jax.random.PRNGKey(7), step)
+        return np.asarray(train_lib.make_batch(
+            key, self.batch, self.seq_len, self.cfg.vocab))
+
+    def run(self) -> int:
+        configure_cpu_world(self.local_devices)
+        deadline = time.monotonic() + self.barrier_timeout_s
+        generation = None
+        while generation is None:
+            generation = self.signal.generation()
+            if generation is None:
+                if time.monotonic() >= deadline:
+                    self._log("error", message="slice never appeared")
+                    return 2
+                time.sleep(0.2)
+        refederator = Refederator(
+            BarrierClient(self.master_base, self.group, self.member),
+            cpu_devices_per_process=self.local_devices,
+            barrier_timeout_s=self.barrier_timeout_s,
+            hold_dir=self.hold_dir)
+        harness = FederatedElasticHarness(
+            self.cfg, self.signal.generation, self.signal.chips,
+            refederator=refederator,
+            checkpoint_root=self.checkpoint_root,
+            optimizer=train_lib.make_optimizer(lr=self.lr),
+            step_factory=self.step_factory
+            or _default_step_factory)
+        try:
+            plan = refederator.refederate(int(generation))
+        except MembershipRefusedError:
+            self._log("resized_out", generation=int(generation))
+            return 0
+        harness.generation = plan["generation"] if plan \
+            else int(generation)
+        harness._target_generation = int(harness.generation)
+        harness._build(fresh=not harness._resumable())
+        self._log("started", generation=int(harness.generation),
+                  world_devices=int(harness.mesh.devices.size),
+                  resumed=bool(harness.restored_generation is not None),
+                  restored_generation=harness.restored_generation,
+                  fingerprint=self._fingerprint(harness.state))
+        steps = 0
+        while True:
+            if self.stop_path and os.path.exists(self.stop_path):
+                self._log("stopped", step=int(harness.state.step))
+                return 0
+            observed = self.signal.generation() or harness.generation
+            agreed = self.agreement.agree(int(observed))
+            if agreed > int(harness.generation):
+                before = self._fingerprint(harness.state)
+                self._log("reshape_begin", target=agreed,
+                          step=int(harness.state.step),
+                          fingerprint=before)
+                try:
+                    harness.reshape(agreed)
+                except MembershipRefusedError:
+                    self._log("resized_out", generation=agreed)
+                    return 0
+                self._log("reshape_done",
+                          generation=int(harness.generation),
+                          world_devices=int(harness.mesh.devices.size),
+                          restored_generation=harness.
+                          restored_generation,
+                          rolled_back=harness.rolled_back,
+                          step=int(harness.state.step),
+                          fingerprint=self._fingerprint(harness.state))
+                # re-enter at the loop top: EVERY member's first
+                # collective in a new world must be the agreement
+                # allgather — a survivor jumping straight into the
+                # train step while a fresh member runs its first
+                # agreement would cross collectives and deadlock
+                continue
+            loss = harness.train_step(self._batch(int(harness.state.step)))
+            steps += 1
+            self._log("step", step=int(harness.state.step), loss=loss,
+                      generation=int(harness.generation),
+                      world_devices=int(harness.mesh.devices.size))
+            if self.max_steps is not None and steps >= self.max_steps:
+                self._log("done", step=int(harness.state.step))
+                return 0
+
+
+def _default_step_factory(cfg, mesh, optimizer):
+    """Sharded train step under full attention: works on every jax this
+    repo supports (the ring/shard_map kernels need newer jax than some
+    environments carry), multi-process safe (tokens ride (data, seq);
+    XLA lays the cross-process collectives)."""
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from gpumounter_tpu.jaxcheck.ring_attention import full_attention
+
+    def loss_fn(params, tokens):
+        logits = model_lib.forward(params, tokens, cfg,
+                                   attn_fn=full_attention)
+        return train_lib.cross_entropy(logits, tokens)
+
+    def step(state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        return train_lib.TrainState(params, opt_state,
+                                    state.step + 1), loss
+
+    return jax.jit(step, donate_argnums=0,
+                   in_shardings=(None,
+                                 NamedSharding(mesh, P("data", "seq"))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--master", required=True,
+                        help="master base URL (http://host:port)")
+    parser.add_argument("--group", required=True,
+                        help="slice group id (from /addtpuslice)")
+    parser.add_argument("--member", required=True, metavar="NS/POD",
+                        help="this member's pod key")
+    parser.add_argument("--checkpoint-root", required=True,
+                        help="shared sharded-checkpoint directory")
+    parser.add_argument("--local-devices", type=int, default=2)
+    parser.add_argument("--status-file", default=None)
+    parser.add_argument("--stop-file", default=None)
+    parser.add_argument("--hold-dir", default=None,
+                        help="fault-injection seam: pause before every "
+                             "barrier join until go-<gen> appears here")
+    parser.add_argument("--max-steps", type=int, default=None)
+    parser.add_argument("--barrier-timeout", type=float,
+                        default=DEFAULT_BARRIER_TIMEOUT_S)
+    parser.add_argument("--lr", type=float, default=1e-2)
+    parser.add_argument("--seq-len", type=int, default=48)
+    args = parser.parse_args(argv)
+    runner = MemberRunner(
+        args.master, args.group, args.member, args.checkpoint_root,
+        local_devices=args.local_devices, status_path=args.status_file,
+        stop_path=args.stop_file, hold_dir=args.hold_dir,
+        max_steps=args.max_steps,
+        barrier_timeout_s=args.barrier_timeout, lr=args.lr,
+        seq_len=args.seq_len)
+    try:
+        return runner.run()
+    except Exception as e:   # noqa: BLE001 — the e2e reads the status
+        runner._log("error", message=repr(e))   # file, not stderr
+        raise
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -142,6 +142,25 @@ def reinitialize_backend() -> None:
     jax.extend.backend.clear_backends()
 
 
+def shutdown_distributed() -> bool:
+    """Tear down this process's membership in the jax.distributed world
+    (backends dropped FIRST — live arrays must not outlive their
+    backend), so a subsequent :func:`initialize_distributed` can join a
+    NEW world with a different size/coordinator. The elastic resize
+    re-federation step (jaxcheck/federation.py): drain → THIS →
+    barrier → initialize(new world) → restore resharded. Returns
+    whether a distributed client was actually shut down (False = this
+    process was never federated — callers need not care)."""
+    reinitialize_backend()
+    try:
+        jax.distributed.shutdown()
+    except RuntimeError:
+        # not initialized (single-process worlds hit this): nothing to
+        # leave, and the next initialize is free to proceed
+        return False
+    return True
+
+
 def wait_for_devices(expected: int, timeout_s: float = 60.0,
                      poll_s: float = 2.0,
                      dev_root: str = "/dev",

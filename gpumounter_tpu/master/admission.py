@@ -90,6 +90,11 @@ class BrokerConfig:
     # Slice self-healing budget (master/slicetxn.py repair_group):
     # repair txns one group may consume before teardown-as-a-unit.
     slice_repair_budget: int = consts.DEFAULT_SLICE_REPAIR_BUDGET
+    # Re-federation barrier (master/slicetxn.py): a barrier incomplete
+    # past this window is STUCK — surfaced in /slicez, doctor and
+    # `tpumounterctl slice status` with the missing member names.
+    resize_barrier_timeout_s: float = \
+        consts.DEFAULT_RESIZE_BARRIER_TIMEOUT_S
     # Indexed waiter wakeup (master/waiterindex.py): capacity signals
     # examine only candidates the freed capacity could satisfy instead
     # of rescanning the whole queue. Selection order is pinned
@@ -109,6 +114,8 @@ class BrokerConfig:
                    gang_hold_s=settings.gang_hold_s,
                    idle_lease_s=settings.idle_lease_s,
                    slice_repair_budget=settings.slice_repair_budget,
+                   resize_barrier_timeout_s=(
+                       settings.resize_barrier_timeout_s),
                    waiter_index=settings.waiter_index,
                    pool_namespace=settings.pool_namespace,
                    resource_name=settings.resource_name)
@@ -393,6 +400,19 @@ class AttachBroker:
                 adopted = self._slice.adopt(slice_records)
                 logger.info("shard %d: adopted %d stranded slice txn(s)",
                             shard, adopted)
+            # re-federation barriers the dead leader armed: re-arm them
+            # (joined set restarts empty; members re-join idempotently)
+            # so waiting members keep a coordinator of record
+            try:
+                barrier_records, _ = self.store.rehydrate_barriers(shard)
+            except K8sApiError as e:
+                logger.warning("shard %d barrier rehydration deferred: "
+                               "%s (tick retries)", shard, e)
+                barrier_records = []
+            if barrier_records:
+                rearmed = self._slice.adopt_barriers(barrier_records)
+                logger.info("shard %d: re-armed %d re-federation "
+                            "barrier(s)", shard, rearmed)
 
     # -- recovered-waiter adoption ---------------------------------------------
 

@@ -138,12 +138,14 @@ _PLAIN_ROUTES = {"/healthz": "healthz", "/version": "version",
                  "/addtpuslice": "addtpuslice",
                  "/removetpuslice": "removetpuslice",
                  "/slice/resize": "sliceresize",
+                 "/slice/barrier": "slicebarrier",
                  "/slicez": "slicez"}
 # Pure introspection requests (and renew heartbeats) would drown the
 # mount traces in the ring buffer; they are measured (histogram) but not
 # stored.
 _UNTRACED_ROUTES = {"healthz", "version", "tracez", "brokerz", "eventz",
-                    "fleetz", "renew", "slicez", "unknown"}
+                    "fleetz", "renew", "slicez", "slicebarrier",
+                    "unknown"}
 
 
 def _route_label(path: str) -> str:
@@ -620,6 +622,25 @@ class MasterGateway:
             if method != "POST":
                 return self._method_not_allowed("POST", method, p)
             return self._slice_resize(body, rid, ctx)
+        if p == "/slice/barrier":
+            if method == "GET":
+                group = (query.get("group") or [""])[0]
+                if not group:
+                    return 400, {"result": "BadRequest",
+                                 "message": "?group= is required"}
+                # sharded deployments: a ?namespace= (BarrierClient
+                # sends the member's) routes the poll to the shard
+                # leader that owns the barrier, like every slice route
+                namespace = (query.get("namespace") or [""])[0]
+                if namespace:
+                    gate = self._shard_gate(namespace, method, path,
+                                            body, rid, ctx)
+                    if gate is not None:
+                        return gate
+                return self.slices.barrier_status(group)
+            if method != "POST":
+                return self._method_not_allowed("GET, POST", method, p)
+            return self._slice_barrier_join(body, rid, ctx)
         if p == "/slicez":
             if method != "GET":
                 return self._method_not_allowed("GET", method, p)
@@ -858,6 +879,43 @@ class MasterGateway:
             # pre-fan-out rejection: no host was touched
             return 412, {"result": "TopologyMismatch",
                          "message": str(e)}
+
+    def _slice_barrier_join(self, body: bytes, rid: str = "-",
+                            ctx: dict | None = None) -> tuple[int, dict]:
+        """``POST /slice/barrier`` — a slice member announces it has
+        drained and torn down its old backend and is ready to federate
+        at the named generation (jaxcheck/federation.py; protocol in
+        master/slicetxn.py barrier_join). Not an attach: no admission —
+        the chips were granted when the generation's txn committed —
+        but shard-gated like every slice route: a join landing on a
+        non-leader replica would lazily arm a split-brain barrier."""
+        try:
+            obj = json.loads(body or b"{}")
+            if not isinstance(obj, dict):
+                raise ValueError("body must be a JSON object")
+            group = obj.get("group")
+            member = obj.get("member")
+            generation = obj.get("generation")
+            if not group or not isinstance(group, str):
+                raise ValueError('"group" (string) is required')
+            if not member or not isinstance(member, str) \
+                    or "/" not in member:
+                raise ValueError('"member" ("namespace/pod") is '
+                                 "required")
+            if not isinstance(generation, int) \
+                    or isinstance(generation, bool):
+                raise ValueError('"generation" (integer) is required')
+            address = obj.get("address") or ""
+            if not isinstance(address, str):
+                raise ValueError('"address" must be a string')
+        except ValueError as e:
+            return 400, {"result": "BadRequest", "message": str(e)}
+        gate = self._shard_gate(member.split("/", 1)[0], "POST",
+                                "/slice/barrier", body, rid, ctx)
+        if gate is not None:
+            return gate
+        return self.slices.barrier_join(group, generation, member,
+                                        address)
 
     def _slice_resize(self, body: bytes, rid: str = "-",
                       ctx: dict | None = None) -> tuple[int, dict]:

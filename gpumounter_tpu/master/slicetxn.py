@@ -95,6 +95,25 @@ class _LiveTxn:
         self.adopted = adopted
 
 
+class _Barrier:
+    """One slice group's re-federation barrier: the set of members that
+    must re-federate at ``generation`` before ANY member may restore
+    (jaxcheck/federation.py is the member side). Membership order IS the
+    federation plan's process-id assignment."""
+
+    __slots__ = ("group", "generation", "members", "joined",
+                 "armed_unix", "completed_unix", "plan")
+
+    def __init__(self, group: str, generation: int, members: list[str]):
+        self.group = group
+        self.generation = int(generation)
+        self.members = list(members)          # ordered "ns/pod" keys
+        self.joined: dict[str, str] = {}      # member -> proposed address
+        self.armed_unix = time.time()
+        self.completed_unix: float | None = None
+        self.plan: dict | None = None
+
+
 class SliceTxnManager:
     """Owns every slice transaction a gateway runs (attach, resize,
     adoption, group detach). One per gateway; the broker binds it
@@ -114,6 +133,11 @@ class SliceTxnManager:
         # the lease table (a detached member leaves its group with no
         # bookkeeping to desync)
         self._groups: dict[str, dict] = {}
+        # group id -> _Barrier: the re-federation barrier armed on every
+        # generation bump (and on a fresh group's commit). Persisted to
+        # the intent store so a failed-over leader re-arms it; every
+        # state change crosses _barrier_transition (lint-pinned).
+        self._barriers: dict[str, _Barrier] = {}
         # test seam: chaos crash points between hosts of one fan-out
         self.before_host_attach = None
         # Slice self-healing (node failure domain): spare-pod discovery
@@ -407,10 +431,17 @@ class SliceTxnManager:
             self._ensure_group_info(
                 lease_group, self.broker.leases.group_leases(lease_group))
         with self._lock:
+            created = lease_group not in self._groups
             group = self._groups.setdefault(
                 lease_group, {"generation": 1,
                               "tpus_per_host": tpus_per_host})
             group["tpus_per_host"] = tpus_per_host
+            generation = group["generation"]
+        if created:
+            # a brand-new slice: arm the generation-1 barrier so the
+            # members' INITIAL federation rides the same protocol as
+            # every later resize (membership order = the txn's pod list)
+            self._arm_barrier(lease_group, pods, generation)
         self._unpersist_txn(txn.record)
         outcome = "adopted_commit" if txn.adopted else "commit"
         REGISTRY.slice_txns.inc(outcome=outcome)
@@ -816,6 +847,7 @@ class SliceTxnManager:
                     self.broker.release(result.namespace, result.pod)
         for lease in self.broker.leases.group_leases(group):
             self.broker.fence_lease(lease, reason="slice-teardown")
+        self._drop_barrier(group, reason="torn-down")
         with self._lock:
             self._repair_counts.pop(group, None)
         REGISTRY.slice_repairs.inc(outcome="torn_down")
@@ -828,6 +860,268 @@ class SliceTxnManager:
         self.broker.signal_capacity()
         self.broker.poke_peers()
         return {"outcome": "torn_down", "group": group}
+
+    # -- re-federation barrier (jaxcheck/federation.py is the member side) -----
+
+    def _barrier_transition(self, transition: str, group: str,
+                            generation: int, **fields) -> None:
+        """THE barrier observability seam (tests/test_federation_lint.py
+        pins it): every barrier state change crosses here, emitting its
+        paired metric + event — a silent transition would blind the
+        doctor's stuck-barrier check exactly when a member died
+        mid-resize."""
+        REGISTRY.slice_barriers.inc(transition=transition)
+        EVENTS.emit("slice_barrier", transition=transition, group=group,
+                    generation=generation, **fields)
+
+    def _persist_barrier(self, barrier: _Barrier) -> None:
+        store = self.broker.store
+        if store is None:
+            return
+        from gpumounter_tpu.master.store import SliceBarrierRecord
+        try:
+            store.put_barrier(SliceBarrierRecord(
+                group=barrier.group, generation=barrier.generation,
+                members=list(barrier.members),
+                created_unix=round(barrier.armed_unix, 3),
+                plan=dict(barrier.plan or {}),
+                completed_unix=(round(barrier.completed_unix, 3)
+                                if barrier.completed_unix else 0.0)))
+        except StoreFencedError as e:
+            self.broker._on_fenced(e)
+
+    def _unpersist_barrier(self, group: str, namespace: str) -> None:
+        store = self.broker.store
+        if store is None or not namespace:
+            return
+        try:
+            store.delete_barrier(namespace, group)
+        except StoreFencedError as e:
+            self.broker._on_fenced(e)
+
+    def _arm_barrier(self, group: str, members, generation: int,
+                     rearmed: bool = False) -> None:
+        """Open (or replace) the group's barrier for ``generation``.
+        ``members`` is the ORDERED new membership — [(ns, pod), ...] or
+        "ns/pod" keys; the order becomes the federation plan's process
+        ids. An incomplete older barrier is superseded — exactly how a
+        dead member's stuck barrier resolves once the control plane
+        moves the generation again (operator resize or repair_group)."""
+        keys = [m if isinstance(m, str) else _pod_key(*m)
+                for m in members]
+        barrier = _Barrier(group, generation, keys)
+        with self._lock:
+            old = self._barriers.get(group)
+            if old is not None and old.generation > barrier.generation:
+                # generations are monotone: never let a stale arm (an
+                # adopted record racing a concurrent resize's bump)
+                # regress the barrier — members joining the newer
+                # generation would be refused indefinitely
+                return
+            self._barriers[group] = barrier
+        if old is not None and old.completed_unix is None \
+                and old.generation != barrier.generation:
+            self._barrier_transition(
+                "superseded", group, old.generation,
+                superseded_by=barrier.generation,
+                joined=len(old.joined), expected=len(old.members))
+        self._barrier_transition(
+            "rearmed" if rearmed else "armed", group,
+            barrier.generation, expected=len(keys))
+        if not rearmed:
+            # a re-arm came FROM the store record; re-putting it would
+            # spend a CAS to write what is already there
+            self._persist_barrier(barrier)
+        self.export_gauges()
+
+    def _drop_barrier(self, group: str, reason: str) -> None:
+        """Retire a group's barrier (teardown / full detach): the group
+        is gone, so nobody can ever complete it."""
+        with self._lock:
+            barrier = self._barriers.pop(group, None)
+        if barrier is None:
+            return
+        if barrier.completed_unix is None:
+            self._barrier_transition(
+                "superseded", group, barrier.generation, reason=reason,
+                joined=len(barrier.joined),
+                expected=len(barrier.members))
+        namespace = barrier.members[0].split("/", 1)[0] \
+            if barrier.members else ""
+        self._unpersist_barrier(group, namespace)
+
+    def adopt_barriers(self, records) -> int:
+        """Re-arm barriers a dead (or deposed) leader persisted. An
+        INCOMPLETE barrier re-arms with the joined set empty — members
+        re-join idempotently, which is cheap next to a lost barrier
+        (members would wait forever on a coordinator that no longer
+        answers). A COMPLETED record restores its frozen plan verbatim:
+        members still polling (or blocked in initialize waiting on one
+        that is) must receive the same plan, never a fresh barrier
+        nobody can complete. The leader-death failure modes of the
+        resize protocol."""
+        adopted = 0
+        for record in records:
+            with self._lock:
+                current = self._barriers.get(record.group)
+                if current is not None \
+                        and current.generation >= record.generation:
+                    continue
+            self._arm_barrier(record.group, list(record.members),
+                              int(record.generation), rearmed=True)
+            if record.completed_unix and record.plan:
+                # the barrier had already COMPLETED when its leader
+                # died: restore the frozen plan so members still
+                # polling for it (or blocked in initialize waiting on
+                # a peer that is) get the SAME answer, not a fresh
+                # barrier nobody can complete
+                with self._lock:
+                    barrier = self._barriers.get(record.group)
+                    if barrier is not None and \
+                            barrier.generation == record.generation:
+                        barrier.joined = {m: "" for m in
+                                          barrier.members}
+                        barrier.plan = dict(record.plan)
+                        barrier.completed_unix = record.completed_unix
+            adopted += 1
+        return adopted
+
+    def barrier_join(self, group: str, generation: int, member: str,
+                     address: str = "") -> tuple[int, dict]:
+        """A member announces it has drained, torn down its old backend,
+        and stands ready to federate at ``generation``. Stale (or
+        future) generations and non-members are REFUSED — a stale
+        process must never corrupt the new world. The join completing
+        the barrier computes the federation plan every poller receives:
+        ordered membership (= process ids), world size, coordinator =
+        member 0's proposed address."""
+        generation = int(generation)
+        with self._lock:
+            barrier = self._barriers.get(group)
+        if barrier is None:
+            # group alive but no armed barrier (master restarted with no
+            # store, or the group predates the protocol): lazily re-arm
+            # at the group's CURRENT generation from the lease table —
+            # the control plane stays the source of truth
+            members = self.broker.leases.group_leases(group)
+            if not members:
+                return 404, {"result": "SliceNotFound", "group": group}
+            info = self._ensure_group_info(group, members)
+            self._arm_barrier(
+                group,
+                sorted(_pod_key(m.namespace, m.pod) for m in members),
+                int(info.get("generation", 1)), rearmed=True)
+        # validation AND mutation under ONE lock acquisition, against a
+        # RE-FETCHED barrier: a generation bump may have swapped the
+        # map entry since the read above — mutating the superseded
+        # object would complete a dead barrier and hand this member a
+        # stale federation plan (the mixed-generation world the whole
+        # protocol exists to forbid)
+        completed = False
+        with self._lock:
+            barrier = self._barriers.get(group)
+            if barrier is None:
+                refusal = ("gone", None)
+            elif generation != barrier.generation:
+                refusal = ("generation", barrier.generation)
+            elif member not in barrier.members:
+                refusal = ("member", barrier.generation)
+            else:
+                refusal = None
+                if barrier.completed_unix is None:
+                    barrier.joined[member] = address or ""
+                    if len(barrier.joined) == len(barrier.members):
+                        barrier.completed_unix = time.time()
+                        barrier.plan = {
+                            "coordinator":
+                                barrier.joined[barrier.members[0]],
+                            "num_processes": len(barrier.members),
+                            "members": list(barrier.members),
+                        }
+                        completed = True
+                joined = len(barrier.joined)
+                expected = len(barrier.members)
+                armed_unix = barrier.armed_unix
+        if refusal is not None and refusal[0] == "gone":
+            return 404, {"result": "SliceNotFound", "group": group}
+        if refusal is not None and refusal[0] == "generation":
+            current = refusal[1]
+            stale = generation < current
+            self._barrier_transition(
+                "refused", group, generation, member=member,
+                reason="stale-generation" if stale
+                else "unknown-generation", current=current)
+            return 409, {
+                "result": "StaleGeneration" if stale
+                          else "UnknownGeneration",
+                "current": current,
+                "message": f"barrier is at generation "
+                           f"{current}, not {generation}"
+                           + (" — drain and rejoin at the current "
+                              "generation" if stale else "")}
+        if refusal is not None:
+            self._barrier_transition(
+                "refused", group, generation, member=member,
+                reason="not-a-member")
+            return 403, {"result": "NotAMember",
+                         "generation": refusal[1],
+                         "members": list(barrier.members),
+                         "message": f"{member} is not in generation "
+                                    f"{generation}'s membership"}
+        self._barrier_transition(
+            "join", group, generation, member=member,
+            joined=joined, expected=expected)
+        if completed:
+            self._barrier_transition(
+                "complete", group, generation,
+                waited_s=round(time.time() - armed_unix, 3))
+            # persist the COMPLETED barrier (plan included) instead of
+            # deleting it: a leader death between the completing join
+            # and a slow member's next status poll must not lose the
+            # plan — members already inside jax.distributed.initialize
+            # are waiting on that member, and a fresh lazily-re-armed
+            # barrier could never complete. The record is reclaimed at
+            # the next arm (same annotation key) or the group's drop.
+            self._persist_barrier(barrier)
+            self.export_gauges()
+        return 200, self._barrier_payload(barrier)
+
+    def barrier_status(self, group: str) -> tuple[int, dict]:
+        with self._lock:
+            barrier = self._barriers.get(group)
+        if barrier is None:
+            return 404, {"result": "BarrierNotFound", "group": group}
+        return 200, self._barrier_payload(barrier)
+
+    def _barrier_payload(self, barrier: _Barrier) -> dict:
+        with self._lock:
+            # field snapshot under the lock: a concurrent join mutates
+            # the joined dict — iterating it unlocked can crash a
+            # /slicez scrape mid-resize
+            members = list(barrier.members)
+            joined = dict(barrier.joined)
+            completed_unix = barrier.completed_unix
+            plan = dict(barrier.plan or {})
+            generation = barrier.generation
+            armed_unix = barrier.armed_unix
+        age = time.time() - armed_unix
+        payload = {
+            "group": barrier.group,
+            "generation": generation,
+            "expected": len(members),
+            "members": members,
+            "joined": sorted(joined),
+            "complete": completed_unix is not None,
+            "age_s": round(age, 3),
+        }
+        if completed_unix is None:
+            payload["missing"] = [m for m in members
+                                  if m not in joined]
+            payload["stuck"] = bool(
+                age > self.broker.config.resize_barrier_timeout_s)
+        else:
+            payload["plan"] = plan
+        return payload
 
     # -- live mesh reshaping (POST /slice/resize) ------------------------------
 
@@ -946,6 +1240,10 @@ class SliceTxnManager:
             info["generation"] += 1
             info["tpus_per_host"] = tpus_per_host
             generation = info["generation"]
+        # arm the re-federation barrier BEFORE the generation becomes
+        # visible anywhere (annotations, /slicez): a member that reads
+        # the new generation must find a barrier to join
+        self._arm_barrier(group, members, generation)
         # the informer-path signal: every member pod's annotation moves
         # only AFTER the new chip set is fully actuated, so an elastic
         # job that drains on the bump never reshapes onto a half-slice
@@ -1025,12 +1323,29 @@ class SliceTxnManager:
         with self._lock:
             in_flight = {txn.record.group or txn.record.txn_id
                          for txn in self._txns.values()}
-            for group in list(self._groups):
-                if group not in live and group not in in_flight:
-                    del self._groups[group]
+            gone = [group for group in self._groups
+                    if group not in live and group not in in_flight]
+            for group in gone:
+                del self._groups[group]
             pending = len(self._txns)
             oldest = min((txn.started for txn in self._txns.values()),
                          default=None)
+        # a fully-detached group's barrier can never complete — retire
+        # it with its registry entry. Swept from the BARRIER map, not
+        # just pruned _groups entries: an adopted barrier whose group
+        # was torn down before the failover has no registry entry at
+        # all, and must not page the stuck alert (or re-adopt its
+        # store record) forever
+        with self._lock:
+            orphaned = [group for group in self._barriers
+                        if group not in live and group not in in_flight]
+        for group in orphaned:
+            self._drop_barrier(group, reason="group-gone")
+        with self._lock:
+            incomplete = sum(
+                1 for barrier in self._barriers.values()
+                if barrier.completed_unix is None)
+        REGISTRY.slice_barriers_incomplete.set(incomplete)
         REGISTRY.slice_txns_pending.set(pending)
         REGISTRY.slice_txn_oldest_age.set(
             0.0 if oldest is None else round(now - oldest, 3))
@@ -1055,6 +1370,7 @@ class SliceTxnManager:
     def snapshot(self) -> dict:
         now = time.monotonic()
         groups_out: dict[str, dict] = {}
+        stuck_barriers = 0
         for group, members in sorted(self.broker.leases.groups().items()):
             # recovering lookup: after a restart/failover the generation
             # comes back from the member annotations (cached after the
@@ -1072,6 +1388,17 @@ class SliceTxnManager:
                                      is None else round(r, 1)),
                 } for lease in members],
             }
+            with self._lock:
+                barrier = self._barriers.get(group)
+            if barrier is not None and barrier.completed_unix is None:
+                # only WAITING barriers render (a completed barrier is
+                # history, and its absence keeps pre-barrier payloads
+                # byte-for-byte) — the stuck flag + missing member
+                # names are what doctor and `slice status` surface
+                payload = self._barrier_payload(barrier)
+                groups_out[group]["barrier"] = payload
+                if payload.get("stuck"):
+                    stuck_barriers += 1
         with self._lock:
             txns = [{
                 "txn_id": txn.record.txn_id, "rid": txn.record.rid,
@@ -1092,4 +1419,5 @@ class SliceTxnManager:
             },
             "gang_queue_depth": int(
                 REGISTRY.gang_queue_depth.value()),
+            "stuck_barriers": stuck_barriers,
         }

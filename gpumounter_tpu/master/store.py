@@ -216,6 +216,53 @@ class SliceTxnRecord:
         return record
 
 
+@dataclasses.dataclass
+class SliceBarrierRecord:
+    """A slice group's re-federation barrier (master/slicetxn.py),
+    armed when the mesh generation bumps and updated with the frozen
+    plan when every member of the NEW generation has re-federated.
+    Persisted beside the slice txn records so the barrier survives the
+    arming leader: a failed-over peer re-arms an incomplete one from
+    this record (the joined set restarts empty — members re-join
+    idempotently, and a join is cheap next to a lost barrier, which
+    would let a member restore into a half-formed world) and restores
+    a completed one's plan verbatim (members still polling for it must
+    get the SAME answer)."""
+
+    group: str
+    generation: int
+    # ordered "namespace/pod" membership of the NEW generation — the
+    # order IS the federation plan's process-id assignment
+    members: list[str] = dataclasses.field(default_factory=list)
+    created_unix: float = 0.0
+    # set once the barrier COMPLETED: the federation plan members poll
+    # for. Persisted (rather than deleting the record) so a leader
+    # death between the completing join and a slow member's next poll
+    # cannot lose the plan — the record is reclaimed at the next
+    # generation's arm (same annotation key) or the group's teardown.
+    plan: dict = dataclasses.field(default_factory=dict)
+    completed_unix: float = 0.0
+
+    @property
+    def namespace(self) -> str:
+        return self.members[0].split("/", 1)[0] if self.members else ""
+
+    @property
+    def annotation_key(self) -> str:
+        return consts.STORE_BARRIER_ANNOTATION_PREFIX + _digest(self.group)
+
+    def to_json(self) -> str:
+        return _canonical(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, text: str) -> "SliceBarrierRecord":
+        obj = json.loads(text)
+        record = cls(**obj)
+        if not record.group or not record.members:
+            raise ValueError(f"barrier record missing identity: {text!r}")
+        return record
+
+
 class IntentStore:
     """Write-through persistence of broker intent, sharded by namespace.
 
@@ -372,6 +419,14 @@ class IntentStore:
 
     def delete_slice_txn(self, namespace: str, txn_id: str) -> bool:
         key = consts.STORE_SLICE_ANNOTATION_PREFIX + _digest(txn_id)
+        return self._mutate(self.shard_of(namespace), key, None)
+
+    def put_barrier(self, record: SliceBarrierRecord) -> bool:
+        return self._mutate(self.shard_of(record.namespace),
+                            record.annotation_key, record.to_json())
+
+    def delete_barrier(self, namespace: str, group: str) -> bool:
+        key = consts.STORE_BARRIER_ANNOTATION_PREFIX + _digest(group)
         return self._mutate(self.shard_of(namespace), key, None)
 
     # -- group commit (the coalescer seam) -------------------------------------
@@ -829,6 +884,11 @@ class IntentStore:
         REGISTRY.store_records.set(waiters, kind="waiter",
                                    shard=str(shard))
         REGISTRY.store_records.set(slices, kind="slice", shard=str(shard))
+        barriers = sum(
+            1 for k in annotations
+            if k.startswith(consts.STORE_BARRIER_ANNOTATION_PREFIX))
+        REGISTRY.store_records.set(barriers, kind="barrier",
+                                   shard=str(shard))
 
     # -- rehydration -----------------------------------------------------------
 
@@ -899,6 +959,38 @@ class IntentStore:
         if torn:
             self.torn_records += torn
         self._export_records(shard)
+        return records, torn
+
+    def rehydrate_barriers(self, shard: int
+                           ) -> tuple[list[SliceBarrierRecord], int]:
+        """The shard's persisted re-federation barriers: (records,
+        torn). A record here after a failover is a barrier whose arming
+        leader died — the adopting leader re-arms it
+        (master/slicetxn.py adopt_barriers) so waiting members keep a
+        source of truth; torn records are counted and dropped (the next
+        generation bump re-creates the barrier)."""
+        try:
+            cm = self.kube.get_config_map(self.namespace,
+                                          self.cm_name(shard))
+        except K8sApiError as e:
+            if e.status == 404:
+                return [], 0
+            raise
+        self._remember(shard, cm)
+        annotations = dict(cm.get("metadata", {}).get("annotations") or {})
+        records: list[SliceBarrierRecord] = []
+        torn = 0
+        for key, value in annotations.items():
+            if not key.startswith(consts.STORE_BARRIER_ANNOTATION_PREFIX):
+                continue
+            try:
+                records.append(SliceBarrierRecord.from_json(value))
+            except (ValueError, TypeError) as e:
+                torn += 1
+                logger.warning("torn barrier record %s dropped (%s)",
+                               key, e)
+        if torn:
+            self.torn_records += torn
         return records, torn
 
     # -- introspection ---------------------------------------------------------

@@ -448,7 +448,7 @@ def assert_broker_invariants(broker, sim, store=None,
 
 
 def assert_slice_invariants(broker, sims, store=None,
-                            health=None) -> None:
+                            health=None, kube=None) -> None:
     """The elastic-slice contract after any slice chaos plan (leader
     killed mid-fan-out, competing gangs, resize races): **zero
     half-attached slices**, judged against cluster ground truth across
@@ -469,6 +469,15 @@ def assert_slice_invariants(broker, sims, store=None,
     5. ``health`` given: the node-death clauses
        (:func:`assert_node_death_invariants`) — no lease on a dead
        node, no group mixing fenced and live members.
+    6. Re-federation barrier sanity (master/slicetxn.py): joined ⊆
+       membership, and a COMPLETE barrier has every member joined — a
+       barrier that answered "complete" to a subset is exactly the
+       mixed-generation world the protocol forbids.
+    7. ``kube`` given (the master's apiserver view): **no
+       mixed-generation world** — every member pod of a group carries
+       the same ``tpumounter.io/mesh-generation`` annotation (where
+       stamped); two members steering by different generations would
+       hang each other's collectives.
     """
     from gpumounter_tpu.k8s import objects
     from gpumounter_tpu.utils import consts
@@ -514,6 +523,38 @@ def assert_slice_invariants(broker, sims, store=None,
     assert not gangs, \
         f"{len(gangs)} gang waiter(s) still parked: " \
         f"{[w.rid for w in gangs]}"
+    manager = getattr(broker, "_slice", None)
+    if manager is not None:
+        with manager._lock:
+            barriers = {group: (set(b.joined), set(b.members),
+                                b.completed_unix is not None,
+                                b.generation)
+                        for group, b in manager._barriers.items()}
+        for group, (joined, members, complete, gen) in \
+                sorted(barriers.items()):
+            assert joined <= members, \
+                f"barrier for group {group} gen {gen} counts joins " \
+                f"from non-members: {sorted(joined - members)}"
+            if complete:
+                assert joined == members, \
+                    f"MIXED-GENERATION WORLD: barrier for group " \
+                    f"{group} gen {gen} answered complete with only " \
+                    f"{sorted(joined)} of {sorted(members)} joined"
+    if kube is not None:
+        for group, members in sorted(broker.leases.groups().items()):
+            generations: set[str] = set()
+            for lease in members:
+                try:
+                    pod = kube.get_pod(lease.namespace, lease.pod)
+                except Exception:  # noqa: BLE001 — absent pod carries
+                    continue       # no annotation to disagree with
+                raw = (pod.get("metadata", {}).get("annotations")
+                       or {}).get(consts.MESH_GENERATION_ANNOTATION)
+                if raw is not None:
+                    generations.add(raw)
+            assert len(generations) <= 1, \
+                f"MIXED-GENERATION WORLD: group {group} members " \
+                f"carry mesh generations {sorted(generations)}"
     if store is not None:
         stored: dict[tuple[str, str], int] = {}
         leftovers = []
@@ -533,6 +574,30 @@ def assert_slice_invariants(broker, sims, store=None,
         assert not leftovers, \
             f"slice txn record(s) outlived resolution: " \
             f"{[r.txn_id for r in leftovers]}"
+
+
+def assert_checkpoint_invariants(root: str) -> None:
+    """The sharded-checkpoint durability contract
+    (jaxcheck/drain.py), checkable at ANY instant of a transition:
+
+    1. If anything ever committed, the ``LATEST`` pointer names a
+       generation directory that still exists — **no checkpoint is
+       deleted while it is the sole surviving copy** (pruning runs only
+       in the commit path, after the successor is durable).
+    2. The committed generation validates end to end: manifest present
+       and well-formed, every named shard present with its checksum —
+       what a crashed member would restore at next boot is whole.
+    """
+    from gpumounter_tpu.jaxcheck import drain as drain_lib
+    latest = drain_lib.latest_generation(root)
+    if latest is None:
+        return                    # nothing ever committed: vacuous
+    gens = drain_lib.list_generations(root)
+    assert latest in gens, \
+        f"LATEST names gen-{latest} but only {gens} exist under " \
+        f"{root} — the sole surviving copy was deleted"
+    manifest = drain_lib._load_manifest(root, latest)
+    drain_lib._verify_shards(root, latest, manifest)
 
 
 def assert_invariants(rig, expected_uuids: set[str],

@@ -717,6 +717,7 @@ class MultiNodeStack:
     def _attach_drain(rig: WorkerRig) -> None:
         from gpumounter_tpu.worker.drain import DrainController
         rig.drain = DrainController(rig.sim.node)
+        rig.drain.register_flush(rig.service.flush_mesh_generation)
         rig.service.drain = rig.drain
 
     def _start_health(self, rig: WorkerRig, grpc_port: int):

@@ -137,6 +137,10 @@ class Settings:
     # gang may HOLD partially reserved hosts before handing them back
     # (anti-deadlock). Gangs only exist when queue_timeout_s > 0.
     gang_hold_s: float = consts.DEFAULT_GANG_HOLD_S
+    # Re-federation barrier (master/slicetxn.py): incomplete past this
+    # window = STUCK (doctor WARN naming the missing members).
+    resize_barrier_timeout_s: float = \
+        consts.DEFAULT_RESIZE_BARRIER_TIMEOUT_S
     # Worker-side mesh-generation notification files (worker/service.py):
     # directory stamped on every actuation; "" = disabled.
     mesh_gen_dir: str = ""
@@ -249,6 +253,13 @@ class Settings:
                 raise ValueError(
                     f"{consts.ENV_GANG_HOLD_S} must be > 0 (a gang that "
                     f"never hands back can deadlock a peer), got {t!r}")
+        if t := env.get(consts.ENV_RESIZE_BARRIER_TIMEOUT_S):
+            s.resize_barrier_timeout_s = float(t)
+            if s.resize_barrier_timeout_s <= 0:
+                raise ValueError(
+                    f"{consts.ENV_RESIZE_BARRIER_TIMEOUT_S} must be "
+                    "> 0 seconds (a barrier that can never be judged "
+                    f"stuck hides dead members forever), got {t!r}")
         s.mesh_gen_dir = env.get(consts.ENV_MESH_GEN_DIR, "")
         if t := env.get(consts.ENV_MASTER_SHARDS):
             s.master_shards = int(t)

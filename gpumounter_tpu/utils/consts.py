@@ -315,6 +315,21 @@ ENV_MESH_GEN_DIR = "TPU_MESH_GEN_DIR"
 # once the slice's NEW chip set is fully actuated — the informer-path
 # generation signal (the alternative to the worker's notification file).
 MESH_GENERATION_ANNOTATION = "tpumounter.io/mesh-generation"
+# Re-federation barrier records (master/slicetxn.py): one per slice
+# group, armed when the mesh generation bumps, persisted beside the
+# slice txn records so a failed-over leader re-arms it (the barrier is
+# control-plane truth, not any member's memory).
+STORE_BARRIER_ANNOTATION_PREFIX = "tpumounter.io/rb-"
+# How long a re-federation barrier may sit incomplete (members joined <
+# expected) before the control plane surfaces it as STUCK: doctor and
+# `tpumounterctl slice status` WARN with the missing member names, and
+# jaxcheck/federation.py members use the same window as their poll
+# deadline before re-checking for a superseded generation. A stuck
+# barrier is the signature of a member killed mid-resize — resolution
+# is a new generation (operator resize or slice self-healing), which
+# re-arms the barrier without the dead member.
+ENV_RESIZE_BARRIER_TIMEOUT_S = "TPU_RESIZE_BARRIER_TIMEOUT_S"
+DEFAULT_RESIZE_BARRIER_TIMEOUT_S = 120.0
 
 # --- Node failure domain (master/nodehealth.py, worker/drain.py) --------------
 # "1" (default): the master folds fleet scrape staleness with k8s Node

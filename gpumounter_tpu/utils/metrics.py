@@ -686,6 +686,29 @@ class Registry:
             "Slice transaction intent records older than their deadline "
             "with no resolver driving them — a crashed fan-out nobody "
             "adopted; doctor CRITs on any")
+        # Re-federation barrier (master/slicetxn.py): every state
+        # transition of a slice group's resize barrier, each paired with
+        # a `slice_barrier` event through the ONE _barrier_transition
+        # seam (tests/test_federation_lint.py pins the pairing). armed =
+        # a generation bump opened a new barrier; join = a member
+        # re-federated; complete = the last member joined (the plan was
+        # handed out — members may now restore); refused = a
+        # stale-generation or non-member join was turned away; superseded
+        # = a newer generation replaced an incomplete barrier (how a
+        # dead member's stuck barrier resolves); rearmed = a failed-over
+        # leader restored the barrier from its intent-store record.
+        self.slice_barriers = Counter(
+            "tpumounter_slice_barriers_total",
+            "Re-federation barrier transitions by kind (armed/join/"
+            "complete/refused/superseded/rearmed)")
+        for transition in ("armed", "join", "complete", "refused",
+                           "superseded", "rearmed"):
+            self.slice_barriers.inc(0.0, transition=transition)
+        self.slice_barriers_incomplete = Gauge(
+            "tpumounter_slice_barriers_incomplete",
+            "Re-federation barriers with members joined < expected; one "
+            "older than TPU_RESIZE_BARRIER_TIMEOUT_S is STUCK (doctor "
+            "WARNs with the missing member names)")
         # Per-host attach latency INSIDE a slice fan-out: the straggler
         # that sets the transaction's wall time was previously only a log
         # line; exemplars carry the rid so a bad bucket links to /tracez.

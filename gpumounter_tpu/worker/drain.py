@@ -66,6 +66,17 @@ class DrainController:
         self.completed_unix: float | None = None
         self.settled: bool | None = None
         self.refused = 0
+        # flush hooks run after settle, before the journal compact:
+        # durability work that must land once actuation is quiet but
+        # before the process goes (e.g. the service's mesh-generation
+        # notification flush — an elastic job's reshape signal must not
+        # die in the page cache with the worker)
+        self._flush_hooks: list = []
+
+    def register_flush(self, hook) -> None:
+        """Add a zero-arg callable to the post-settle flush sequence
+        (exceptions are logged, never abort the drain)."""
+        self._flush_hooks.append(hook)
 
     @property
     def draining(self) -> bool:
@@ -141,6 +152,11 @@ class DrainController:
             logger.error("drain window (%.0fs) expired with actuation "
                          "still in flight — the journal replay at next "
                          "boot resolves it", timeout_s)
+        for hook in self._flush_hooks:
+            try:
+                hook()
+            except Exception:    # noqa: BLE001 — a flush hiccup must
+                logger.exception("drain flush hook failed")   # not abort
         if journal is not None:
             try:
                 journal.compact()

@@ -392,6 +392,7 @@ def main() -> None:
                                              SpotTerminationWatcher)
     drainer = DrainController(settings.node_name,
                               default_timeout_s=settings.drain_timeout_s)
+    drainer.register_flush(service.flush_mesh_generation)
     service.drain = drainer
     _HealthHandler.drain = drainer
 

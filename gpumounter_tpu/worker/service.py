@@ -588,10 +588,42 @@ class TPUMountService:
             with os.fdopen(fd, "w") as f:
                 json_mod.dump({"generation": round(time.time(), 6),
                                "chips": sorted(chips)}, f)
+                f.flush()
+                # fsync'd like a checkpoint shard: the elastic job's
+                # reshape decision rides this file — a worker crash
+                # right after an actuation must not leave a stale (or
+                # torn) generation behind the chips' new reality
+                os.fsync(f.fileno())
             os.replace(tmp, path)
+            # the rename itself is only crash-durable once the DIRECTORY
+            # entry is synced — same discipline as the checkpoint
+            # writer's (jaxcheck/drain._atomic_write)
+            dir_fd = os.open(directory, os.O_RDONLY)
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
         except OSError as e:
             logger.warning("mesh-generation stamp for %s/%s failed: %s",
                            namespace, pod_name, e)
+
+    def flush_mesh_generation(self) -> None:
+        """Drain-time flush hook (worker/drain.py): fsync the
+        notification directory so every stamped generation file's name
+        is durable before the process exits — the settle-before-detach
+        contract includes the signal files elastic jobs steer by."""
+        directory = self.settings.mesh_gen_dir
+        if not directory:
+            return
+        import os
+        try:
+            fd = os.open(directory, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
 
     # -- attachment-record cache (detach resolution fast path) ----------------
 

@@ -29,15 +29,18 @@ def test_multimaster_config_scales_admission(monkeypatch):
     The window is shortened for suite time; the modeled RTT stays the
     shipped one so the measured ratio is the real configuration's.
 
-    One remeasure on a longer window before failing: the 2.5 s window
-    is noise-sensitive under whole-suite machine load (the dual run's
-    24 client threads share the GIL with whatever the box is doing),
-    and a transient squeeze must not read as an architecture
+    The remeasure-before-failing lives INSIDE measure_multimaster now
+    (it owns the assert, so an external retry could never run): on a
+    sub-bar ratio it re-measures BOTH topologies in the same run on a
+    doubled window — a same-run baseline, so suite/machine load hits
+    numerator and denominator alike (the 2.5 s window is
+    noise-sensitive under whole-suite load: the dual run's 24 client
+    threads share the GIL with whatever the box is doing, observed
+    1.79x). A transient squeeze must not read as an architecture
     regression — the bar itself stays 1.8x."""
-    out = bench.measure_multimaster(window_s=2.5)
-    if out["multimaster_scaling_x"] < 1.8:
-        out = bench.measure_multimaster(window_s=5.0)
+    out = bench.measure_multimaster(window_s=2.5, scaling_retries=2)
     assert out["multimaster_scaling_x"] >= 1.8
+    assert out["multimaster_scaling_retries"] <= 2
     assert out["multimaster_admission_cps_2"] > \
         out["multimaster_admission_cps_1"] > 0
     assert out["multimaster_store_write_rtt_s"] == \

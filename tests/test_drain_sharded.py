@@ -1,0 +1,282 @@
+"""Sharded checkpoint streaming (jaxcheck/drain.py): per-process shard
+files + committed manifest (generation, world size, SHA-256 checksums),
+atomic tmp→fsync→rename writes, restore resharding onto a different
+mesh, and the typed-error + last-good-rollback contract — a torn or
+missing shard can NEVER yield a partial tree, and no checkpoint is
+deleted while it is the sole surviving copy."""
+
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from gpumounter_tpu.jaxcheck import drain as drain_lib  # noqa: E402
+from gpumounter_tpu.testing.chaos import (  # noqa: E402
+    assert_checkpoint_invariants)
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("x",))
+
+
+def _tree(mesh, scale=1.0):
+    """A state-shaped pytree: a sharded matrix, a replicated vector, a
+    host scalar — the three placement classes a TrainState carries."""
+    matrix = jax.device_put(
+        np.arange(32, dtype=np.float32).reshape(8, 4) * scale,
+        NamedSharding(mesh, P("x", None)))
+    replicated = jax.device_put(np.ones(3, dtype=np.float32) * scale,
+                                NamedSharding(mesh, P()))
+    return {"matrix": matrix, "replicated": replicated,
+            "step": np.int64(7)}
+
+
+def _shardings(mesh):
+    return {"matrix": NamedSharding(mesh, P("x", None)),
+            "replicated": NamedSharding(mesh, P()), "step": None}
+
+
+def _drain(root, generation, scale=1.0, mesh_size=4):
+    mesh = _mesh(mesh_size)
+    drain_lib.drain_sharded(_tree(mesh, scale), root,
+                            generation)
+    return mesh
+
+
+def _values(tree):
+    return {key: np.asarray(jax.device_get(value))
+            for key, value in tree.items()}
+
+
+# -- roundtrip + resharding ----------------------------------------------------
+
+def test_sharded_roundtrip_reshards_onto_a_different_mesh(tmp_path):
+    root = str(tmp_path / "ckpt")
+    source = _mesh(4)
+    tree = _tree(source)
+    drain_lib.drain_sharded(tree, root, 1)
+    assert drain_lib.latest_generation(root) == 1
+    # restore onto an 8-device mesh: same values, new placement
+    target = _mesh(8)
+    restored = drain_lib.restore_sharded(root, _shardings(target),
+                                         expect_generation=1)
+    np.testing.assert_array_equal(_values(restored)["matrix"],
+                                  _values(tree)["matrix"])
+    np.testing.assert_array_equal(_values(restored)["replicated"],
+                                  _values(tree)["replicated"])
+    assert int(restored["step"]) == 7
+    assert restored["matrix"].sharding.mesh.devices.size == 8
+    assert_checkpoint_invariants(root)
+
+
+def test_restore_without_shardings_returns_host_tree(tmp_path):
+    root = str(tmp_path / "ckpt")
+    _drain(root, 1)
+    host = drain_lib.restore_sharded(root)
+    assert isinstance(host["matrix"], np.ndarray)
+    np.testing.assert_array_equal(
+        host["matrix"],
+        np.arange(32, dtype=np.float32).reshape(8, 4))
+
+
+def test_commit_keeps_current_plus_previous_generation_only(tmp_path):
+    root = str(tmp_path / "ckpt")
+    for generation in (1, 2, 3):
+        _drain(root, generation, scale=float(generation))
+    # gen-1 pruned at gen-3's commit; gen-2 is the rollback target
+    assert drain_lib.list_generations(root) == [2, 3]
+    assert drain_lib.latest_generation(root) == 3
+    assert_checkpoint_invariants(root)
+
+
+def test_prune_spares_the_newest_COMMITTED_generation(tmp_path):
+    """A torn dir a crashed transition left behind (shards, no
+    manifest) is junk, not a rollback target: pruning at the next
+    commit must spare the newest generation that actually COMMITTED —
+    sparing the torn dir instead would silently shorten the rollback
+    chain to nothing."""
+    root = str(tmp_path / "ckpt")
+    _drain(root, 1, scale=1.0)
+    # generation 2 tore mid-drain: a shard landed, the commit did not
+    gen2 = os.path.join(root, "gen-2")
+    os.makedirs(gen2)
+    with open(os.path.join(gen2, drain_lib._shard_name(0, 1)),
+              "wb") as f:
+        f.write(b"partial")
+    _drain(root, 3, scale=3.0)
+    # gen-1 (the real last-good) survives; torn gen-2 is the one pruned
+    assert drain_lib.list_generations(root) == [1, 3]
+    _, generation = drain_lib.restore_last_good(root)
+    assert generation == 3
+    assert_checkpoint_invariants(root)
+
+
+# -- typed errors + last-good rollback -----------------------------------------
+
+def test_truncated_shard_is_typed_and_rolls_back_to_last_good(tmp_path):
+    root = str(tmp_path / "ckpt")
+    _drain(root, 1, scale=1.0)
+    _drain(root, 2, scale=2.0)
+    shard = os.path.join(root, "gen-2",
+                         drain_lib._shard_name(0, 1))
+    with open(shard, "r+b") as f:
+        f.truncate(os.path.getsize(shard) // 2)
+    mesh = _mesh(4)
+    with pytest.raises(drain_lib.TornShardError):
+        drain_lib.restore_sharded(root, _shardings(mesh))
+    # the rollback: generation 1 restores whole — never a partial tree
+    tree, generation = drain_lib.restore_last_good(root,
+                                                   _shardings(mesh))
+    assert generation == 1
+    np.testing.assert_array_equal(
+        _values(tree)["matrix"],
+        np.arange(32, dtype=np.float32).reshape(8, 4))
+    # and the failed restore deleted NOTHING (lint-pinned path)
+    assert drain_lib.list_generations(root) == [1, 2]
+
+
+def test_corrupt_manifest_is_typed_and_rolls_back(tmp_path):
+    root = str(tmp_path / "ckpt")
+    _drain(root, 1, scale=1.0)
+    _drain(root, 2, scale=2.0)
+    with open(os.path.join(root, "gen-2", "manifest.json"), "w") as f:
+        f.write("{not json")
+    with pytest.raises(drain_lib.ManifestError):
+        drain_lib.restore_sharded(root)
+    _, generation = drain_lib.restore_last_good(root)
+    assert generation == 1
+
+
+def test_checksum_mismatch_is_torn(tmp_path):
+    root = str(tmp_path / "ckpt")
+    _drain(root, 1)
+    shard = os.path.join(root, "gen-1", drain_lib._shard_name(0, 1))
+    data = bytearray(open(shard, "rb").read())
+    data[len(data) // 2] ^= 0xFF        # same size, different bytes
+    with open(shard, "wb") as f:
+        f.write(bytes(data))
+    with pytest.raises(drain_lib.TornShardError, match="checksum"):
+        drain_lib.restore_sharded(root)
+
+
+def test_wrong_generation_is_typed(tmp_path):
+    root = str(tmp_path / "ckpt")
+    _drain(root, 2)
+    with pytest.raises(drain_lib.WrongGenerationError):
+        drain_lib.restore_sharded(root, expect_generation=3)
+    # without the expectation the checkpoint is fine
+    assert drain_lib.restore_sharded(root) is not None
+
+
+def test_crash_before_manifest_leaves_last_good_committed(tmp_path):
+    """A member crashed mid-drain of generation 2: its shard file
+    landed but process 0 never committed. LATEST still names
+    generation 1 — the next boot restores it; nothing is torn."""
+    root = str(tmp_path / "ckpt")
+    mesh = _drain(root, 1, scale=1.0)
+    # generation 2's shard write happened, commit did not
+    gen2 = os.path.join(root, "gen-2")
+    os.makedirs(gen2)
+    with open(os.path.join(gen2, drain_lib._shard_name(0, 1)),
+              "wb") as f:
+        f.write(b"partial")
+    assert drain_lib.latest_generation(root) == 1
+    tree = drain_lib.restore_sharded(root, _shardings(mesh),
+                                     expect_generation=1)
+    assert int(tree["step"]) == 7
+    assert_checkpoint_invariants(root)
+    # last-good walks PAST the uncommitted gen-2 without tripping
+    _, generation = drain_lib.restore_last_good(root)
+    assert generation == 1
+
+
+def test_empty_root_is_no_checkpoint(tmp_path):
+    with pytest.raises(drain_lib.NoCheckpointError):
+        drain_lib.restore_sharded(str(tmp_path / "nothing"))
+    with pytest.raises(drain_lib.NoCheckpointError):
+        drain_lib.restore_last_good(str(tmp_path / "nothing"))
+
+
+def test_invariants_catch_a_deleted_sole_copy(tmp_path):
+    """The chaos clause itself: LATEST naming a deleted directory IS
+    the no-checkpoint-deleted-while-sole-copy violation."""
+    import shutil
+    root = str(tmp_path / "ckpt")
+    _drain(root, 1)
+    shutil.rmtree(os.path.join(root, "gen-1"))
+    with pytest.raises(AssertionError, match="sole surviving copy"):
+        assert_checkpoint_invariants(root)
+
+
+# -- manifest contents ---------------------------------------------------------
+
+def test_manifest_records_generation_world_and_checksums(tmp_path):
+    root = str(tmp_path / "ckpt")
+    _drain(root, 4)
+    with open(os.path.join(root, "gen-4", "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["format"] == drain_lib.SHARDED_FORMAT
+    assert manifest["generation"] == 4
+    assert manifest["process_count"] == 1
+    name = drain_lib._shard_name(0, 1)
+    assert set(manifest["shards"]) == {name}
+    meta = manifest["shards"][name]
+    assert meta["sha256"] == drain_lib._sha256(
+        os.path.join(root, "gen-4", name))
+    assert meta["bytes"] == os.path.getsize(
+        os.path.join(root, "gen-4", name))
+
+
+def test_shard_entries_deduplicate_replicas(tmp_path):
+    """A replicated leaf appears ONCE across all shard files (replica_id
+    == 0 only) — N identical copies would multiply checkpoint size by
+    the world size for nothing."""
+    root = str(tmp_path / "ckpt")
+    _drain(root, 1, mesh_size=8)
+    with open(os.path.join(root, "gen-1",
+                           drain_lib._shard_name(0, 1)), "rb") as f:
+        payload = pickle.load(f)
+    entries = payload["tree"]["replicated"]["entries"]
+    assert len(entries) == 1
+
+
+# -- legacy single-file path (the PR 15 fsync satellite) -----------------------
+
+def test_legacy_drain_is_atomic_and_leaves_no_tmp(tmp_path):
+    path = str(tmp_path / "ckpt" / "state.ckpt")
+    mesh = _mesh(4)
+    tree = _tree(mesh)
+    drain_lib.drain(tree, path)
+    restored = drain_lib.restore(path, _shardings(mesh))
+    np.testing.assert_array_equal(_values(restored)["matrix"],
+                                  _values(tree)["matrix"])
+    leftovers = [n for n in os.listdir(os.path.dirname(path))
+                 if n.endswith(".draining")]
+    assert leftovers == [], "tmp file outlived the atomic rename"
+
+
+def test_legacy_drain_failure_keeps_the_old_checkpoint(tmp_path,
+                                                       monkeypatch):
+    """A crash mid-write (fsync/rename never reached) must leave the
+    PREVIOUS checkpoint untouched — the torn tmp is discarded."""
+    path = str(tmp_path / "state.ckpt")
+    mesh = _mesh(4)
+    drain_lib.drain(_tree(mesh, scale=1.0), path)
+    good = open(path, "rb").read()
+    real_dumps = pickle.dumps
+
+    def exploding_dumps(*a, **k):
+        raise OSError("disk full mid-serialize")
+    monkeypatch.setattr(drain_lib.pickle, "dumps", exploding_dumps)
+    with pytest.raises(OSError):
+        drain_lib.drain(_tree(mesh, scale=2.0), path)
+    monkeypatch.setattr(drain_lib.pickle, "dumps", real_dumps)
+    assert open(path, "rb").read() == good
+    assert [n for n in os.listdir(str(tmp_path))
+            if n.endswith(".draining")] == []

@@ -1,0 +1,673 @@
+"""Crash-safe multi-process re-federation (ISSUE 15).
+
+Master side: the re-federation barrier (master/slicetxn.py) — armed on
+every mesh-generation bump (and a fresh slice's commit), joined by
+members over ``POST /slice/barrier``, completing into a federation plan
+(ordered membership = process ids, coordinator = member 0's address);
+stale-generation joins refused, incomplete barriers superseded by the
+next generation, persistence + lazy re-arm, stuck-barrier surfacing in
+/slicez, doctor and `tpumounterctl slice status`.
+
+Member side + acceptance: REAL subprocess members (CPU backend, gloo
+collectives, 2 virtual devices each) ride ``POST /slice/resize`` 2→4→2
+hosts with the loss trajectory and step counter intact, and a SIGKILLed
+member mid-resize leaves the barrier stuck until the control plane
+moves the generation past it — survivors roll back to the last-good
+checkpoint and re-form under a re-elected coordinator.
+"""
+
+import contextlib
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu import cli
+from gpumounter_tpu.master.admission import BrokerConfig
+from gpumounter_tpu.testing.chaos import (assert_checkpoint_invariants,
+                                          assert_slice_invariants)
+from gpumounter_tpu.utils.config import HostPaths
+from gpumounter_tpu.utils.events import EVENTS
+
+jax = pytest.importorskip("jax")
+
+
+def _host(tmp_path, i):
+    base = tmp_path / f"node{i}"
+    for sub in ("dev", "proc", "sys/fs/cgroup"):
+        (base / sub).mkdir(parents=True)
+    return HostPaths(dev_root=str(base / "dev"),
+                     proc_root=str(base / "proc"),
+                     sys_root=str(base / "sys"),
+                     cgroup_root=str(base / "sys" / "fs" / "cgroup"),
+                     kubelet_socket=str(base / "pr" / "kubelet.sock"))
+
+
+def _post(url, obj):
+    req = urllib.request.Request(url, data=json.dumps(obj).encode(),
+                                 method="POST")
+    try:
+        resp = urllib.request.urlopen(req, timeout=60)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _target(n, tpus=2, members=None):
+    pods = members if members is not None else list(range(n))
+    return {"pods": [{"namespace": "default", "pod": f"workload-{i}"}
+                     for i in pods], "tpusPerHost": tpus}
+
+
+def _stack(tmp_path, hosts=2, **kw):
+    from gpumounter_tpu.testing.sim import MultiNodeStack
+    return MultiNodeStack([_host(tmp_path, i) for i in range(hosts)],
+                          n_chips=2, **kw)
+
+
+def _join(base, group, gen, member, address="127.0.0.1:1"):
+    return _post(f"{base}/slice/barrier",
+                 {"group": group, "generation": gen,
+                  "member": member, "address": address})
+
+
+# ---------------------------------------------------------------------------
+# master-side barrier protocol
+# ---------------------------------------------------------------------------
+
+def test_slice_attach_arms_generation_one_barrier(tmp_path):
+    stack = _stack(tmp_path, hosts=2)
+    try:
+        status, body = _post(f"{stack.base}/addtpuslice", _target(2))
+        assert status == 200, body
+        group = body["group"]
+        status, barrier = _get(
+            f"{stack.base}/slice/barrier?group={group}")
+        assert status == 200
+        assert barrier["generation"] == 1
+        assert barrier["expected"] == 2
+        assert barrier["complete"] is False
+        assert barrier["missing"] == ["default/workload-0",
+                                      "default/workload-1"]
+        assert barrier["stuck"] is False
+        # the waiting barrier renders in /slicez (and nowhere else: a
+        # completed one vanishes, keeping pre-barrier payloads intact)
+        _, slicez = _get(f"{stack.base}/slicez")
+        assert slicez["groups"][group]["barrier"]["expected"] == 2
+        assert slicez["stuck_barriers"] == 0
+    finally:
+        stack.close()
+
+
+def test_barrier_completes_into_plan_and_refuses_stale(tmp_path):
+    stack = _stack(tmp_path, hosts=2)
+    try:
+        _, body = _post(f"{stack.base}/addtpuslice", _target(2))
+        group = body["group"]
+        status, out = _join(stack.base, group, 1, "default/workload-0",
+                            "127.0.0.1:4000")
+        assert status == 200 and out["complete"] is False
+        # joining is idempotent: a re-join refreshes the address
+        status, out = _join(stack.base, group, 1, "default/workload-0",
+                            "127.0.0.1:4001")
+        assert status == 200 and len(out["joined"]) == 1
+        status, out = _join(stack.base, group, 1, "default/workload-1",
+                            "127.0.0.1:5000")
+        assert status == 200 and out["complete"] is True
+        plan = out["plan"]
+        # ordered membership IS the process-id assignment; coordinator
+        # = member 0's LAST proposed address
+        assert plan["members"] == ["default/workload-0",
+                                   "default/workload-1"]
+        assert plan["num_processes"] == 2
+        assert plan["coordinator"] == "127.0.0.1:4001"
+        # resize bumps to generation 2 → the old generation is refused
+        status, body = _post(f"{stack.base}/slice/resize", {
+            "pods": [{"namespace": "default", "pod": "workload-0"}]})
+        assert status == 200 and body["generation"] == 2
+        status, out = _join(stack.base, group, 1, "default/workload-0")
+        assert status == 409 and out["result"] == "StaleGeneration"
+        assert out["current"] == 2
+        # a FUTURE generation is unknown, not stale
+        status, out = _join(stack.base, group, 7, "default/workload-0")
+        assert status == 409 and out["result"] == "UnknownGeneration"
+        # a pod resized out of the membership is refused by name
+        status, out = _join(stack.base, group, 2, "default/workload-1")
+        assert status == 403 and out["result"] == "NotAMember"
+        # and garbage is a 400, not a crash
+        status, out = _post(f"{stack.base}/slice/barrier",
+                            {"group": group, "generation": "x",
+                             "member": "default/workload-0"})
+        assert status == 400
+    finally:
+        stack.close()
+
+
+def test_new_generation_supersedes_incomplete_barrier(tmp_path):
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    stack = _stack(tmp_path, hosts=3)
+    try:
+        _, body = _post(f"{stack.base}/addtpuslice", _target(2))
+        group = body["group"]
+        status, _ = _join(stack.base, group, 1, "default/workload-0")
+        assert status == 200
+        superseded0 = REGISTRY.slice_barriers.series().get(
+            (("transition", "superseded"),), 0.0)
+        # limit=-1: an untruncated snapshot, so seq is the ring's TRUE
+        # newest (a truncated page's seq points at the page end — a
+        # full suite's ring would hand back a cursor deep in the past)
+        events0 = EVENTS.snapshot(limit=-1)["seq"]
+        _, body = _post(f"{stack.base}/slice/resize", _target(3))
+        assert body["generation"] == 2
+        _, barrier = _get(f"{stack.base}/slice/barrier?group={group}")
+        assert barrier["generation"] == 2
+        assert barrier["joined"] == []          # joins restart
+        assert barrier["expected"] == 3
+        # the supersede crossed the observability seam: metric + event
+        superseded1 = REGISTRY.slice_barriers.series().get(
+            (("transition", "superseded"),), 0.0)
+        assert superseded1 == superseded0 + 1
+        tail = [e for e in EVENTS.snapshot(since=events0,
+                                           limit=-1)["events"]
+                if e["kind"] == "slice_barrier"
+                and e["attrs"].get("group") == group
+                and e["attrs"].get("transition") == "superseded"]
+        assert len(tail) == 1 and tail[0]["attrs"]["generation"] == 1
+        assert tail[0]["attrs"]["superseded_by"] == 2
+    finally:
+        stack.close()
+
+
+def test_stuck_barrier_surfaces_in_slicez_doctor_and_cli(tmp_path):
+    stack = _stack(
+        tmp_path, hosts=2,
+        broker_config=BrokerConfig(resize_barrier_timeout_s=0.05))
+    try:
+        _, body = _post(f"{stack.base}/addtpuslice", _target(2))
+        group = body["group"]
+        _join(stack.base, group, 1, "default/workload-0")
+        time.sleep(0.1)
+        _, barrier = _get(f"{stack.base}/slice/barrier?group={group}")
+        assert barrier["stuck"] is True
+        assert barrier["missing"] == ["default/workload-1"]
+        _, slicez = _get(f"{stack.base}/slicez")
+        assert slicez["stuck_barriers"] == 1
+        # doctor WARNs, naming the missing member
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli.main(["--master", stack.base, "doctor"])
+        assert rc == 1, out.getvalue()
+        assert "barrier" in out.getvalue()
+        assert "default/workload-1" in out.getvalue()
+        # slice status renders it and exits non-zero
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli.main(["--master", stack.base, "slice", "status"])
+        assert rc == 1
+        assert "STUCK" in out.getvalue()
+        assert "default/workload-1" in out.getvalue()
+    finally:
+        stack.close()
+
+
+def test_barrier_rearms_lazily_after_state_loss(tmp_path):
+    """Coordinator death without a store: the restarted master has no
+    barrier state, but a member's join lazily re-arms one at the
+    group's CURRENT generation from the lease table — the control
+    plane stays the source of truth, not any process's memory."""
+    stack = _stack(tmp_path, hosts=2)
+    try:
+        _, body = _post(f"{stack.base}/addtpuslice", _target(2))
+        group = body["group"]
+        manager = stack.gateway.slices
+        with manager._lock:               # "restart": in-memory loss
+            manager._barriers.clear()
+        status, out = _join(stack.base, group, 1, "default/workload-1")
+        assert status == 200
+        assert out["generation"] == 1
+        assert out["joined"] == ["default/workload-1"]
+        # a stale join against the re-armed barrier is still refused
+        status, out = _join(stack.base, group, 0, "default/workload-1")
+        assert status == 409
+    finally:
+        stack.close()
+
+
+def test_barrier_record_rearms_from_the_store(tmp_path):
+    """A failed-over leader re-arms persisted barriers with an empty
+    joined set (adopt_barriers) — and ignores records older than what
+    it already carries."""
+    from gpumounter_tpu.master.store import SliceBarrierRecord
+    stack = _stack(tmp_path, hosts=2)
+    try:
+        _, body = _post(f"{stack.base}/addtpuslice", _target(2))
+        group = body["group"]
+        manager = stack.gateway.slices
+        record = SliceBarrierRecord(
+            group=group, generation=5,
+            members=["default/workload-0", "default/workload-1"],
+            created_unix=time.time())
+        assert manager.adopt_barriers([record]) == 1
+        _, barrier = _get(f"{stack.base}/slice/barrier?group={group}")
+        assert barrier["generation"] == 5 and barrier["joined"] == []
+        # an OLDER record does not clobber the newer in-memory barrier
+        stale = SliceBarrierRecord(
+            group=group, generation=2,
+            members=["default/workload-0"], created_unix=time.time())
+        assert manager.adopt_barriers([stale]) == 0
+        _, barrier = _get(f"{stack.base}/slice/barrier?group={group}")
+        assert barrier["generation"] == 5
+        roundtrip = SliceBarrierRecord.from_json(record.to_json())
+        assert roundtrip == record
+        # a COMPLETED record restores its frozen plan verbatim: members
+        # still polling (or blocked in initialize waiting on one that
+        # is) must receive the SAME plan, never a fresh barrier nobody
+        # can complete
+        done = SliceBarrierRecord(
+            group=group, generation=6,
+            members=["default/workload-0", "default/workload-1"],
+            created_unix=time.time(),
+            plan={"coordinator": "127.0.0.1:7777", "num_processes": 2,
+                  "members": ["default/workload-0",
+                              "default/workload-1"]},
+            completed_unix=time.time())
+        assert manager.adopt_barriers([done]) == 1
+        _, barrier = _get(f"{stack.base}/slice/barrier?group={group}")
+        assert barrier["complete"] is True
+        assert barrier["plan"]["coordinator"] == "127.0.0.1:7777"
+    finally:
+        stack.close()
+
+
+def test_teardown_retires_the_barrier(tmp_path):
+    stack = _stack(tmp_path, hosts=2)
+    try:
+        _, body = _post(f"{stack.base}/addtpuslice", _target(2))
+        group = body["group"]
+        _, body = _post(f"{stack.base}/removetpuslice", _target(2))
+        stack.gateway.slices.export_gauges()
+        status, _ = _get(f"{stack.base}/slice/barrier?group={group}")
+        assert status == 404
+        # a member mid-refederation when the group vanished gets the
+        # clean resized-out exit, not a transport-error crash
+        from gpumounter_tpu.jaxcheck import federation as fed
+        client = fed.BarrierClient(stack.base, group,
+                                   "default/workload-0")
+        with pytest.raises(fed.MembershipRefusedError):
+            client.join(1, "127.0.0.1:4000")
+        assert_slice_invariants(stack.gateway.broker,
+                                [r.sim for r in stack.rigs],
+                                kube=stack.master_kube)
+    finally:
+        stack.close()
+
+
+def test_orphan_adopted_barrier_is_swept(tmp_path):
+    """A barrier adopted for a group that no longer exists (torn down
+    before the failover) must be retired by the gauge sweep — not page
+    the stuck alert forever for a ghost."""
+    from gpumounter_tpu.master.store import SliceBarrierRecord
+    from gpumounter_tpu.utils.metrics import REGISTRY
+    stack = _stack(tmp_path, hosts=2)
+    try:
+        manager = stack.gateway.slices
+        ghost = SliceBarrierRecord(
+            group="txn-ghost", generation=4,
+            members=["default/gone-0", "default/gone-1"],
+            created_unix=time.time())
+        assert manager.adopt_barriers([ghost]) == 1
+        # the arm's own gauge pass already swept it: a ghost barrier
+        # never outlives the very call that adopted it
+        manager.export_gauges()
+        status, _ = _get(f"{stack.base}/slice/barrier?group=txn-ghost")
+        assert status == 404
+        assert REGISTRY.slice_barriers_incomplete.value() == 0
+    finally:
+        stack.close()
+
+
+# ---------------------------------------------------------------------------
+# the member side, in-process (fast paths of jaxcheck/federation.py)
+# ---------------------------------------------------------------------------
+
+def test_barrier_client_typed_refusals(tmp_path):
+    from gpumounter_tpu.jaxcheck import federation as fed
+    stack = _stack(tmp_path, hosts=2)
+    try:
+        _, body = _post(f"{stack.base}/addtpuslice", _target(2))
+        group = body["group"]
+        client = fed.BarrierClient(stack.base, group,
+                                   "default/workload-0")
+        out = client.join(1, "127.0.0.1:4000")
+        assert out["complete"] is False
+        _, body = _post(f"{stack.base}/slice/resize", {
+            "pods": [{"namespace": "default", "pod": "workload-0"}]})
+        assert body["generation"] == 2
+        with pytest.raises(fed.StaleGenerationError) as info:
+            client.join(1, "127.0.0.1:4000")
+        assert info.value.current == 2
+        other = fed.BarrierClient(stack.base, group,
+                                  "default/workload-1")
+        with pytest.raises(fed.MembershipRefusedError):
+            other.join(2, "127.0.0.1:5000")
+        # a generation AHEAD of the barrier is typed too (the member
+        # keeps its target and re-joins; never a transport OSError)
+        with pytest.raises(fed.UnknownGenerationError):
+            client.join(9, "127.0.0.1:4000")
+        # wait() on a superseded target raises the typed retarget too
+        with pytest.raises(fed.StaleGenerationError):
+            client.wait(1, timeout_s=1.0)
+        # and an incomplete barrier times out rather than hanging
+        with pytest.raises(fed.BarrierTimeoutError):
+            client.wait(2, timeout_s=0.3)
+    finally:
+        stack.close()
+
+
+def test_single_process_crash_between_drain_and_restore_resumes(
+        tmp_path):
+    """The sole-surviving-copy scenario: a harness crashes after the
+    sharded drain committed but before restore. The next boot
+    (start(resume=True) / MemberRunner's resume path) restores the
+    checkpoint instead of resetting the trajectory."""
+    from gpumounter_tpu.jaxcheck import federation as fed
+    from gpumounter_tpu.jaxcheck import model as model_lib
+    from gpumounter_tpu.jaxcheck import train as train_lib
+    import numpy as np
+    cfg = model_lib.ModelConfig(vocab=64, d_model=32, n_heads=4,
+                                n_layers=1, d_ff=64)
+    root = str(tmp_path / "ckpt")
+    signal_state = {"gen": 1, "chips": 4}
+
+    def harness():
+        return fed.FederatedElasticHarness(
+            cfg, lambda: signal_state["gen"],
+            lambda: signal_state["chips"],
+            refederator=fed.Refederator(None),
+            checkpoint_root=root,
+            optimizer=train_lib.make_optimizer(lr=1e-2),
+            step_factory=fed._default_step_factory)
+
+    first = harness().start()
+    tokens = np.asarray(train_lib.make_batch(
+        jax.random.PRNGKey(7), 4, 16, cfg.vocab))
+    for _ in range(5):
+        first.train_step(tokens)
+    assert int(first.state.step) == 5
+    embed = np.asarray(jax.device_get(first.state.params["embed"]))
+    # drain for the (never-completed) transition to generation 2 —
+    # then "crash": the checkpoint is the sole surviving copy
+    first._drain(2)
+    assert_checkpoint_invariants(root)
+    reborn = harness()
+    reborn._target_generation = 2
+    reborn.start(resume=True)
+    assert int(reborn.state.step) == 5              # not reset
+    np.testing.assert_array_equal(
+        embed, np.asarray(jax.device_get(reborn.state.params["embed"])))
+    assert reborn.restored_generation == 2
+    # a start WITHOUT resume still inits fresh (historical contract)
+    fresh = harness().start()
+    assert int(fresh.state.step) == 0
+
+
+# ---------------------------------------------------------------------------
+# the multi-process acceptance e2es (real subprocesses, gloo/CPU)
+# ---------------------------------------------------------------------------
+
+def _member_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.pop("TPU_EVENT_LOG", None)
+    return env
+
+
+def _spawn_member(base, group, i, tmp_path, *, hold_dir=None,
+                  barrier_timeout=6.0):
+    status = str(tmp_path / f"member-{i}.jsonl")
+    argv = [sys.executable, "-m", "gpumounter_tpu.jaxcheck.federation",
+            "--master", base, "--group", group,
+            "--member", f"default/workload-{i}",
+            "--checkpoint-root", str(tmp_path / "ckpt"),
+            "--local-devices", "2", "--status-file", status,
+            "--stop-file", str(tmp_path / "stop"),
+            "--barrier-timeout", str(barrier_timeout),
+            "--seq-len", "48"]
+    if hold_dir is not None:
+        argv += ["--hold-dir", str(hold_dir)]
+    proc = subprocess.Popen(argv, cwd=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        env=_member_env(), stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT, start_new_session=True)
+    return proc, status
+
+
+def _records(status_path):
+    try:
+        with open(status_path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+    except OSError:
+        return []
+
+
+def _wait_for(predicate, timeout_s=90.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while True:
+        value = predicate()
+        if value:
+            return value
+        assert time.monotonic() < deadline, f"timed out waiting: {what}"
+        time.sleep(0.25)
+
+
+def _steps_at(status_path, generation, world, n=2):
+    def check():
+        steps = [r for r in _records(status_path)
+                 if r["phase"] == "step"
+                 and r["generation"] == generation
+                 and r["world_devices"] == world]
+        return steps if len(steps) >= n else None
+    return check
+
+
+def _reap(procs, timeout_s=30.0):
+    for proc in procs:
+        try:
+            proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=5)
+
+
+def test_multiprocess_resize_2_4_2_end_to_end(tmp_path):
+    """THE acceptance flow: two real member processes federate over
+    gloo (2 virtual CPU devices each), train, and ride /slice/resize
+    2→4→2 hosts through the full drain → barrier → re-initialize →
+    restore-resharded protocol — step counter and loss trajectory
+    intact across BOTH transitions, members resized out exit clean."""
+    stack = _stack(tmp_path, hosts=4)
+    procs = []
+    try:
+        status, body = _post(f"{stack.base}/addtpuslice", _target(2))
+        assert status == 200, body
+        group = body["group"]
+        p0, s0 = _spawn_member(stack.base, group, 0, tmp_path)
+        p1, s1 = _spawn_member(stack.base, group, 1, tmp_path)
+        procs = [p0, p1]
+        # generation 1: a 2-process / 4-device world training
+        _wait_for(_steps_at(s0, 1, 4, n=3), what="gen-1 steps")
+        # GROW 2 → 4 hosts: barrier gen 2 expects all four members
+        status, body = _post(f"{stack.base}/slice/resize", _target(4))
+        assert status == 200, body
+        assert body["generation"] == 2
+        p2, s2 = _spawn_member(stack.base, group, 2, tmp_path)
+        p3, s3 = _spawn_member(stack.base, group, 3, tmp_path)
+        procs += [p2, p3]
+        _wait_for(_steps_at(s0, 2, 8, n=3), what="gen-2 steps")
+        _wait_for(_steps_at(s2, 2, 8, n=1), what="member-2 joined")
+        # SHRINK 4 → 2: members 2/3 are refused at the barrier and exit
+        status, body = _post(f"{stack.base}/slice/resize", _target(2))
+        assert status == 200, body
+        assert body["generation"] == 3
+        _wait_for(_steps_at(s0, 3, 4, n=3), what="gen-3 steps")
+        for proc in (p2, p3):
+            assert proc.wait(timeout=60) == 0
+        assert any(r["phase"] == "resized_out" for r in _records(s2))
+        with open(tmp_path / "stop", "w") as f:
+            f.write("1")
+        _reap([p0, p1])
+        assert p0.returncode == 0 and p1.returncode == 0
+
+        records = _records(s0)
+        steps = [r for r in records if r["phase"] == "step"]
+        # the step counter NEVER resets: strictly increasing across
+        # both reshapes, and the world really was 4 → 8 → 4 devices
+        numbers = [r["step"] for r in steps]
+        assert numbers == sorted(set(numbers)), numbers
+        worlds = [r["world_devices"] for r in steps]
+        assert {1: 4, 2: 8, 3: 4} == {
+            r["generation"]: r["world_devices"] for r in steps}
+        reshapes = [r for r in records if r["phase"] == "reshape_done"]
+        assert [r["generation"] for r in reshapes] == [2, 3]
+        assert all(r["rolled_back"] is False for r in reshapes)
+        # parameters survived both transitions bit-for-bit: the
+        # fingerprint before each drain equals the one after restore
+        begins = [r for r in records if r["phase"] == "reshape_begin"]
+        for begin, done in zip(begins, reshapes):
+            assert begin["fingerprint"] == pytest.approx(
+                done["fingerprint"], rel=1e-4)
+        # the loss trajectory descends across the whole ride
+        losses = [r["loss"] for r in steps]
+        assert len(losses) >= 9
+        assert (sum(losses[-3:]) / 3) < (sum(losses[:3]) / 3), losses
+        # both signals agree with ground truth everywhere
+        assert_slice_invariants(stack.gateway.broker,
+                                [r.sim for r in stack.rigs],
+                                kube=stack.master_kube)
+        assert_checkpoint_invariants(str(tmp_path / "ckpt"))
+    finally:
+        _reap(procs, timeout_s=5.0)
+        stack.close()
+
+
+def test_member_sigkill_mid_resize_rolls_back_and_reforms(tmp_path):
+    """Fault injection: the COORDINATOR member is SIGKILLed in the
+    mid-resize window (drained, torn down, not yet joined). The gen-2
+    barrier sticks at joined < expected (doctor-visible), survivors
+    park; the operator moves the generation past the dead member —
+    exactly what slice self-healing does on a node death — and the
+    survivors re-form under a re-elected coordinator, restoring the
+    last-good checkpoint: step counter and trajectory intact."""
+    stack = _stack(
+        tmp_path, hosts=4,
+        broker_config=BrokerConfig(resize_barrier_timeout_s=1.0))
+    hold = tmp_path / "hold"
+    hold.mkdir()
+    procs = []
+    try:
+        status, body = _post(f"{stack.base}/addtpuslice", _target(2))
+        assert status == 200, body
+        group = body["group"]
+        p0, s0 = _spawn_member(stack.base, group, 0, tmp_path,
+                               hold_dir=hold, barrier_timeout=3.0)
+        p1, s1 = _spawn_member(stack.base, group, 1, tmp_path,
+                               hold_dir=hold, barrier_timeout=3.0)
+        procs = [p0, p1]
+        # release the initial (generation 1) federation hold
+        _wait_for(lambda: os.path.exists(
+            hold / "default--workload-0.ready-1") and os.path.exists(
+            hold / "default--workload-1.ready-1"), what="gen-1 holds")
+        (hold / "go-1").touch()
+        _wait_for(_steps_at(s0, 1, 4, n=3), what="gen-1 steps")
+
+        # GROW 2 → 4: members drain gen 2, tear down, and HOLD at the
+        # pre-join seam — the deterministic mid-resize window
+        status, body = _post(f"{stack.base}/slice/resize", _target(4))
+        assert status == 200, body
+        assert body["generation"] == 2
+        _wait_for(lambda: os.path.exists(
+            hold / "default--workload-0.ready-2") and os.path.exists(
+            hold / "default--workload-1.ready-2"), what="gen-2 holds")
+        # SIGKILL member 0 — the jax coordinator — inside the window
+        os.killpg(p0.pid, signal.SIGKILL)
+        p0.wait(timeout=10)
+        (hold / "go-2").touch()
+        # the two NEW members join normally (no hold)
+        p2, s2 = _spawn_member(stack.base, group, 2, tmp_path,
+                               barrier_timeout=3.0)
+        p3, s3 = _spawn_member(stack.base, group, 3, tmp_path,
+                               barrier_timeout=3.0)
+        procs += [p2, p3]
+        # barrier sticks at 3/4 — missing exactly the killed member —
+        # and the master surfaces it (doctor WARN path pinned in the
+        # unit above; here the raw surface)
+        def stuck():
+            _, barrier = _get(
+                f"{stack.base}/slice/barrier?group={group}")
+            return barrier if (barrier.get("generation") == 2
+                               and len(barrier.get("joined") or [])
+                               == 3 and barrier.get("stuck")) else None
+        barrier = _wait_for(stuck, what="stuck 3/4 barrier")
+        assert barrier["missing"] == ["default/workload-0"]
+        # no survivor restored: nobody is stepping at generation 2
+        assert not [r for r in _records(s1) if r["phase"] == "step"
+                    and r["generation"] == 2]
+
+        # the control plane moves past the dead member (the operator's
+        # resize here; repair_group drives this same bump on a node
+        # death) — barrier gen 3 for the three live members, coordinator
+        # re-elected to member 1
+        status, body = _post(f"{stack.base}/slice/resize",
+                             _target(3, members=[1, 2, 3]))
+        assert status == 200, body
+        assert body["generation"] == 3
+        _wait_for(lambda: os.path.exists(
+            hold / "default--workload-1.ready-3"), what="gen-3 hold")
+        (hold / "go-3").touch()
+        # survivors re-form a 3-process / 6-device world and keep
+        # training — restored from the LAST-GOOD checkpoint
+        steps = _wait_for(_steps_at(s1, 3, 6, n=3),
+                          what="gen-3 steps")
+        records = _records(s1)
+        done = [r for r in records if r["phase"] == "reshape_done"]
+        assert done and done[-1]["generation"] == 3
+        assert done[-1]["restored_generation"] == 2
+        # the drained state at the moment of transition IS what came
+        # back: fingerprint preserved through kill + rollback
+        begin = [r for r in records if r["phase"] == "reshape_begin"][-1]
+        assert done[-1]["fingerprint"] == pytest.approx(
+            begin["fingerprint"], rel=1e-4)
+        # step counter intact (the steps taken at gen 1 are not lost)
+        gen1_last = max(r["step"] for r in records
+                        if r["phase"] == "step"
+                        and r["generation"] == 1)
+        assert steps[0]["step"] == gen1_last + 1
+        losses = [r["loss"] for r in records if r["phase"] == "step"]
+        assert (sum(losses[-3:]) / 3) < (sum(losses[:3]) / 3), losses
+        with open(tmp_path / "stop", "w") as f:
+            f.write("1")
+        _reap([p1, p2, p3])
+        assert p1.returncode == 0
+        assert p2.returncode == 0 and p3.returncode == 0
+        assert_slice_invariants(stack.gateway.broker,
+                                [r.sim for r in stack.rigs],
+                                kube=stack.master_kube)
+        assert_checkpoint_invariants(str(tmp_path / "ckpt"))
+    finally:
+        _reap(procs, timeout_s=5.0)
+        stack.close()

@@ -1,0 +1,204 @@
+"""AST lints pinning the re-federation protocol's two structural
+contracts (ISSUE 15 CI satellite):
+
+1. **No restore path deletes a checkpoint.** In jaxcheck/drain.py every
+   deletion call (unlink/remove/rmtree/rmdir) is confined to the commit
+   path (`_prune_generations`, reached only from `commit_manifest`,
+   which runs strictly AFTER the new generation's manifest + LATEST are
+   durable) and the atomic-writer's failed-tmp cleanup. A deletion
+   reachable from a restore function could destroy the sole surviving
+   copy of the state exactly when it is needed.
+
+2. **Every barrier transition is observable.** In master/slicetxn.py
+   the `tpumounter_slice_barriers_total` metric and the `slice_barrier`
+   event are emitted ONLY inside `_barrier_transition` (which emits
+   BOTH — the pairing), and every method that mutates the barrier map
+   crosses that seam. A silent transition would blind the doctor's
+   stuck-barrier check precisely when a member died mid-resize.
+"""
+
+import ast
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _parse(rel):
+    path = os.path.join(ROOT, "gpumounter_tpu", rel)
+    with open(path) as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def _functions(tree):
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def _call_names(node):
+    """Dotted names of every call inside ``node`` (e.g. "os.unlink",
+    "self._barrier_transition", "shutil.rmtree")."""
+    names = []
+    for call in ast.walk(node):
+        if not isinstance(call, ast.Call):
+            continue
+        parts = []
+        f = call.func
+        while isinstance(f, ast.Attribute):
+            parts.append(f.attr)
+            f = f.value
+        if isinstance(f, ast.Name):
+            parts.append(f.id)
+        names.append(".".join(reversed(parts)))
+    return names
+
+
+_DELETERS = {"os.unlink", "os.remove", "os.rmdir", "shutil.rmtree"}
+
+
+def test_drain_deletions_confined_to_the_commit_path():
+    tree = _parse("jaxcheck/drain.py")
+    allowed = {
+        "_prune_generations",     # THE pruning seam (commit-only)
+        "_atomic_write",          # failed-tmp cleanup inside the writer
+        "drain_restore_cycle",    # legacy helper deleting its OWN tmp
+    }
+    offenders = {}
+    for name, defs in _functions(tree).items():
+        for fn in defs:
+            hits = [c for c in _call_names(fn) if c in _DELETERS]
+            if hits and name not in allowed:
+                offenders[name] = hits
+    assert not offenders, (
+        f"deletion calls outside the sanctioned commit path: "
+        f"{offenders} — a restore path that deletes can destroy the "
+        "sole surviving checkpoint")
+
+
+def test_prune_reached_only_from_commit():
+    tree = _parse("jaxcheck/drain.py")
+    callers = []
+    for name, defs in _functions(tree).items():
+        for fn in defs:
+            if name == "_prune_generations":
+                continue
+            if any(c.endswith("_prune_generations")
+                   for c in _call_names(fn)):
+                callers.append(name)
+    assert callers == ["commit_manifest"], (
+        f"_prune_generations called from {callers}; pruning may run "
+        "ONLY inside the commit (after manifest + LATEST are durable)")
+
+
+def test_restore_paths_exist_and_never_delete():
+    """The concrete restore-path functions (belt to the braces above:
+    they must exist, or the allowlist lint is vacuously green)."""
+    tree = _parse("jaxcheck/drain.py")
+    functions = _functions(tree)
+    for required in ("restore_sharded", "restore_last_good",
+                     "_load_generation", "_verify_shards", "restore"):
+        assert required in functions, f"missing {required}"
+        for fn in functions[required]:
+            assert not any(c in _DELETERS for c in _call_names(fn))
+
+
+def test_federation_module_never_deletes_checkpoints():
+    tree = _parse("jaxcheck/federation.py")
+    hits = [c for c in _call_names(tree) if c in _DELETERS]
+    assert hits == [], (
+        f"jaxcheck/federation.py deletes files: {hits} — the member "
+        "side owns no checkpoint lifecycle; deletion is the commit "
+        "path's alone")
+
+
+def test_barrier_metric_and_event_only_inside_the_seam():
+    tree = _parse("master/slicetxn.py")
+    functions = _functions(tree)
+    offenders = []
+    for name, defs in functions.items():
+        for fn in defs:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _call_names(node)[:1]
+                if dotted == ["REGISTRY.slice_barriers.inc"] \
+                        and name != "_barrier_transition":
+                    offenders.append((name, "metric"))
+                if dotted == ["EVENTS.emit"] and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        node.args[0].value == "slice_barrier" and \
+                        name != "_barrier_transition":
+                    offenders.append((name, "event"))
+    assert not offenders, (
+        f"barrier metric/event emitted outside _barrier_transition: "
+        f"{offenders}")
+
+
+def test_barrier_seam_pairs_metric_with_event():
+    tree = _parse("master/slicetxn.py")
+    seam = _functions(tree).get("_barrier_transition")
+    assert seam, "slicetxn.py lost _barrier_transition"
+    calls = _call_names(seam[0])
+    assert "REGISTRY.slice_barriers.inc" in calls
+    assert "EVENTS.emit" in calls
+
+
+def test_every_barrier_map_mutation_crosses_the_seam():
+    """Any method that writes self._barriers (arm, drop, …) must call
+    _barrier_transition somewhere in its body — no silent barrier
+    state changes."""
+    tree = _parse("master/slicetxn.py")
+    offenders = []
+    for name, defs in _functions(tree).items():
+        for fn in defs:
+            mutates = False
+            for node in ast.walk(fn):
+                # self._barriers[...] = ... / del self._barriers[...]
+                if isinstance(node, (ast.Assign, ast.Delete)):
+                    targets = node.targets
+                    for target in targets:
+                        if isinstance(target, ast.Subscript) and \
+                                isinstance(target.value,
+                                           ast.Attribute) and \
+                                target.value.attr == "_barriers":
+                            mutates = True
+                # self._barriers.pop(...) / .clear() / .setdefault()
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in ("pop", "clear",
+                                           "setdefault", "update") and \
+                        isinstance(node.func.value, ast.Attribute) and \
+                        node.func.value.attr == "_barriers":
+                    mutates = True
+            if mutates and "self._barrier_transition" not in \
+                    _call_names(fn):
+                offenders.append(name)
+    assert not offenders, (
+        f"methods mutate self._barriers without crossing "
+        f"_barrier_transition: {offenders}")
+
+
+def test_barrier_route_registered():
+    path = os.path.join(ROOT, "gpumounter_tpu", "master", "gateway.py")
+    source = open(path).read()
+    assert '"/slice/barrier": "slicebarrier"' in source
+    assert '"slicebarrier"' in source.split("_UNTRACED_ROUTES")[1] \
+        .split("}")[0], "barrier polling must stay out of the trace ring"
+
+
+def test_barrier_timeout_knob_is_plumbed_and_validated():
+    from gpumounter_tpu.master.admission import BrokerConfig
+    from gpumounter_tpu.utils import consts
+    from gpumounter_tpu.utils.config import Settings
+    assert consts.DEFAULT_RESIZE_BARRIER_TIMEOUT_S > 0
+    assert BrokerConfig().resize_barrier_timeout_s == \
+        consts.DEFAULT_RESIZE_BARRIER_TIMEOUT_S
+    s = Settings.from_env({consts.ENV_RESIZE_BARRIER_TIMEOUT_S: "45"})
+    assert s.resize_barrier_timeout_s == 45.0
+    assert BrokerConfig.from_settings(s).resize_barrier_timeout_s == 45.0
+    with pytest.raises(ValueError):
+        Settings.from_env({consts.ENV_RESIZE_BARRIER_TIMEOUT_S: "0"})

@@ -152,6 +152,7 @@ def measure_attach_cycle(schedule_delay_s: float, cycles: int,
                          warm_pool: bool = False,
                          count_round_trips: bool = False,
                          usage: bool = True,
+                         topo: bool = True,
                          grpc_mode: str = "threadpool"
                          ) -> tuple[list[float], list[float], list[dict]]:
     """Drive attach+detach cycles; returns (attach_latencies,
@@ -187,15 +188,31 @@ def measure_attach_cycle(schedule_delay_s: float, cycles: int,
     # timed attaches — the headline overhead number includes it, and the
     # usage=False re-measure is the TPU_USAGE=0 A/B
     # (utilz_overhead_delta_ms).
+    # topo=True is likewise the production default: the worker serves
+    # /topoz and the master's fleet tick scrapes+scores it concurrently
+    # with the timed attaches; topo=False is the TPU_TOPOLOGY=0 A/B
+    # (topoz_scrape_delta_ms).
     rig = WorkerRig(host, n_chips=max(CHIPS, n_chips), actuator="procroot",
                     use_kubelet_socket=True,
                     schedule_delay_s=schedule_delay_s,
                     warm_pool=pool_sizes, informer=True, agent=True,
                     usage="fs" if usage else False,
-                    usage_interval_s=0.2)
+                    usage_interval_s=0.2, topo=topo)
     if rig.usage is not None:
         rig.usage.start()
-    stack = LiveStack(rig, grpc_mode=grpc_mode)
+    # the gateway reads TPU_TOPOLOGY at construction; pin it for the
+    # stack build so the A/B actually removes the scrape + scoring
+    prev_topology = os.environ.get("TPU_TOPOLOGY")
+    if not topo:
+        os.environ["TPU_TOPOLOGY"] = "0"
+    try:
+        stack = LiveStack(rig, grpc_mode=grpc_mode)
+    finally:
+        if not topo:
+            if prev_topology is None:
+                os.environ.pop("TPU_TOPOLOGY", None)
+            else:
+                os.environ["TPU_TOPOLOGY"] = prev_topology
     client = _Client(stack.base)
     attach = (f"/addtpu/namespace/default/pod/workload"
               f"/tpu/{n_chips}/isEntireMount/{str(entire).lower()}")
@@ -887,6 +904,18 @@ def main() -> None:
         f"usage sampling is NOT within noise: overhead p50 "
         f"{p50_events_on * 1e3:.2f} ms with the sampler vs "
         f"{p50_usage_off * 1e3:.2f} ms without")
+    # Topology-plane A/B (ISSUE 17, same discipline): the overhead
+    # config re-measured with TPU_TOPOLOGY=0 semantics — no /topoz
+    # scrape, no fleet-tick scoring. Serving /topoz is snapshot-only
+    # and scoring runs on the fleet tick thread (both lint-pinned), so
+    # the topology-ON p50 (the default, measured above with the fleet
+    # loop scraping) must sit within noise of topology-OFF.
+    topo_off, _, _ = measure_attach_cycle(0.0, cycles=100, topo=False)
+    p50_topo_off = statistics.median(topo_off)
+    assert p50_events_on <= p50_topo_off * 1.5 + 0.002, (
+        f"topology scrape is NOT within noise: overhead p50 "
+        f"{p50_events_on * 1e3:.2f} ms with the topology plane vs "
+        f"{p50_topo_off * 1e3:.2f} ms without")
     # Parking-executor A/B (ISSUE 14, same discipline as the events/
     # usage A/Bs): the overhead config re-measured over the production
     # worker executor (TPU_GRPC_ASYNC semantics). The 10 ms bar is
@@ -935,6 +964,9 @@ def main() -> None:
         "overhead_p50_usage_off_s": round(p50_usage_off, 4),
         "utilz_overhead_delta_ms": round(
             (p50_events_on - p50_usage_off) * 1e3, 3),
+        "overhead_p50_topo_off_s": round(p50_topo_off, 4),
+        "topoz_scrape_delta_ms": round(
+            (p50_events_on - p50_topo_off) * 1e3, 3),
         "overhead_p50_parking_s": round(p50_parking, 4),
         "single_chip_attach_p50_s": round(statistics.median(single), 4),
         "single_chip_detach_p50_s": round(

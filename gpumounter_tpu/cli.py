@@ -632,6 +632,102 @@ def cmd_utilz(args) -> int:
     return EXIT_OTHER if unattributed else 0
 
 
+def cmd_topo(args) -> int:
+    """Render the master's /topoz fleet-topology view: an ASCII
+    occupancy map per node (each chip at its mesh coordinate, lettered
+    by owner), the fragmentation score, stranded-chip count, group
+    contiguity and the defrag candidate report. Exit non-zero when any
+    chip is stranded — free capacity no aligned grant can use."""
+    try:
+        payload = json.loads(_fetch_text(args.master, "/topoz",
+                                         args.timeout))
+    except TransportError as e:
+        if "404" in str(e):
+            # the master answers NoSuchRoute under TPU_TOPOLOGY=0 — a
+            # disabled plane is a state, not a transport failure
+            print("topology plane disabled on this target "
+                  "(TPU_TOPOLOGY=0 — no /topoz, no fragmentation "
+                  "scoring)")
+            return 0
+        print(f"unreachable: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    except ValueError as e:
+        print(f"bad /topoz payload: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    if not payload.get("enabled"):
+        _emit(payload, args.json,
+              "topology plane disabled on this target (TPU_TOPOLOGY=0 "
+              "— no /topoz, no fragmentation scoring)")
+        return 0
+    fleet = payload.get("fleet") or {}
+    fleet_nodes = fleet.get("nodes") or {}
+    nodes = payload.get("nodes") or {}
+    lines = []
+    if fleet:
+        lines.append(
+            f"fleet: frag {float(fleet.get('score') or 0):.2f} "
+            f"(largest free block {fleet.get('largest_free_block', 0)} "
+            f"of {fleet.get('free', 0)} free), "
+            f"{fleet.get('stranded', 0)} stranded chip(s)")
+    else:
+        lines.append("fleet: no topology scored yet (no /topoz scrape "
+                     "has completed)")
+    # one letter per owner across the whole fleet, stable by sort order
+    owners = sorted({c["owner"] for n in nodes.values()
+                     for c in n.get("chips") or [] if c.get("owner")})
+    letters = {owner: chr(ord("A") + i % 26)
+               for i, owner in enumerate(owners)}
+    for node in sorted(nodes):
+        n = nodes[node]
+        scored = fleet_nodes.get(node) or {}
+        mesh = n.get("mesh") or [0, 0]
+        lines.append(
+            f"  {node}: {n.get('free', 0)} free / "
+            f"{n.get('leased', 0)} leased on "
+            f"{mesh[0]}x{mesh[1]}"
+            + (f" ({n['topology']})" if n.get("topology") else "")
+            + (f"  frag {float(scored.get('frag') or 0):.2f}"
+               f"  largest free block "
+               f"{scored.get('largest_free_block', 0)}"
+               + (f"  {scored['stranded']} STRANDED"
+                  if scored.get("stranded") else "")
+               if scored else ""))
+        rows, cols = (mesh + [0, 0])[:2]
+        grid = [["?"] * max(cols, 0) for _ in range(max(rows, 0))]
+        for chip in n.get("chips") or []:
+            r, c = (chip.get("coord") or [0, 0])[:2]
+            if not (0 <= r < rows and 0 <= c < cols):
+                continue
+            if chip.get("state") == "free":
+                grid[r][c] = "."
+            else:
+                grid[r][c] = letters.get(chip.get("owner", ""), "#")
+        for row in grid:
+            lines.append("    " + " ".join(row))
+    for owner in owners:
+        lines.append(f"  {letters[owner]} = {owner}")
+    for group, info in sorted((fleet.get("groups") or {}).items()):
+        verdict = {True: "contiguous", False: "SCATTERED",
+                   None: "unknown"}[info.get("contiguous")]
+        lines.append(f"  group {group}: hosts "
+                     f"{','.join(info.get('hosts') or [])} — {verdict}")
+    for cand in fleet.get("defrag_candidates") or []:
+        lines.append(
+            f"  defrag candidate: {cand.get('namespace')}/"
+            f"{cand.get('pod')} (tenant {cand.get('tenant')}, "
+            f"{cand.get('chips')} chip(s) on {cand.get('node')}"
+            + (", idle" if cand.get("idle") else "")
+            + f") would grow the largest free block by "
+            f"{cand.get('gain')}")
+    stranded = int(fleet.get("stranded") or 0)
+    if stranded:
+        lines.append(f"  WARNING: {stranded} stranded chip(s) — free "
+                     "capacity in fragments no topology-aligned grant "
+                     "can use")
+    _emit(payload, args.json, "\n".join(lines))
+    return EXIT_OTHER if stranded else 0
+
+
 def cmd_fleet(args) -> int:
     """Render the master's /fleetz cluster view: per-node scrape health,
     per-tenant chips in use, top SLO burn, and the merged lifecycle event
@@ -652,6 +748,7 @@ def cmd_fleet(args) -> int:
              f"{payload.get('ticks', 0)} scrape tick(s) "
              f"@ {payload.get('tick_interval_s')}s"]
     rc = 0
+    topo_nodes = (payload.get("topology") or {}).get("nodes") or {}
     for node in sorted(nodes):
         n = nodes[node]
         state = n.get("state", "?")
@@ -667,7 +764,15 @@ def cmd_fleet(args) -> int:
                     f"{util.get('chips_total', 0)} busy "
                     f"{100 * float(util.get('avg_duty') or 0):.0f}%"
                     if util else "-")
+        # frag column (the node's /topoz-derived score): 1 - largest
+        # schedulable free block / free chips; "-" with the topology
+        # plane off or the node not yet scored
+        topo = topo_nodes.get(node) or {}
+        frag_str = (f"{float(topo.get('frag') or 0.0):.2f}"
+                    if topo else "-")
         extras = []
+        if topo.get("stranded"):
+            extras.append(f"{topo['stranded']} stranded chip(s)")
         if util.get("unattributed_busy"):
             extras.append(f"{util['unattributed_busy']} unattributed "
                           "busy chip(s)")
@@ -680,7 +785,8 @@ def cmd_fleet(args) -> int:
         lines.append(
             f"  {node}: {state.upper()}  chips[{chip_str}]  "
             f"util[{util_str}]  "
-            f"events@{n.get('events_seq', 0)}"
+            + (f"frag[{frag_str}]  " if topo_nodes else "")
+            + f"events@{n.get('events_seq', 0)}"
             + (f"  [{'; '.join(extras)}]" if extras else ""))
     # HA posture of the answering master (docs/guide/HA.md): its role per
     # shard, the peers its lock records name, and store lag — a stuck
@@ -722,6 +828,22 @@ def cmd_fleet(args) -> int:
     if tenants:
         lines.append("  tenants: " + ", ".join(
             f"{t}={c} chip(s)" for t, c in sorted(tenants.items())))
+    # fleet-wide fragmentation + the cross-shard tenant rollup (the
+    # topology plane; absent under TPU_TOPOLOGY=0)
+    topology = payload.get("topology") or {}
+    if topology:
+        lines.append(
+            f"  topology: frag {float(topology.get('score') or 0):.2f} "
+            f"(largest free block {topology.get('largest_free_block', 0)}"
+            f" of {topology.get('free', 0)} free), "
+            f"{topology.get('stranded', 0)} stranded chip(s), "
+            f"{len(topology.get('defrag_candidates') or [])} defrag "
+            "candidate(s)")
+    tenants_global = (payload.get("global_tenants") or {}).get("tenants")
+    if tenants_global:
+        lines.append("  global tenants: " + ", ".join(
+            f"{t}={c} chip(s)"
+            for t, c in sorted(tenants_global.items())))
     # per-tenant utilization + the idle-lease list (chips held but not
     # computing — the capacity the broker's idle-aware preemption and
     # the fractional-sharing roadmap item reclaim/pack)
@@ -1270,6 +1392,34 @@ def cmd_doctor(args) -> int:
               "idle-aware preemption reclaim them")
     elif metrics and metrics.get("tpumounter_tenant_chips_idle"):
         check("ok", "no leased chips idle past TPU_IDLE_LEASE_S")
+
+    # Fleet topology plane: fragmentation and stranded chips are CURRENT
+    # state (gauges recomputed every fleet tick). Both WARN — they cost
+    # capacity, not correctness; the paired alert rules
+    # (TPUMounterFleetFragmented / TPUMounterStrandedChips) add the
+    # sustained-duration judgment a one-shot doctor cannot.
+    if metrics and metrics.get("tpumounter_fleet_fragmentation_score"):
+        from gpumounter_tpu.master.topology import FRAG_WARN_THRESHOLD
+        frag = max(metrics["tpumounter_fleet_fragmentation_score"]
+                   .values(), default=0.0)
+        stranded_chips = sum(
+            metrics.get("tpumounter_stranded_chips", {}).values())
+        if frag > FRAG_WARN_THRESHOLD:
+            check("warn",
+                  f"fleet fragmented: score {frag:.2f} (> "
+                  f"{FRAG_WARN_THRESHOLD:g}) — free capacity is "
+                  "shattered; `tpumounterctl topo` for the defrag "
+                  "candidates")
+        elif stranded_chips:
+            pass    # the stranded check below carries the WARN
+        else:
+            check("ok", f"fleet fragmentation score {frag:.2f} "
+                        f"(threshold {FRAG_WARN_THRESHOLD:g})")
+        if stranded_chips:
+            check("warn",
+                  f"{int(stranded_chips)} stranded chip(s): free "
+                  "capacity in mesh fragments no topology-aligned "
+                  "grant can use — `tpumounterctl topo` maps them")
 
     # Elastic slice subsystem: a STRANDED slice transaction (intent
     # record older than its deadline that nothing is driving) is a
@@ -1849,6 +1999,15 @@ def build_parser() -> argparse.ArgumentParser:
              "open accounting (non-zero exit on unattributed busy "
              "chips)")
     p.set_defaults(fn=cmd_utilz)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser(
+        "topo",
+        help="fleet topology from the master's /topoz: per-node ASCII "
+             "chip-occupancy map, fragmentation score, slice "
+             "contiguity and the defrag candidate report (non-zero "
+             "exit on stranded chips)")
+    p.set_defaults(fn=cmd_topo)
     _add_common(p, suppress=True)
 
     p = sub.add_parser(

@@ -47,6 +47,7 @@ class TPUCollector:
         self.pool_namespace = pool_namespace
         self._lock = threading.RLock()
         self._chips: dict[str, TPUChip] = {}       # uuid -> chip
+        self._allocatable: set[str] | None = None  # last kubelet view
         # Precomputed actuation plans (device/plan.py), rebuilt whenever
         # the enumerated inventory actually changes (hot-plug) — the
         # mounter holds this object, so attach/detach actuation reads
@@ -120,13 +121,35 @@ class TPUCollector:
                             chip.state = DeviceState.ALLOCATED
                             chip.pod_name = pod.name
                             chip.namespace = pod.namespace
-            allocated = sum(1 for c in self._chips.values()
-                            if c.state is DeviceState.ALLOCATED)
-            free = sum(1 for c in self._chips.values()
-                       if c.state is DeviceState.FREE
-                       and (allocatable is None or c.uuid in allocatable))
-            REGISTRY.chips.set(free, state="free")
-            REGISTRY.chips.set(allocated, state="allocated")
+            self._allocatable = allocatable
+            self._set_chip_gauges()
+
+    def mark_released(self, uuids: list[str]) -> None:
+        """Write a completed detach through to the cached inventory.
+
+        The slave pods holding these chips are already deleted, so the
+        chips must read FREE to snapshot-only consumers (/topoz,
+        node_status) immediately — not at the next attach's refresh or
+        usage-sampler pass. Deliberately NO kubelet round trip: detach
+        resolution stays zero-LIST (the attach-record cache win), and
+        the next ``update_status`` re-derives ground truth anyway."""
+        with self._lock:
+            for uuid in uuids:
+                chip = self._chips.get(uuid)
+                if chip is not None and chip.state is DeviceState.ALLOCATED:
+                    chip.reset_state()
+            self._set_chip_gauges()
+
+    def _set_chip_gauges(self) -> None:
+        # caller holds the lock
+        allocatable = self._allocatable
+        allocated = sum(1 for c in self._chips.values()
+                        if c.state is DeviceState.ALLOCATED)
+        free = sum(1 for c in self._chips.values()
+                   if c.state is DeviceState.FREE
+                   and (allocatable is None or c.uuid in allocatable))
+        REGISTRY.chips.set(free, state="free")
+        REGISTRY.chips.set(allocated, state="allocated")
 
     # -- aggregation -----------------------------------------------------------
 

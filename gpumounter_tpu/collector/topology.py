@@ -1,0 +1,207 @@
+"""Worker-side topology snapshot: chip coordinates + occupancy (/topoz).
+
+The usage sampler (collector/usage.py) told the control plane what each
+chip is *doing*; nothing yet says where each chip *sits*. The ROADMAP's
+utilization-driven defragmenter needs placement quality measured against
+physical topology — fragmentation, free-block contiguity, stranded chips
+— and the first input to all of those is a per-node map joining the
+node's advertised ICI mesh (allocator/topology.py ``NodeTopology``, from
+the GKE node labels) with the enumerated ``/dev/accel*`` inventory and
+its kubelet-derived occupancy:
+
+- each chip gets a **coordinate** in the node's host-local mesh grid
+  (the advertised topology when its product matches the host chip count,
+  a near-square fold of the chip count otherwise — same row-major
+  device-order convention the SNIPPETS.md §2 NamedSharding mapping
+  assumes);
+- each chip gets an **occupancy** state (free / leased) joined to its
+  owner pod through the same slave → owner resolution the usage sampler
+  uses (``attachment_owners`` + informer slave-pod labels).
+
+Served as ``GET /topoz`` on the worker health port, strictly
+**snapshot-only**: the handler reads the collector's cached inventory
+and already-resolved ownership — no enumeration, no kubelet probe, no
+apiserver round trip on the request path (tests/test_topology_lint.py
+pins it). ``TPU_TOPOLOGY=0`` removes the view entirely — /topoz answers
+``{"enabled": false}`` and no fleet scrape happens.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from gpumounter_tpu.allocator import topology as topology_lib
+from gpumounter_tpu.device.model import DeviceState, TPUChip
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.log import get_logger
+
+logger = get_logger("collector.topology")
+
+# Node-label topology is effectively immutable for a node's lifetime;
+# re-reading it every snapshot would put an apiserver GET on the health
+# port's request path. Cache it, retrying sooner after a failed read.
+DEFAULT_TOPOLOGY_TTL_S = 300.0
+FAILED_TOPOLOGY_RETRY_S = 15.0
+
+
+def host_grid(topology: str, n_chips: int) -> tuple[int, int]:
+    """Host-local 2-D mesh dims (rows, cols) for ``n_chips`` chips.
+
+    The advertised topology wins when its product equals the host chip
+    count (a 3-D form folds to ``(d0, product-of-rest)`` — contiguity on
+    the folded grid is a documented proxy, not a cabling claim). A
+    multi-host slice label ("2x4" across two 4-chip hosts) or a missing
+    label falls back to the nearest-square factorization of the host
+    count, which reproduces the single-host sub-meshes GKE actually
+    hands out (4 → 2x2, 8 → 2x4)."""
+    if n_chips <= 0:
+        return (0, 0)
+    try:
+        dims = [int(d) for d in topology.lower().split("x")] \
+            if topology else []
+    except ValueError:
+        dims = []
+    if dims and all(d > 0 for d in dims):
+        product = 1
+        for d in dims:
+            product *= d
+        if product == n_chips:
+            if len(dims) == 1:
+                return (1, dims[0])
+            return (dims[0], product // dims[0])
+    rows = 1
+    for d in range(1, int(n_chips ** 0.5) + 1):
+        if n_chips % d == 0:
+            rows = d
+    return (rows, n_chips // rows)
+
+
+def node_topology_source(kube, node_name: str, *,
+                         ttl_s: float = DEFAULT_TOPOLOGY_TTL_S):
+    """TTL-cached ``() -> NodeTopology | None`` over the node's labels.
+
+    Best-effort: an unreadable or unlabeled node degrades to ``None``
+    (the grid falls back to the chip-count factorization) and is retried
+    on a shorter fuse — never raises into the snapshot path."""
+    from gpumounter_tpu.utils.errors import K8sApiError
+    state = {"topo": None, "until": -float("inf")}
+    lock = threading.Lock()
+
+    def source() -> topology_lib.NodeTopology | None:
+        with lock:
+            now = time.monotonic()
+            if now < state["until"]:
+                return state["topo"]
+            try:
+                node = kube.get_node(node_name)
+                state["topo"] = topology_lib.node_topology(node)
+                state["until"] = now + ttl_s
+            except K8sApiError:
+                state["topo"] = None
+                state["until"] = now + FAILED_TOPOLOGY_RETRY_S
+            return state["topo"]
+
+    return source
+
+
+class NodeTopologyView:
+    """The ``GET /topoz`` payload builder: cached inventory × advertised
+    mesh × ownership, assembled per request from state other components
+    already maintain. Snapshot-only — see the module docstring."""
+
+    def __init__(self, collector, *, node_name: str = "",
+                 topology_fn=None, owners_fn=None,
+                 pool_namespace: str = consts.DEFAULT_POOL_NAMESPACE):
+        self.collector = collector
+        self.node_name = node_name
+        # topology_fn() -> NodeTopology | None (TTL-cached source above);
+        # None = no label source (unit rigs), grid from chip count.
+        self.topology_fn = topology_fn
+        # owners_fn() -> {slave pod name: (owner ns, owner pod)}; None =
+        # only directly-bound chips attribute.
+        self.owners_fn = owners_fn
+        self.pool_namespace = pool_namespace
+
+    def _resolve_owner(self, chip: TPUChip,
+                       owners: dict[str, tuple[str, str]]
+                       ) -> tuple[str, str] | None:
+        if chip.state is not DeviceState.ALLOCATED or not chip.pod_name:
+            return None
+        if chip.namespace == self.pool_namespace:
+            # held through a slave pod: the grant's real owner is the
+            # pod the slave's labels (or the attach record) name
+            return owners.get(chip.pod_name)
+        return (chip.namespace, chip.pod_name)
+
+    def snapshot(self) -> dict:
+        """The /topoz payload. Reads the collector's CACHED inventory
+        (attach/detach and the usage sampler already refresh it) — this
+        method performs no enumeration and no kubelet probe."""
+        chips = sorted(self.collector.chips, key=lambda c: c.index)
+        topo = None
+        if self.topology_fn is not None:
+            try:
+                topo = self.topology_fn()
+            except Exception:    # noqa: BLE001 — labels degrade,
+                logger.exception("topology source failed")  # never dies
+        owners: dict[str, tuple[str, str]] = {}
+        if self.owners_fn is not None:
+            try:
+                owners = self.owners_fn() or {}
+            except Exception:    # noqa: BLE001 — attribution degrades
+                logger.exception("owner resolution failed")
+        rows, cols = host_grid(topo.topology if topo else "", len(chips))
+        chips_out = []
+        free = leased = 0
+        # Coordinates come from the chip's RANK in index order, not the
+        # raw accelN number: a sparse inventory (hot-unplugged chip) must
+        # still tile the grid without holes.
+        for rank, chip in enumerate(chips):
+            state = ("leased" if chip.state is DeviceState.ALLOCATED
+                     else "free")
+            if state == "free":
+                free += 1
+            else:
+                leased += 1
+            row = {
+                "chip": chip.uuid,
+                "index": chip.index,
+                "coord": [rank // cols, rank % cols] if cols else [0, 0],
+                "device_path": chip.device_path,
+                "state": state,
+            }
+            if chip.namespace == self.pool_namespace and chip.pod_name:
+                row["slave_pod"] = chip.pod_name
+            owner = self._resolve_owner(chip, owners)
+            if owner is not None:
+                row["owner"] = f"{owner[0]}/{owner[1]}"
+            chips_out.append(row)
+        return {
+            "enabled": True,
+            "node": self.node_name,
+            "accelerator": topo.accelerator if topo else "",
+            "topology": topo.topology if topo else "",
+            "chips_per_host": topo.chips_per_host if topo else len(chips),
+            "mesh": [rows, cols],
+            "chips": chips_out,
+            "free": free,
+            "leased": leased,
+        }
+
+
+def build_topology_view(service, settings) -> NodeTopologyView:
+    """Production wiring (worker/main.py): labels from the worker's own
+    node object (TTL-cached), ownership from attachment records + the
+    informer's slave-pod labels — the same resolver /utilz trusts."""
+    from gpumounter_tpu.collector.usage import slave_owner_resolver
+    return NodeTopologyView(
+        service.allocator.collector,
+        node_name=settings.node_name,
+        topology_fn=node_topology_source(service.kube,
+                                         settings.node_name)
+        if settings.node_name else None,
+        owners_fn=slave_owner_resolver(service.reads,
+                                       settings.pool_namespace,
+                                       service=service),
+        pool_namespace=settings.pool_namespace)

@@ -139,13 +139,14 @@ _PLAIN_ROUTES = {"/healthz": "healthz", "/version": "version",
                  "/removetpuslice": "removetpuslice",
                  "/slice/resize": "sliceresize",
                  "/slice/barrier": "slicebarrier",
-                 "/slicez": "slicez"}
+                 "/slicez": "slicez",
+                 "/topoz": "topoz"}
 # Pure introspection requests (and renew heartbeats) would drown the
 # mount traces in the ring buffer; they are measured (histogram) but not
 # stored.
 _UNTRACED_ROUTES = {"healthz", "version", "tracez", "brokerz", "eventz",
                     "fleetz", "renew", "slicez", "slicebarrier",
-                    "unknown"}
+                    "topoz", "unknown"}
 
 
 def _route_label(path: str) -> str:
@@ -251,6 +252,22 @@ class MasterGateway:
                     consts.DEFAULT_NODE_DEAD_TICKS))
             self.broker.bind_node_health(self.nodehealth.state)
             self.slices.bind_repair_candidates(self._repair_candidates)
+        # Fleet topology & fragmentation plane (master/topology.py):
+        # the fleet tick scrapes each worker's /topoz into this model
+        # and scores fragmentation / stranded chips / slice contiguity
+        # / defrag candidates / the cross-shard tenant rollup — all
+        # report-only, the defragmenter's future input. TPU_TOPOLOGY=0
+        # removes the model entirely — no scrape, no /topoz route, no
+        # /fleetz sections, no series (byte-for-byte, pinned).
+        from gpumounter_tpu.master import topology as fleettopo
+        self.topology = None
+        if fleettopo.enabled():
+            self.topology = fleettopo.FleetTopology(
+                leases_fn=self.broker.leases.leases,
+                groups_fn=self.broker.leases.groups,
+                local_usage_fn=self.broker.leases.usage,
+                peers_fn=self._topology_peers,
+                replica=self.ha.replica)
         self.fleet = FleetAggregator(
             targets_fn=self._fleet_targets,
             usage_fn=self.broker.leases.usage,
@@ -260,7 +277,8 @@ class MasterGateway:
             # joins scraped chip utilization to the tenant holding the
             # grant (/fleetz per-tenant utilization + idle-lease list)
             lease_lookup=self.broker.leases.get,
-            node_health=self.nodehealth)
+            node_health=self.nodehealth,
+            topology=self.topology)
         # ...and the reverse direction: the broker tick reads the
         # fleet's observed per-lease activity to mark leases idle past
         # TPU_IDLE_LEASE_S (reclaim signal + preemption preference).
@@ -666,6 +684,14 @@ class MasterGateway:
                 limit = 64
             return 200, self.fleet.snapshot(
                 events_limit=max(1, min(512, limit)))
+        if p == "/topoz":
+            if method != "GET":
+                return self._method_not_allowed("GET", method, p)
+            if self.topology is None:
+                # TPU_TOPOLOGY=0: the route does not exist — the
+                # pre-topology 404 payload, byte-for-byte
+                return 404, {"result": "NoSuchRoute", "message": path}
+            return 200, self.topology.snapshot()
         return 404, {"result": "NoSuchRoute", "message": path}
 
     # -- /tracez: trace introspection + master↔worker stitching ----------------
@@ -1232,6 +1258,19 @@ class MasterGateway:
         if self.broker.store is not None:
             view["store"] = self.broker.store.snapshot()
         return view
+
+    def _topology_peers(self) -> dict:
+        """Peer master shards for the global tenant rollup, straight
+        from the election's lock records ({shard: {holder, url, fence,
+        expired}}). No election = no peers = the rollup equals this
+        shard's own usage."""
+        if self.election is None:
+            return {}
+        try:
+            return self.election.leaders()
+        except Exception:    # noqa: BLE001 — rollup degrades, never dies
+            logger.exception("peer leader listing failed")
+            return {}
 
     def _add(self, namespace: str, pod_name: str, tpu_num: int,
              entire: bool, rid: str = "-", query: dict | None = None,

@@ -56,6 +56,7 @@ import threading
 import time
 import uuid as uuid_mod
 
+from gpumounter_tpu.k8s import objects
 from gpumounter_tpu.master.slice import PodResult, SliceCoordinator
 from gpumounter_tpu.utils import consts
 from gpumounter_tpu.utils.errors import (QueueFullError,
@@ -419,11 +420,20 @@ class SliceTxnManager:
                 rid, tpus_per_host, lease_group, waiter,
                 enqueued_at) -> tuple[int, dict]:
         for result in attached.values():
+            # stamp the member's node like the single-attach path does
+            # (gateway resolve span): node-scoped consumers — preemption
+            # victim filtering, fleet topology's slice-contiguity verdict
+            # — need it, and a repair/resize re-commit refreshes it
+            try:
+                node = objects.node_name(self.gateway.kube.get_pod(
+                    result.namespace, result.pod)) or ""
+            except Exception:
+                node = ""
             self.broker.leases.record(
                 result.namespace, result.pod, tenant, priority,
                 list(result.device_ids), chips=len(result.device_ids),
                 rid=rid, ttl_s=self.broker.config.lease_ttl_s,
-                group=lease_group)
+                group=lease_group, node=node)
         if lease_group != txn.record.txn_id or txn.adopted:
             # the group may predate this process (resize delta, adopted
             # txn after failover): recover its generation from the

@@ -368,6 +368,44 @@ def assert_node_death_invariants(broker, health) -> None:
                 f"leases on unhealthy nodes"
 
 
+def assert_topology_invariants(topology_section: dict) -> None:
+    """Internal-consistency contract of a /fleetz ``topology`` section
+    (any plan that reads one can call this on every observation):
+
+    1. **Score arithmetic holds**: the fleet score is exactly
+       1 − largest/free (0 with no free chips), and the fleet
+       largest/free/stranded figures are the max/sum of the per-node
+       figures — the section is one tick's coherent computation, not a
+       mix of ticks.
+    2. **Per-node sanity**: largest schedulable block never exceeds the
+       node's free count; stranded never exceeds free; a node's free
+       components sum to its free count.
+    3. **Actionable candidates only**: every defrag candidate's gain is
+       positive — a report naming a move that merges nothing is noise
+       the future optimizer would chase.
+    """
+    nodes = topology_section.get("nodes") or {}
+    free = sum(n["free"] for n in nodes.values())
+    largest = max((n["largest_free_block"] for n in nodes.values()),
+                  default=0)
+    stranded = sum(n["stranded"] for n in nodes.values())
+    assert topology_section["free"] == free, topology_section
+    assert topology_section["largest_free_block"] == largest, \
+        topology_section
+    assert topology_section["stranded"] == stranded, topology_section
+    expected = round(1.0 - largest / free, 4) if free else 0.0
+    assert abs(topology_section["score"] - expected) < 1e-6, \
+        f"score {topology_section['score']} != {expected} " \
+        f"(largest {largest} / free {free})"
+    for node, n in sorted(nodes.items()):
+        assert 0 <= n["largest_free_block"] <= n["free"], (node, n)
+        assert 0 <= n["stranded"] <= n["free"], (node, n)
+        assert sum(n.get("free_components") or []) == n["free"], (node, n)
+    for cand in topology_section.get("defrag_candidates") or []:
+        assert cand["gain"] > 0, cand
+        assert cand["node"] in nodes, cand
+
+
 def assert_broker_invariants(broker, sim, store=None,
                              health=None) -> None:
     """The broker-layer contract after any contention / lease-race /

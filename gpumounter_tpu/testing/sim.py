@@ -64,6 +64,13 @@ def make_tpu_node(name="node-a", accelerator="tpu-v5-lite-podslice",
     return node
 
 
+def _mesh_label(n_chips: int) -> str:
+    """The single-host topology label GKE would advertise for a host of
+    ``n_chips`` chips (v5e sub-host meshes)."""
+    return {1: "1x1", 2: "1x2", 4: "2x2", 8: "2x4",
+            16: "4x4"}.get(n_chips, f"1x{n_chips}")
+
+
 def worker_pod(node, ip, name="w1", grpc_port: int | None = None):
     """A Running tpu-mounter-worker pod as the master's discovery sees it.
     ``grpc_port`` sets the per-pod port-override annotation (local stacks
@@ -216,7 +223,8 @@ class WorkerRig:
                  kubelet_lag_s=0.0, warm_pool: dict[str, int] | None = None,
                  informer: bool = False, agent: bool = False,
                  usage=False, usage_interval_s: float = 0.25,
-                 gate=False, grpc_workers: int | None = None,
+                 topo: bool = False, gate=False,
+                 grpc_workers: int | None = None,
                  grpc_async: bool | None = None):
         from gpumounter_tpu.actuation.cgroup import CgroupDeviceController
         from gpumounter_tpu.actuation.mount import TPUMounter
@@ -367,6 +375,23 @@ class WorkerRig:
                     self.reads, self.sim.settings.pool_namespace,
                     service=self.service),
                 refresh_inventory=True)
+        # Topology snapshot view (collector/topology.py): the /topoz
+        # payload builder over this rig's collector — mesh labels from
+        # the sim's node object, ownership resolved like the sampler's.
+        # Snapshot-only; nothing to start or stop.
+        self.topo = None
+        if topo:
+            from gpumounter_tpu.collector.topology import (
+                NodeTopologyView, node_topology_source)
+            from gpumounter_tpu.collector.usage import slave_owner_resolver
+            self.topo = NodeTopologyView(
+                self.sim.collector,
+                node_name=node,
+                topology_fn=node_topology_source(self.sim.kube, node),
+                owners_fn=slave_owner_resolver(
+                    self.reads, self.sim.settings.pool_namespace,
+                    service=self.service),
+                pool_namespace=self.sim.settings.pool_namespace)
 
     def provision_container(self, pod: objects.Pod,
                             pid: int | None = None) -> dict[str, int]:
@@ -460,6 +485,7 @@ class LiveStack:
         _HealthHandler.cache = rig.service.reads
         _HealthHandler.agent = rig.agent
         _HealthHandler.usage = rig.usage
+        _HealthHandler.topo = rig.topo
         _HealthHandler.gate = rig.gate
         self.health_server = start_health_server(0)
         health_port = self.health_server.server_port
@@ -492,6 +518,7 @@ class LiveStack:
         _HealthHandler.cache = None
         _HealthHandler.agent = None
         _HealthHandler.usage = None
+        _HealthHandler.topo = None
         _HealthHandler.gate = None
         self.gateway.fleet.stop()
         self.gateway.broker.stop()
@@ -664,7 +691,8 @@ class MultiNodeStack:
     what the single production apiserver would do."""
 
     def __init__(self, hosts: list, n_chips=4, health: bool = False,
-                 broker_config=None, usage=False, gate=False):
+                 broker_config=None, usage=False, topo: bool = False,
+                 gate=False):
         from gpumounter_tpu.k8s import objects as k8s_objects
         from gpumounter_tpu.master.admission import AttachBroker
         from gpumounter_tpu.master.discovery import WorkerDirectory
@@ -687,7 +715,14 @@ class MultiNodeStack:
         for i, host in enumerate(hosts):
             rig = WorkerRig(host, n_chips=n_chips, node=f"node-{i}",
                             pod_name=f"workload-{i}", usage=usage,
-                            gate=gate)
+                            topo=topo, gate=gate)
+            if topo:
+                # advertise a real single-host mesh on each rig's node
+                # object so /topoz coordinates come from labels, exactly
+                # the GKE wiring (4 chips → "2x2", 8 → "2x4", ...)
+                rig.sim.kube.put_node(make_tpu_node(
+                    name=f"node-{i}", chips=n_chips,
+                    topology=_mesh_label(n_chips)))
             self._attach_drain(rig)
             self.rigs.append(rig)
             server, port = build_server(rig.service, port=0,
@@ -725,6 +760,7 @@ class MultiNodeStack:
         hs = start_health_server(0, journal=rig.journal,
                                  cache=rig.service.reads,
                                  usage=rig.usage,
+                                 topo=rig.topo,
                                  gate=rig.gate,
                                  drain=getattr(rig, "drain", None),
                                  ready=True)

@@ -184,6 +184,12 @@ class Settings:
     # before the broker marks a lease idle). Only meaningful while
     # worker utilization telemetry is flowing.
     idle_lease_s: float = consts.DEFAULT_IDLE_LEASE_S
+    # Fleet topology plane (collector/topology.py): snapshot-only chip
+    # coordinate + occupancy view served as GET /topoz. ON by default;
+    # TPU_TOPOLOGY=0 removes the endpoint payload, the fleet scrape and
+    # every new series, so existing endpoints answer exactly the
+    # pre-topology payloads.
+    topology_enabled: bool = True
     # Graceful worker drain (worker/drain.py): how long the SIGTERM /
     # /drainz sequence waits for in-flight actuation to settle before
     # the gRPC server goes down anyway.
@@ -303,6 +309,7 @@ class Settings:
         if t := env.get(consts.ENV_ATTACH_CACHE_TTL_S):
             s.attach_cache_ttl_s = float(t)
         s.usage_enabled = env.get(consts.ENV_USAGE, "1") != "0"
+        s.topology_enabled = env.get(consts.ENV_TOPOLOGY, "1") != "0"
         if t := env.get(consts.ENV_USAGE_INTERVAL_S):
             s.usage_interval_s = float(t)
             if s.usage_interval_s <= 0:

@@ -223,6 +223,20 @@ DEFAULT_USAGE_INTERVAL_S = 5.0
 ENV_IDLE_LEASE_S = "TPU_IDLE_LEASE_S"
 DEFAULT_IDLE_LEASE_S = 300.0
 
+# --- Fleet topology & fragmentation plane (collector/topology.py,
+# master/topology.py) ----------------------------------------------------------
+# "1" (default): each worker serves GET /topoz on the health port — a
+# snapshot-only view mapping every enumerated chip to its coordinate in
+# the node's advertised mesh plus free/leased occupancy joined to owner
+# and group; the master's fleet tick scrapes it beside /utilz into a
+# FleetTopology model (fragmentation score, free-block contiguity,
+# stranded chips, per-group slice contiguity, a report-only defrag
+# candidate report, and the cross-shard per-tenant usage rollup). "0"
+# disables the plane entirely: no /topoz scrape, no topology or
+# global-tenants sections in /fleetz, and no new metric series — every
+# existing endpoint answers byte-for-byte the pre-topology payloads.
+ENV_TOPOLOGY = "TPU_TOPOLOGY"
+
 # --- Master gateway front (master/httpfront.py) --------------------------------
 # "multiplexed" (default): bounded selector + worker-pool front with
 # HTTP/1.1 keep-alive and connection admission before thread allocation.

@@ -791,6 +791,46 @@ class Registry:
             "Leased chips whose lease the broker marked idle (zero "
             "duty past TPU_IDLE_LEASE_S), by tenant — reclaimable "
             "capacity held against quota")
+        # Fleet topology & fragmentation plane (master/topology.py):
+        # placement quality measured against the physical mesh — the
+        # inputs the ROADMAP's utilization-driven defragmenter will
+        # optimize. Score = 1 - largest schedulable contiguous free
+        # block / total free chips (0 = perfectly packed); stranded
+        # chips are free chips in components too small/misaligned for
+        # any valid ICI group; slice_contiguity says whether a gang's
+        # hosts are adjacent in the fleet's host order (the NamedSharding
+        # row-major proxy). All series vanish under TPU_TOPOLOGY=0.
+        self.fleet_fragmentation_score = Gauge(
+            "tpumounter_fleet_fragmentation_score",
+            "Fleet-wide fragmentation: 1 - largest schedulable "
+            "contiguous free block / total free chips (0 = unfragmented,"
+            " approaching 1 = free capacity shattered)")
+        self.node_free_contiguous_chips = Gauge(
+            "tpumounter_node_free_contiguous_chips",
+            "Largest schedulable contiguous free block on the node's "
+            "mesh (chips), by node — the biggest aligned group the node "
+            "can still grant")
+        self.stranded_chips = Gauge(
+            "tpumounter_stranded_chips",
+            "Free chips fleet-wide sitting in mesh fragments too small "
+            "or misaligned to form any valid ICI group — capacity no "
+            "aligned grant can use until a defrag move frees it")
+        self.slice_contiguity = Gauge(
+            "tpumounter_slice_contiguity",
+            "Whether the group's member hosts occupy adjacent positions "
+            "in the fleet host order (1 = contiguous, 0 = scattered), "
+            "by group — the NamedSharding row-major adjacency proxy")
+        self.tenant_chips_in_use_global = Gauge(
+            "tpumounter_tenant_chips_in_use_global",
+            "Chips in use per tenant summed across every master shard "
+            "(quotas remain per-shard; this is the report-only global "
+            "rollup), by tenant")
+        self.defrag_candidates = Counter(
+            "tpumounter_defrag_candidates_total",
+            "Defrag candidate reports: leases (idle-preferred) whose "
+            "relocation would merge free blocks into a schedulable "
+            "slice, by node — paired 1:1 with defrag_candidate events")
+        self.defrag_candidates.inc(0.0, node="")
         # Device-access accounting (the gpu_ext audit-counter half):
         # every observed idle→busy transition of a chip's device node is
         # one "open". outcome=attributed names the owning tenant (the

@@ -40,6 +40,7 @@ class _HealthHandler(BaseHTTPRequestHandler):
     agent = None       # ResidentActuationAgent, set when the agent is on
     events = None      # EventLog override; None = the process singleton
     usage = None       # ChipUsageSampler, set when TPU_USAGE is on
+    topo = None        # NodeTopologyView, set when TPU_TOPOLOGY is on
     gate = None        # DeviceGate, set when TPU_GATE != legacy
     drain = None       # DrainController, set by main() (graceful drain)
 
@@ -156,6 +157,20 @@ class _HealthHandler(BaseHTTPRequestHandler):
                               else {"enabled": False}).encode()
             ctype = "application/json"
             code = 200
+        elif self.path == "/topoz":
+            # fleet topology plane: each chip's coordinate in the node's
+            # advertised mesh + free/leased occupancy joined to its
+            # owner — what the master's FleetTopology scrapes for
+            # fragmentation scoring. Serves the view's snapshot() over
+            # the collector's CACHED inventory; no enumeration or
+            # kubelet probe runs on this request thread
+            # (tests/test_topology_lint.py pins it).
+            import json
+            topo = type(self).topo
+            body = json.dumps(topo.snapshot() if topo is not None
+                              else {"enabled": False}).encode()
+            ctype = "application/json"
+            code = 200
         elif self.path == "/gatez":
             # kernel device gate: backend + per-container entries, the
             # deny ring with reasons, drift audit, converge stats —
@@ -221,7 +236,7 @@ def start_health_server(port: int, **state) -> ThreadingHTTPServer:
     if state:
         unknown = set(state) - {"journal", "cache", "pool", "agent",
                                 "events", "ready", "usage", "gate",
-                                "drain"}
+                                "drain", "topo"}
         if unknown:
             raise TypeError(f"unknown health-server state: {unknown}")
         handler = type("_ScopedHealthHandler", (_HealthHandler,), state)
@@ -363,6 +378,15 @@ def main() -> None:
         RECORDER.register_provider("usage", sampler.snapshot)
         logger.info("usage sampler enabled: interval %.1fs",
                     settings.usage_interval_s)
+    if settings.topology_enabled:
+        # fleet topology plane (collector/topology.py): snapshot-only
+        # chip coordinate + occupancy view served as GET /topoz for the
+        # master's fragmentation scoring. No thread — the view reads
+        # state other components already maintain. TPU_TOPOLOGY=0
+        # removes the payload and the fleet scrape.
+        from gpumounter_tpu.collector.topology import build_topology_view
+        _HealthHandler.topo = build_topology_view(service, settings)
+        logger.info("topology snapshot enabled (/topoz)")
     tls = load_tls_config()
     if tls:
         logger.info("worker gRPC TLS enabled (mTLS=%s)",

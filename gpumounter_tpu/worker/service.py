@@ -541,6 +541,10 @@ class TPUMountService:
                                  busy_pids=e.pids, message=str(e))
         with trace.span("cleanup"):
             self.allocator.delete_slave_pods(holders)
+            # the freed chips must read FREE to snapshot consumers
+            # (/topoz, node_status) NOW, not at the next kubelet refresh
+            self.allocator.collector.mark_released(
+                [c.uuid for c in chips])
         # the record described the pre-detach attachment; whatever remains
         # (partial detach) is re-resolved and re-recorded by the next
         # attach, never served stale

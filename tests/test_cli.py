@@ -222,6 +222,15 @@ def test_exposition_round_trip_registry_to_parser():
     reg.tenant_chips_idle.set(2, tenant="teamB")
     reg.device_opens.inc(tenant="teamA", outcome="attributed")
     reg.device_opens.inc(2, tenant="", outcome="unattributed")
+    # topology-plane families (ISSUE 17): fragmentation score, per-node
+    # free-block gauge, stranded chips, group contiguity, cross-shard
+    # tenant rollup, defrag-candidate counter
+    reg.fleet_fragmentation_score.set(0.62)
+    reg.node_free_contiguous_chips.set(2, node="node-0")
+    reg.stranded_chips.set(1)
+    reg.slice_contiguity.set(1, group="g1")
+    reg.tenant_chips_in_use_global.set(6, tenant="teamA")
+    reg.defrag_candidates.inc(node="node-1")
 
     # classic exposition: NO exemplars (the ` # {...}` suffix is a parse
     # error for a real Prometheus scraping text/plain; version=0.0.4) —
@@ -285,6 +294,12 @@ def test_exposition_round_trip_registry_to_parser():
     # the exemplar-bearing bucket parsed to its exact cumulative count
     assert parsed["tpumounter_gateway_request_seconds_bucket"][
         (("le", "0.5"), ("route", "addtpu"))] == 1
+    # topology-plane round trips
+    assert parsed["tpumounter_fleet_fragmentation_score"][()] == 0.62
+    assert parsed["tpumounter_slice_contiguity"][(("group", "g1"),)] == 1
+    assert cli._counter_total(parsed,
+                              "tpumounter_defrag_candidates_total",
+                              node="node-1") == 1
 
 
 def test_doctor_reports_version_and_slowest_trace(live_stack):

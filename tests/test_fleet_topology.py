@@ -1,0 +1,663 @@
+"""Fleet topology & fragmentation plane (ISSUE 17).
+
+Unit coverage for the worker-side /topoz view (collector/topology.py:
+grid derivation, label-source caching, the snapshot join) and the master
+model (master/topology.py: component scoring, fragmentation arithmetic,
+group contiguity, the defrag candidate report + its telemetry pairing,
+the cross-shard rollup, vanished-series hygiene); then the acceptance
+e2es on the sim stacks — a 4-host fleet fragments and the plane scores
+it within one tick, names the movable idle-preferred grant, and the
+score drops when it releases; a 2-host group's contiguity verdict flips
+on a scattered migration; TPU_TOPOLOGY=0 restores the pre-topology
+payloads byte-for-byte; and a 2-master split's global tenant rollup
+equals the sum of the per-shard brokers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import time
+import types
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.collector.topology import (NodeTopologyView, host_grid,
+                                               node_topology_source)
+from gpumounter_tpu.master.admission import BrokerConfig
+from gpumounter_tpu.master.topology import (FleetTopology, _components,
+                                            _score_free_set)
+from gpumounter_tpu.testing.chaos import assert_topology_invariants
+from gpumounter_tpu.testing.sim import (LiveStack, MultiMasterStack,
+                                        MultiNodeStack, WorkerRig,
+                                        make_tpu_node)
+from gpumounter_tpu.utils.config import HostPaths
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+
+def _get_json(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _host(tmp_path, i):
+    base = tmp_path / f"node{i}"
+    for sub in ("dev", "proc", "sys/fs/cgroup"):
+        (base / sub).mkdir(parents=True)
+    return HostPaths(dev_root=str(base / "dev"),
+                     proc_root=str(base / "proc"),
+                     sys_root=str(base / "sys"),
+                     cgroup_root=str(base / "sys" / "fs" / "cgroup"),
+                     kubelet_socket=str(base / "pr" / "kubelet.sock"))
+
+
+# -- worker side: grid + snapshot ----------------------------------------------
+
+def test_host_grid_advertised_topology_wins_when_it_fits():
+    assert host_grid("2x2", 4) == (2, 2)
+    assert host_grid("1x2", 2) == (1, 2)
+    assert host_grid("8", 8) == (1, 8)
+    # 3-D advertised forms fold to (d0, rest)
+    assert host_grid("2x2x2", 8) == (2, 4)
+
+
+def test_host_grid_falls_back_to_near_square():
+    # multi-host slice label: the product exceeds THIS host's chips
+    assert host_grid("2x4", 4) == (2, 2)
+    # no label at all
+    assert host_grid("", 8) == (2, 4)
+    assert host_grid("", 6) == (2, 3)
+    assert host_grid("", 7) == (1, 7)
+    assert host_grid("garbage", 4) == (2, 2)
+    assert host_grid("", 0) == (0, 0)
+
+
+def test_node_topology_source_caches_and_retries_failures(fake_host):
+    rig = WorkerRig(fake_host, n_chips=4)
+    try:
+        calls = {"n": 0}
+        real_get_node = rig.sim.kube.get_node
+
+        def counting_get_node(name):
+            calls["n"] += 1
+            return real_get_node(name)
+
+        rig.sim.kube.get_node = counting_get_node
+        source = node_topology_source(rig.sim.kube, "node-a")
+        # no node object yet: degrades to None, no raise
+        assert source() is None
+        assert calls["n"] == 1
+        rig.sim.kube.put_node(make_tpu_node(name="node-a"))
+        # still inside the failure-retry fuse: cached None
+        assert source() is None
+        assert calls["n"] == 1
+        fresh = node_topology_source(rig.sim.kube, "node-a")
+        topo = fresh()
+        assert topo is not None and topo.topology == "2x2"
+        # TTL cache: the second read is free
+        assert fresh().topology == "2x2"
+        assert calls["n"] == 2
+    finally:
+        rig.close()
+
+
+def test_worker_topoz_snapshot_joins_mesh_and_ownership(fake_host):
+    """The /topoz payload: every chip at its grid coordinate, leased
+    chips attributed through the slave pod to the real owner — assembled
+    from the collector's cached inventory."""
+    rig = WorkerRig(fake_host, n_chips=4, topo=True)
+    try:
+        rig.sim.kube.put_node(make_tpu_node(name="node-a"))
+        outcome = rig.service.add_tpu("workload", "default", 2, False)
+        assert outcome.result.name == "SUCCESS", outcome
+        snap = rig.topo.snapshot()
+        assert snap["enabled"] is True
+        assert snap["node"] == "node-a"
+        assert snap["topology"] == "2x2"
+        assert snap["mesh"] == [2, 2]
+        assert snap["chips_per_host"] == 4
+        assert [c["coord"] for c in snap["chips"]] == \
+            [[0, 0], [0, 1], [1, 0], [1, 1]]
+        assert snap["free"] + snap["leased"] == 4
+        assert snap["leased"] == 2
+        leased = [c for c in snap["chips"] if c["state"] == "leased"]
+        for chip in leased:
+            assert chip["owner"] == "default/workload", chip
+            assert chip["slave_pod"], chip
+        free = [c for c in snap["chips"] if c["state"] == "free"]
+        assert all("owner" not in c for c in free)
+    finally:
+        rig.close()
+
+
+def test_worker_topoz_grid_without_node_labels(fake_host):
+    """No node object / no labels: the grid comes from the chip count,
+    never an error on the serving path."""
+    rig = WorkerRig(fake_host, n_chips=4, topo=True)
+    try:
+        snap = rig.topo.snapshot()
+        assert snap["topology"] == ""
+        assert snap["mesh"] == [2, 2]
+        assert snap["free"] == 4
+    finally:
+        rig.close()
+
+
+# -- master side: scoring primitives -------------------------------------------
+
+def test_components_bfs_four_neighbour():
+    comps = _components({(0, 0), (0, 1), (1, 1), (3, 3)})
+    sizes = sorted(len(c) for c in comps)
+    assert sizes == [1, 3]
+    # diagonal is NOT adjacency
+    assert sorted(len(c) for c in _components({(0, 0), (1, 1)})) == [1, 1]
+    assert _components(set()) == []
+
+
+def test_score_free_set_alignment_and_stranding():
+    aligned = [1, 2, 4]
+    # an L of 3: largest aligned block that fits is 2, one chip stranded
+    largest, stranded, sizes = _score_free_set(
+        {(0, 1), (1, 0), (1, 1)}, aligned)
+    assert (largest, stranded, sizes) == (2, 1, [3])
+    # full 2x2: a perfect 4-block, nothing stranded
+    largest, stranded, sizes = _score_free_set(
+        {(0, 0), (0, 1), (1, 0), (1, 1)}, aligned)
+    assert (largest, stranded, sizes) == (4, 0, [4])
+    # two isolated singles: aligned size 1 fits each, no stranding
+    largest, stranded, sizes = _score_free_set(
+        {(0, 0), (1, 1)}, aligned)
+    assert (largest, stranded, sizes) == (1, 0, [1, 1])
+    assert _score_free_set(set(), aligned) == (0, 0, [])
+
+
+def _payload(leased, n=4, topology="2x2",
+             accelerator="tpu-v5-lite-podslice", owners=None):
+    """A /topoz payload for a 2x2 host with ``leased`` chip ranks."""
+    rows, cols = host_grid(topology, n)
+    chips = []
+    for rank in range(n):
+        chip = {"chip": f"uuid-{rank}", "index": rank,
+                "coord": [rank // cols, rank % cols],
+                "device_path": f"/dev/accel{rank}",
+                "state": "leased" if rank in leased else "free"}
+        if rank in leased and owners:
+            chip["owner"] = owners.get(rank, "")
+        chips.append(chip)
+    return {"enabled": True, "node": "", "accelerator": accelerator,
+            "topology": topology, "chips_per_host": n,
+            "mesh": [rows, cols], "chips": chips,
+            "free": n - len(leased), "leased": len(leased)}
+
+
+def _lease(pod, node, chips=2, ns="default", tenant="teamA",
+           uuids=(), group="", idle=None):
+    return types.SimpleNamespace(
+        namespace=ns, pod=pod, tenant=tenant, chips=chips,
+        uuids=set(uuids), node=node, group=group, idle_since_unix=idle)
+
+
+def test_tick_scores_nodes_and_fleet():
+    topo = FleetTopology()
+    topo.ingest("node-0", _payload({0}))            # L of 3 free
+    topo.ingest("node-1", _payload({0, 3}))         # checkerboard
+    topo.tick()
+    view = topo.fleetz_section()
+    assert view is not None
+    assert view["nodes"]["node-0"] == {
+        "free": 3, "leased": 1, "largest_free_block": 2, "stranded": 1,
+        "free_components": [3], "frag": round(1 - 2 / 3, 4),
+        "mesh": [2, 2], "topology": "2x2"}
+    assert view["nodes"]["node-1"]["largest_free_block"] == 1
+    assert view["nodes"]["node-1"]["free_components"] == [1, 1]
+    assert view["score"] == round(1 - 2 / 5, 4)
+    assert view["stranded"] == 1
+    assert_topology_invariants(view)
+    # gauges exported on the tick
+    assert REGISTRY.fleet_fragmentation_score.value() == view["score"]
+    assert REGISTRY.stranded_chips.value() == 1
+    assert REGISTRY.node_free_contiguous_chips.value(node="node-0") == 2
+    topo.withdraw()
+
+
+def test_ingest_disabled_or_dead_node_withdraws_it():
+    topo = FleetTopology()
+    topo.ingest("node-0", _payload(set()))
+    topo.ingest("node-1", _payload(set()))
+    topo.tick()
+    assert set(topo.fleetz_section()["nodes"]) == {"node-0", "node-1"}
+    topo.ingest("node-1", {"enabled": False})
+    topo.tick()
+    assert set(topo.fleetz_section()["nodes"]) == {"node-0"}
+    # pruned when it leaves the live fleet entirely
+    topo.tick(live_nodes=set())
+    assert topo.fleetz_section() is None
+    topo.withdraw()
+
+
+def test_vanished_node_gauge_zeroed_once_then_forgotten():
+    topo = FleetTopology()
+    topo.ingest("node-z", _payload(set()))
+    topo.tick()
+    assert REGISTRY.node_free_contiguous_chips.value(node="node-z") == 4
+    topo.ingest("node-z", None)
+    topo.tick()
+    assert REGISTRY.node_free_contiguous_chips.value(node="node-z") == 0
+    # forgotten: later ticks do NOT keep re-zeroing the dead series
+    REGISTRY.node_free_contiguous_chips.set(7, node="node-z")
+    topo.tick()
+    assert REGISTRY.node_free_contiguous_chips.value(node="node-z") == 7
+    REGISTRY.node_free_contiguous_chips.set(0, node="node-z")
+    topo.withdraw()
+
+
+def test_withdraw_zeroes_every_exported_series():
+    topo = FleetTopology(
+        groups_fn=lambda: {"g-w": [_lease("p", "node-0", group="g-w")]},
+        local_usage_fn=lambda: {"teamW": 3})
+    topo.ingest("node-0", _payload({0}))
+    topo.tick()
+    assert REGISTRY.slice_contiguity.value(group="g-w") == 1
+    assert REGISTRY.tenant_chips_in_use_global.value(tenant="teamW") == 3
+    topo.withdraw()
+    assert REGISTRY.fleet_fragmentation_score.value() == 0.0
+    assert REGISTRY.stranded_chips.value() == 0
+    assert REGISTRY.node_free_contiguous_chips.value(node="node-0") == 0
+    assert REGISTRY.slice_contiguity.value(group="g-w") == 0
+    assert REGISTRY.tenant_chips_in_use_global.value(tenant="teamW") == 0
+
+
+def test_group_contiguity_judged_against_host_order():
+    groups = {"g-adj": [_lease("a", "node-0", group="g-adj"),
+                        _lease("b", "node-1", group="g-adj")],
+              "g-torn": [_lease("c", "node-0", group="g-torn"),
+                         _lease("d", "node-2", group="g-torn")],
+              "g-unknown": [_lease("e", "node-9", group="g-unknown")]}
+    topo = FleetTopology(groups_fn=lambda: groups)
+    for i in range(3):
+        topo.ingest(f"node-{i}", _payload(set()))
+    topo.tick()
+    view = topo.fleetz_section()
+    assert view["groups"]["g-adj"]["contiguous"] is True
+    assert view["groups"]["g-torn"]["contiguous"] is False
+    # a group on hosts outside the model is unknown, never "torn"
+    assert view["groups"]["g-unknown"]["contiguous"] is None
+    assert REGISTRY.slice_contiguity.value(group="g-adj") == 1
+    assert REGISTRY.slice_contiguity.value(group="g-torn") == 0
+    topo.withdraw()
+
+
+def test_defrag_candidates_idle_preferred_and_actionable_only():
+    # node-0: lease at rank 0 strands the L of 3 (gain 2 if it moved);
+    # node-1 is fully free (room to receive it); node-2's lease has the
+    # same gain but is IDLE and must sort first.
+    leases = [
+        _lease("busy-pod", "node-0", chips=1, uuids={"uuid-0"}),
+        _lease("idle-pod", "node-2", chips=1, uuids={"uuid-0"},
+               idle=time.time()),
+    ]
+    topo = FleetTopology(leases_fn=lambda: leases)
+    topo.ingest("node-0", _payload({0}))
+    topo.ingest("node-1", _payload(set()))
+    topo.ingest("node-2", _payload({0}))
+    topo.tick()
+    cands = topo.fleetz_section()["defrag_candidates"]
+    assert [c["pod"] for c in cands] == ["idle-pod", "busy-pod"]
+    assert cands[0]["idle"] is True and cands[1]["idle"] is False
+    assert all(c["gain"] == 2 for c in cands)
+    topo.withdraw()
+
+
+def test_defrag_candidate_needs_somewhere_to_go():
+    """A move that frees a block but fits NOWHERE else today is not
+    actionable — no candidate, no event."""
+    leases = [_lease("pod-a", "node-0", chips=1, uuids={"uuid-0"})]
+    topo = FleetTopology(leases_fn=lambda: leases)
+    topo.ingest("node-0", _payload({0}))       # the only node
+    before = REGISTRY.defrag_candidates.value(node="node-0")
+    topo.tick()
+    assert topo.fleetz_section()["defrag_candidates"] == []
+    assert REGISTRY.defrag_candidates.value(node="node-0") == before
+    topo.withdraw()
+
+
+def test_defrag_candidate_event_fires_once_per_new_candidate():
+    from gpumounter_tpu.utils.events import EVENTS
+    leases = [_lease("pod-a", "node-0", chips=1, uuids={"uuid-0"})]
+    topo = FleetTopology(leases_fn=lambda: leases)
+    topo.ingest("node-0", _payload({0}))
+    topo.ingest("node-1", _payload(set()))
+    before = REGISTRY.defrag_candidates.value(node="node-0")
+    topo.tick()
+    topo.tick()        # same candidate again: deduped, no re-fire
+    assert REGISTRY.defrag_candidates.value(node="node-0") == before + 1
+    # tail, not snapshot(): under a full tier-1 run the shared ring
+    # already holds >256 older events and the default page keeps the
+    # OLDEST matches — the event just emitted sits at the newest end
+    events = [e for e in EVENTS.tail(64)
+              if e["kind"] == "defrag_candidate"
+              and e.get("pod") == "pod-a"]
+    assert len(events) == 1
+    event = events[-1]
+    assert event["node"] == "node-0" and event["tenant"] == "teamA"
+    assert event["attrs"]["gain"] == 2
+    # the candidate leaves the report (lease released) and re-enters:
+    # a NEW decision, it fires again
+    released = []
+    topo.leases_fn = lambda: released
+    topo.tick()
+    topo.leases_fn = lambda: leases
+    topo.tick()
+    assert REGISTRY.defrag_candidates.value(node="node-0") == before + 2
+    topo.withdraw()
+
+
+def test_rollup_sums_local_usage_and_skips_self_and_expired():
+    peers = {0: {"holder": "me", "url": "http://127.0.0.1:1", "fence": 1,
+                 "expired": False},
+             1: {"holder": "ghost", "url": "http://127.0.0.1:1",
+                 "fence": 2, "expired": True}}
+    topo = FleetTopology(local_usage_fn=lambda: {"teamA": 2},
+                         peers_fn=lambda: peers, replica="me")
+    topo.tick()
+    rollup = topo.global_tenants()
+    # self + expired both skipped: nothing scraped, nothing errored
+    assert rollup == {"tenants": {"teamA": 2}, "peers_scraped": 0,
+                      "peer_errors": 0}
+    assert REGISTRY.tenant_chips_in_use_global.value(tenant="teamA") == 2
+    # no usage source at all (worker-only rigs): no rollup, no section
+    bare = FleetTopology()
+    bare.tick()
+    assert bare.global_tenants() is None
+    topo.withdraw()
+
+
+def test_snapshot_serves_raw_maps_and_scored_view():
+    topo = FleetTopology()
+    snap = topo.snapshot()
+    assert snap["enabled"] is True and snap["fleet"] is None
+    assert snap["ticks"] == 0 and snap["nodes"] == {}
+    topo.ingest("node-0", _payload({0}))
+    topo.tick()
+    snap = topo.snapshot()
+    assert snap["ticks"] == 1
+    assert snap["fleet"]["nodes"]["node-0"]["stranded"] == 1
+    assert snap["nodes"]["node-0"]["mesh"] == [2, 2]
+    assert len(snap["nodes"]["node-0"]["chips"]) == 4
+    topo.withdraw()
+
+
+# -- acceptance e2e: fragmentation scored, defrag named, release drops it ------
+
+def test_e2e_fragmentation_scored_and_defrag_candidate_named(tmp_path):
+    """ISSUE 17 acceptance: fragmented grants across a 4-host fleet →
+    the score and stranded count land in /fleetz within ONE tick, the
+    defrag report names the movable idle-preferred grant, releasing it
+    drops the score next tick, and the CLI renders + exits on it."""
+    stack = MultiNodeStack([_host(tmp_path, i) for i in range(4)],
+                           n_chips=4, health=True, topo=True,
+                           broker_config=BrokerConfig())
+    try:
+        def attach(i, n):
+            body = _get_json(
+                f"{stack.base}/addtpu/namespace/default/pod/workload-{i}"
+                f"/tpu/{n}/isEntireMount/false?tenant=team{i}",
+                timeout=60)
+            assert body["result"] == "SUCCESS", body
+
+        # 1 chip on node-0 strands one of its 3 free chips (L-shape);
+        # 2 chips on each of nodes 1-3 leave 2x1 free blocks — no node
+        # fully free, so the largest schedulable block fleet-wide is 2
+        attach(0, 1)
+        for i in (1, 2, 3):
+            attach(i, 2)
+        # mark node-1's grant idle (the PR 10 signal the report prefers)
+        leases = stack.gateway.broker.leases.leases()
+        lease_1 = next(l for l in leases if l.pod == "workload-1")
+        lease_1.idle_since_unix = time.time()
+
+        states = stack.gateway.fleet.tick()
+        assert set(states.values()) == {"fresh"}, states
+        fleetz = _get_json(f"{stack.base}/fleetz")
+        topo = fleetz["topology"]
+        assert_topology_invariants(topo)
+        # free: 3 + 2+2+2 = 9, largest schedulable block 2
+        assert topo["free"] == 9
+        assert topo["largest_free_block"] == 2
+        assert topo["score"] == pytest.approx(1 - 2 / 9, abs=1e-3)
+        assert topo["stranded"] == 1
+        assert topo["nodes"]["node-0"]["stranded"] == 1
+        assert topo["nodes"]["node-0"]["frag"] == \
+            pytest.approx(1 - 2 / 3, abs=1e-3)
+        # the idle grant leads the candidate report
+        cands = topo["defrag_candidates"]
+        assert cands, topo
+        assert cands[0]["pod"] == "workload-1"
+        assert cands[0]["idle"] is True
+        assert cands[0]["node"] == "node-1"
+        assert cands[0]["gain"] > 0
+        # paired telemetry: counter + event, once per new candidate
+        assert REGISTRY.defrag_candidates.value(node="node-1") >= 1
+        # limit=-1: under a full tier-1 run the shared ring holds >256
+        # older events and the default page keeps the OLDEST matches
+        eventz = _get_json(f"{stack.base}/eventz?limit=-1")
+        kinds = [e for e in eventz["events"]
+                 if e["kind"] == "defrag_candidate"]
+        assert any(e.get("pod") == "workload-1" for e in kinds)
+        # gauges carry the scored view
+        assert REGISTRY.fleet_fragmentation_score.value() == \
+            pytest.approx(topo["score"], abs=1e-6)
+        assert REGISTRY.stranded_chips.value() == 1
+        # the global rollup sums this (single) shard's usage
+        assert fleetz["global_tenants"]["tenants"]["team1"] == 2
+
+        # the master /topoz serves the raw maps the CLI renders
+        topoz = _get_json(f"{stack.base}/topoz")
+        assert topoz["enabled"] is True
+        assert set(topoz["nodes"]) == {f"node-{i}" for i in range(4)}
+
+        # tpumounterctl topo: ASCII map + WARNING, exit non-zero on
+        # stranded; fleet grows the frag column + summary line
+        from gpumounter_tpu import cli
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli.main(["--master", stack.base, "topo"])
+        rendered = out.getvalue()
+        assert rc != 0, rendered
+        assert "STRANDED" in rendered and "WARNING" in rendered
+        assert "defrag candidate: default/workload-1" in rendered
+        assert "." in rendered          # free cells in the grid
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            cli.main(["--master", stack.base, "fleet"])
+        assert "frag[" in out.getvalue()
+
+        # releasing the named grant merges node-1 whole: score DROPS
+        release = urllib.request.Request(
+            f"{stack.base}/removetpu/namespace/default/pod/workload-1"
+            f"/force/false", data=b"{}", method="POST")
+        with urllib.request.urlopen(release, timeout=60) as resp:
+            body = json.loads(resp.read())
+        assert body["result"] == "SUCCESS", body
+        stack.gateway.fleet.tick()
+        after = _get_json(f"{stack.base}/fleetz")["topology"]
+        assert_topology_invariants(after)
+        assert after["largest_free_block"] == 4
+        assert after["free"] == 11
+        assert after["score"] == pytest.approx(1 - 4 / 11, abs=1e-3)
+        assert after["score"] < topo["score"]
+    finally:
+        stack.close()
+
+
+def test_e2e_slice_contiguity_flips_on_scattered_migration(tmp_path):
+    """A 2-host gang on adjacent hosts judges contiguous; after a member
+    migrates to a non-adjacent host the verdict (and gauge) flip within
+    one tick."""
+    stack = MultiNodeStack([_host(tmp_path, i) for i in range(4)],
+                           n_chips=4, health=True, topo=True)
+    try:
+        req = urllib.request.Request(
+            f"{stack.base}/addtpuslice",
+            data=json.dumps({
+                "pods": [{"namespace": "default", "pod": "workload-0"},
+                         {"namespace": "default", "pod": "workload-1"}],
+                "tpusPerHost": 4}).encode(),
+            method="POST")
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            body = json.loads(resp.read())
+        assert body["result"] == "SUCCESS", body
+
+        stack.gateway.fleet.tick()
+        topo = _get_json(f"{stack.base}/fleetz")["topology"]
+        groups = topo["groups"]
+        assert len(groups) == 1
+        group = next(iter(groups))
+        assert groups[group]["hosts"] == ["node-0", "node-1"]
+        assert groups[group]["contiguous"] is True
+        assert REGISTRY.slice_contiguity.value(group=group) == 1
+
+        # the migration's end state: the member's lease now lives on
+        # node-3 (what repair/migration record after moving it)
+        for lease in stack.gateway.broker.leases.groups()[group]:
+            if lease.node == "node-1":
+                lease.node = "node-3"
+        stack.gateway.fleet.tick()
+        groups = _get_json(f"{stack.base}/fleetz")["topology"]["groups"]
+        assert groups[group]["hosts"] == ["node-0", "node-3"]
+        assert groups[group]["contiguous"] is False
+        assert REGISTRY.slice_contiguity.value(group=group) == 0
+    finally:
+        stack.close()
+
+
+# -- TPU_TOPOLOGY=0: byte-for-byte pre-topology payloads -----------------------
+
+def test_topology_off_restores_pre_topology_payloads(fake_host,
+                                                     monkeypatch):
+    """TPU_TOPOLOGY=0 semantics: no worker view, no master model —
+    /topoz answers the disabled stub on the worker and 404 on the
+    master, and /fleetz carries neither new section (byte-for-byte the
+    pre-topology payload)."""
+    monkeypatch.setenv("TPU_TOPOLOGY", "0")
+    rig = WorkerRig(fake_host, n_chips=4)          # topo=False
+    stack = LiveStack(rig, broker_config=BrokerConfig(),
+                      shared_kube=True)
+    try:
+        assert stack.gateway.topology is None
+        pod = rig.sim.add_target_pod(name="pod-z")
+        rig.provision_container(pod)
+        body = _get_json(
+            f"{stack.base}/addtpu/namespace/default/pod/pod-z"
+            f"/tpu/2/isEntireMount/true", timeout=60)
+        assert body["result"] == "SUCCESS", body
+        health = f"http://127.0.0.1:{stack.health_server.server_port}"
+        assert _get_json(f"{health}/topoz") == {"enabled": False}
+        stack.gateway.fleet.tick()
+        fleetz = _get_json(f"{stack.base}/fleetz")
+        assert "topology" not in fleetz
+        assert "global_tenants" not in fleetz
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{stack.base}/topoz", timeout=30)
+        assert exc.value.code == 404
+        assert json.loads(exc.value.read())["result"] == "NoSuchRoute"
+        # the CLI reports the disabled plane as a state, exit 0
+        from gpumounter_tpu import cli
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = cli.main(["--master", stack.base, "topo"])
+        assert rc == 0
+        assert "disabled" in out.getvalue()
+    finally:
+        stack.close()
+
+
+def test_workers_off_masters_on_keeps_fleetz_topology_free(fake_host):
+    """Workers on TPU_TOPOLOGY=0 under a topology-enabled master: the
+    scrape sees the disabled stub, nothing is ingested, and /fleetz
+    never grows a topology section — only the (local) global rollup."""
+    rig = WorkerRig(fake_host, n_chips=4)          # topo=False
+    stack = LiveStack(rig, broker_config=BrokerConfig(),
+                      shared_kube=True)
+    try:
+        assert stack.gateway.topology is not None
+        stack.gateway.fleet.tick()
+        fleetz = _get_json(f"{stack.base}/fleetz")
+        assert "topology" not in fleetz
+        assert fleetz["global_tenants"]["tenants"] == {}
+        topoz = _get_json(f"{stack.base}/topoz")
+        assert topoz["enabled"] is True and topoz["nodes"] == {}
+    finally:
+        stack.close()
+
+
+# -- acceptance e2e: cross-shard global tenant rollup --------------------------
+
+def test_e2e_cross_shard_rollup_equals_per_shard_brokerz(fake_host):
+    """ISSUE 17 acceptance: under a 2-master split, every replica's
+    global_tenants equals the SUM of both shards' /brokerz usage —
+    per-shard /brokerz keeps showing only its slice."""
+    rig = WorkerRig(fake_host, n_chips=4)
+    stack = MultiMasterStack(rig, masters=2, shards=2)
+    try:
+        stack.wait_converged()
+        # "default" and "other" hash to different shards (asserted, so
+        # a ring change breaks this loudly instead of hollowing it out)
+        assert stack.ring.shard_of("default") != \
+            stack.ring.shard_of("other")
+        other_pod = rig.sim.add_target_pod(
+            name="pod-o", namespace="other", uid="uid-o",
+            container_id="containerd://" + "cd" * 32)
+        rig.provision_container(other_pod)
+
+        def attach(ns, pod, n, tenant):
+            leader = stack.leader_for(ns)
+            body = _get_json(
+                f"{stack.bases[leader]}/addtpu/namespace/{ns}/pod/{pod}"
+                f"/tpu/{n}/isEntireMount/false?tenant={tenant}",
+                timeout=60)
+            assert body["result"] == "SUCCESS", body
+
+        attach("default", "workload", 2, "teamA")
+        attach("other", "pod-o", 1, "teamB")
+
+        # each broker holds ONLY its shard's slice
+        per_shard: dict[str, int] = {}
+        for i in stack.live():
+            brokerz = _get_json(f"{stack.bases[i]}/brokerz")
+            for tenant, info in brokerz["tenants"].items():
+                per_shard[tenant] = (per_shard.get(tenant, 0)
+                                     + info["in_use"])
+        assert per_shard == {"teamA": 2, "teamB": 1}
+
+        for i in stack.live():
+            stack.gateways[i].fleet.tick()
+        total_scraped = 0
+        for i in stack.live():
+            fleetz = _get_json(f"{stack.bases[i]}/fleetz")
+            rollup = fleetz["global_tenants"]
+            assert rollup["tenants"] == per_shard, (i, rollup)
+            # the election may hand BOTH shards to one master — expected
+            # peer count is the distinct non-self live holders, exactly
+            # the rollup's own discovery rule
+            gw = stack.gateways[i]
+            expected = len({
+                str(info.get("holder") or "")
+                for info in gw.election.leaders().values()
+                if not info.get("expired")
+                and str(info.get("url") or "")
+                and str(info.get("holder") or "") != gw.topology.replica})
+            assert rollup["peers_scraped"] == expected, (i, rollup)
+            assert rollup["peer_errors"] == 0
+            total_scraped += rollup["peers_scraped"]
+        # with 2 live masters SOMEBODY is not the holder of everything:
+        # at least one real cross-master /brokerz scrape happened
+        assert total_scraped >= 1
+        assert REGISTRY.tenant_chips_in_use_global.value(
+            tenant="teamA") == 2
+        assert REGISTRY.tenant_chips_in_use_global.value(
+            tenant="teamB") == 1
+    finally:
+        stack.close()

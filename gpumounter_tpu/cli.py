@@ -728,6 +728,64 @@ def cmd_topo(args) -> int:
     return EXIT_OTHER if stranded else 0
 
 
+def cmd_defrag(args) -> int:
+    """Render the fleet defragmenter's state (the ``defrag`` section of
+    the master's /fleetz): mode, the standing gain-sorted plans, the
+    recent move ring with outcomes, moves in flight and the sliding
+    move budget. Exit non-zero when the budget is exhausted — the
+    actuator has halted itself and the fleet stays fragmented until the
+    window slides (or someone raises TPU_DEFRAG_BUDGET)."""
+    try:
+        payload = json.loads(_fetch_text(args.master, "/fleetz",
+                                         args.timeout))
+    except TransportError as e:
+        print(f"unreachable: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    except ValueError as e:
+        print(f"bad /fleetz payload: {e}", file=sys.stderr)
+        return EXIT_TRANSPORT
+    defrag = payload.get("defrag")
+    if not isinstance(defrag, dict):
+        # TPU_DEFRAG_MODE=0 removes the actuator AND its /fleetz
+        # section — a disabled defragmenter is a state, not an error
+        _emit({"defrag": None}, args.json,
+              "defragmenter disabled on this target (TPU_DEFRAG_MODE=0 "
+              "— no planning, no moves; the topology plane may still "
+              "report candidates under `tpumounterctl topo`)")
+        return 0
+    budget = defrag.get("budget") or {}
+    mode = defrag.get("mode", "?")
+    lines = [
+        f"defrag: mode {mode}"
+        + (" (journal + report only — no moves)" if mode == "plan"
+           else "")
+        + f", {defrag.get('inflight', 0)} move(s) in flight, "
+        f"budget {budget.get('used', 0)}/{budget.get('limit', 0)} "
+        f"move(s) in the last {float(budget.get('window_s') or 0):g}s"]
+    if budget.get("exhausted"):
+        lines.append("  BUDGET EXHAUSTED — actuator halted until the "
+                     "window slides")
+    plans = defrag.get("plans") or []
+    for plan in plans:
+        lines.append(
+            f"  plan {plan.get('rid')}: move {plan.get('namespace')}/"
+            f"{plan.get('pod')} (tenant {plan.get('tenant')}, "
+            f"{plan.get('chips')} chip(s)) off {plan.get('node')} — "
+            f"grows the largest free block by {plan.get('gain')} "
+            f"(group {plan.get('group')})")
+    if not plans:
+        lines.append("  no standing plans — nothing is eligible to "
+                     "move (fragmentation below gain, leases busy, or "
+                     "hysteresis still counting)")
+    for entry in defrag.get("recent") or []:
+        detail = " ".join(f"{k}={v}" for k, v in sorted(entry.items())
+                          if k not in ("outcome", "unix"))
+        lines.append(f"  recent: {str(entry.get('outcome', '?')).upper()}"
+                     + (f"  {detail}" if detail else ""))
+    _emit(defrag, args.json, "\n".join(lines))
+    return EXIT_OTHER if budget.get("exhausted") else 0
+
+
 def cmd_fleet(args) -> int:
     """Render the master's /fleetz cluster view: per-node scrape health,
     per-tenant chips in use, top SLO burn, and the merged lifecycle event
@@ -1421,6 +1479,38 @@ def cmd_doctor(args) -> int:
                   "capacity in mesh fragments no topology-aligned "
                   "grant can use — `tpumounterctl topo` maps them")
 
+    # Fleet defragmenter: moves are designed to be RARE (hysteresis,
+    # idle-only, sliding budget). More than one live migration inside
+    # one doctor window is a migration storm — exactly the churn the
+    # interlocks exist to prevent — and a budget_exhausted transition
+    # means the actuator halted itself mid-consolidation. Both WARN:
+    # the defragmenter defers rather than degrades, so this costs
+    # compaction, never correctness.
+    if metrics and metrics.get("tpumounter_defrag_moves_total"):
+        src = metrics_delta if metrics_delta is not None else metrics
+        scope = (f"in the last {window:g}s" if metrics_delta is not None
+                 else "lifetime")
+        migrated = _counter_total(src, "tpumounter_defrag_moves_total",
+                                  outcome="migrated")
+        exhausted = _counter_total(src, "tpumounter_defrag_moves_total",
+                                   outcome="budget_exhausted")
+        storm = metrics_delta is not None and migrated > 1
+        if storm:
+            check("warn",
+                  f"defrag migration storm: {int(migrated)} live "
+                  f"migration(s) {scope} — moves should be rare "
+                  "(hysteresis + sliding budget); check the "
+                  "TPU_DEFRAG_* knobs and `tpumounterctl defrag`")
+        if exhausted:
+            check("warn",
+                  f"defrag budget exhausted {int(exhausted)}x {scope} "
+                  "— the actuator halted itself; the fleet stays "
+                  "fragmented until the window slides "
+                  "(`tpumounterctl defrag` for the standing plans)")
+        elif migrated and not storm:
+            check("ok", f"defrag: {int(migrated)} migration(s) {scope},"
+                        " budget never exhausted")
+
     # Elastic slice subsystem: a STRANDED slice transaction (intent
     # record older than its deadline that nothing is driving) is a
     # half-attached slice nobody will resolve — chips held on some hosts
@@ -2008,6 +2098,15 @@ def build_parser() -> argparse.ArgumentParser:
              "contiguity and the defrag candidate report (non-zero "
              "exit on stranded chips)")
     p.set_defaults(fn=cmd_topo)
+    _add_common(p, suppress=True)
+
+    p = sub.add_parser(
+        "defrag",
+        help="fleet defragmenter state from the master's /fleetz: "
+             "mode (plan/act), standing gain-sorted plans, recent move "
+             "outcomes, in-flight count and the sliding move budget "
+             "(non-zero exit when the budget is exhausted)")
+    p.set_defaults(fn=cmd_defrag)
     _add_common(p, suppress=True)
 
     p = sub.add_parser(

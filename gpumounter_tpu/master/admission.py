@@ -218,6 +218,10 @@ class AttachBroker:
         # preemption detach whole slices through it; rehydration hands
         # it stranded txn records. None = single-host semantics only.
         self._slice = None
+        # Fleet defragmenter (bind_defrag): shard rehydration hands it
+        # journaled defrag-move records to adopt or abort. None = no
+        # actuator (TPU_DEFRAG_MODE=0, or worker-only rigs).
+        self._defrag = None
         # A release/expiry/hand-back freed chips since the last tick:
         # the tick stamps the peer shards' capacity poke (request
         # threads never pay the ConfigMap round trip).
@@ -292,6 +296,13 @@ class AttachBroker:
         group-lease expiry/preemption detach whole slices through it,
         and shard rehydration hands it stranded txn records to adopt."""
         self._slice = manager
+
+    def bind_defrag(self, actuator) -> None:
+        """Wire the fleet defragmenter (master/defrag.py): shard
+        rehydration hands it the dead leader's journaled defrag moves,
+        so every in-flight migration is adopted (grow landed — finish
+        the detach) or aborted (group intact at the old placement)."""
+        self._defrag = actuator
 
     def bind_utilization(self, activity_fn) -> None:
         """Wire the fleet aggregator's per-lease activity feed
@@ -413,6 +424,21 @@ class AttachBroker:
                 rearmed = self._slice.adopt_barriers(barrier_records)
                 logger.info("shard %d: re-armed %d re-federation "
                             "barrier(s)", shard, rearmed)
+        if self._defrag is not None:
+            # journaled defrag moves the dead leader never resolved:
+            # the actuator adopts each against the group's ACTUAL
+            # membership — old placement or new, never half-moved
+            try:
+                defrag_records, _ = \
+                    self.store.rehydrate_defrag_moves(shard)
+            except K8sApiError as e:
+                logger.warning("shard %d defrag rehydration deferred: "
+                               "%s (tick retries)", shard, e)
+                defrag_records = []
+            if defrag_records:
+                adopted = self._defrag.adopt(defrag_records)
+                logger.info("shard %d: adopted %d stranded defrag "
+                            "move(s)", shard, adopted)
 
     # -- recovered-waiter adoption ---------------------------------------------
 

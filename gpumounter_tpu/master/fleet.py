@@ -142,6 +142,11 @@ class FleetAggregator:
         # = plane off (TPU_TOPOLOGY=0) — no scrape, no /fleetz
         # sections, no series (byte-for-byte, pinned).
         self.topology = topology
+        # Fleet defragmenter (master/defrag.py, bind_defrag): when
+        # bound, /fleetz carries its plans/recent-moves/budget section.
+        # None = actuator off (TPU_DEFRAG_MODE=0) — /fleetz stays
+        # byte-for-byte the pre-defrag payload.
+        self.defrag = None
         # Node failure domain (master/nodehealth.py): when bound, every
         # tick's per-node scrape outcome (fresh/missed + the healthz
         # text, which a draining worker changes) feeds the tracker's
@@ -513,6 +518,13 @@ class FleetAggregator:
             for key in stale:
                 del self._activity[key]
 
+    def bind_defrag(self, actuator) -> None:
+        """Wire the defrag actuator (master/defrag.py) so /fleetz
+        carries its ``defrag`` section. A binder (not a constructor
+        argument) because the actuator consumes this aggregator's
+        activity feed — it is built after it."""
+        self.defrag = actuator
+
     def lease_activity(self) -> dict[tuple[str, str], dict]:
         """Point-in-time copy of the per-owner activity map — the
         broker's idle-lease marking joins this to its lease table
@@ -643,6 +655,10 @@ class FleetAggregator:
             tenants_global = self.topology.global_tenants()
             if tenants_global is not None:
                 out["global_tenants"] = tenants_global
+        if self.defrag is not None:
+            # absent entirely under TPU_DEFRAG_MODE=0 — the pre-defrag
+            # /fleetz payload stays byte-for-byte
+            out["defrag"] = self.defrag.fleetz_section()
         if self.node_health is not None:
             # absent entirely under TPU_NODE_HEALTH=0 — the pre-
             # subsystem /fleetz payload stays byte-for-byte

@@ -267,7 +267,13 @@ class MasterGateway:
                 groups_fn=self.broker.leases.groups,
                 local_usage_fn=self.broker.leases.usage,
                 peers_fn=self._topology_peers,
-                replica=self.ha.replica)
+                replica=self.ha.replica,
+                # candidates on cordoned/fenced nodes are pruned between
+                # ticks (a dead candidate must not persist in /fleetz or
+                # feed the defrag actuator a gone world)
+                node_excluded_fn=(self.nodehealth.cordoned
+                                  if self.nodehealth is not None
+                                  else None))
         self.fleet = FleetAggregator(
             targets_fn=self._fleet_targets,
             usage_fn=self.broker.leases.usage,
@@ -283,6 +289,44 @@ class MasterGateway:
         # fleet's observed per-lease activity to mark leases idle past
         # TPU_IDLE_LEASE_S (reclaim signal + preemption preference).
         self.broker.bind_utilization(self.fleet.lease_activity)
+        # Fleet defragmenter (master/defrag.py): the optimizer tick over
+        # the topology plane's candidate report — "plan" (the default)
+        # journals migration plans only; "act" executes them grow-first
+        # through the slice repair seam. TPU_DEFRAG_MODE=0 (or
+        # TPU_TOPOLOGY=0 — no report to consume) removes the actuator
+        # entirely: no thread, no /fleetz section, no series
+        # (byte-for-byte, pinned).
+        from gpumounter_tpu.master import defrag as defrag_mod
+        self.defrag = None
+        if self.topology is not None and defrag_mod.enabled():
+            def _env_num(name, default, cast):
+                try:
+                    return cast(os.environ.get(name, default))
+                except ValueError:
+                    return cast(default)
+            self.defrag = defrag_mod.DefragActuator(
+                slices=self.slices,
+                view_fn=self.topology.snapshot,
+                activity_fn=self.fleet.lease_activity,
+                node_excluded_fn=(self.nodehealth.cordoned
+                                  if self.nodehealth is not None
+                                  else None),
+                store=self.broker.store,
+                mode=defrag_mod.mode(),
+                hysteresis_ticks=_env_num(
+                    consts.ENV_DEFRAG_HYSTERESIS_TICKS,
+                    consts.DEFAULT_DEFRAG_HYSTERESIS_TICKS, int),
+                idle_duty_max=_env_num(
+                    consts.ENV_DEFRAG_IDLE_DUTY_MAX,
+                    consts.DEFAULT_DEFRAG_IDLE_DUTY_MAX, float),
+                max_inflight=_env_num(
+                    consts.ENV_DEFRAG_MAX_INFLIGHT,
+                    consts.DEFAULT_DEFRAG_MAX_INFLIGHT, int),
+                budget=_env_num(consts.ENV_DEFRAG_BUDGET,
+                                consts.DEFAULT_DEFRAG_BUDGET, int),
+                tick_interval_s=fleet_interval)
+            self.fleet.bind_defrag(self.defrag)
+            self.broker.bind_defrag(self.defrag)
         # gRPC target "ip:port" -> base URL of that worker's health/tracez
         # HTTP endpoint. The default follows the worker's fixed convention
         # (health on grpc_port + 1, worker/main.py HEALTH_PORT_OFFSET);
@@ -1528,6 +1572,8 @@ class MasterGateway:
         # it exported).
         self.broker.start()
         self.fleet.start()
+        if self.defrag is not None:
+            self.defrag.start()
         # HA: the election loop acquires/renews this replica's shard
         # locks; its lifetime is tied to the server's like the loops
         # above (a stopped master must release nothing by crashing — the
@@ -1545,6 +1591,8 @@ class MasterGateway:
         orig_shutdown = server.shutdown
 
         def shutdown_with_loops():
+            if self.defrag is not None:
+                self.defrag.stop()
             self.fleet.stop()
             self.broker.stop()
             if self.election is not None:

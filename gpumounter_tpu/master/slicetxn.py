@@ -513,6 +513,18 @@ class SliceTxnManager:
 
     # -- failover adoption -----------------------------------------------------
 
+    def txn_inflight(self, rid: str) -> bool:
+        """True while a live slice txn carries ``rid`` or ANY adoption
+        is still resolving — the defrag adopter (master/defrag.py)
+        polls this before judging an orphaned move against the group's
+        final membership (judging mid-adoption would race the very txn
+        whose outcome decides the move)."""
+        with self._lock:
+            if self._adopting:
+                return True
+            return any(t.record.rid == rid
+                       for t in self._txns.values())
+
     def adopt(self, records) -> int:
         """Resolve slice txn records a dead (or deposed) leader left
         behind: complete the fan-out under the original rid while the
@@ -841,6 +853,75 @@ class SliceTxnManager:
         return {"outcome": "migrated", "group": group,
                 "generation": generation, "added": list(spares),
                 "shrink_deferred": not ok}
+
+    # -- fleet defragmentation (master/defrag.py is the planner) ---------------
+
+    def migrate_member(self, group: str, member: tuple[str, str],
+                       rid: str) -> dict:
+        """The defragmenter's ONE entry into actuation
+        (tests/test_defrag_lint.py pins that every move crosses here):
+        relocate a single idle member onto a spare host as a grow-first
+        migration riding the repair machinery — the same crash-safe
+        slice txn, the same defer-never-degrade semantics, and the same
+        per-group exclusivity guard as ``repair_group`` (a repair in
+        flight wins; defrag yields and re-plans later)."""
+        member = tuple(member)
+        with self._lock:
+            if group in self._repairing:
+                return {"outcome": "deferred", "group": group,
+                        "why": "repair in flight"}
+            self._repairing.add(group)
+        try:
+            members = self.broker.leases.group_leases(group)
+            moving = [m for m in members
+                      if (m.namespace, m.pod) == member]
+            if not moving:
+                return {"outcome": "gone", "group": group}
+            survivors = [(m.namespace, m.pod) for m in members
+                         if (m.namespace, m.pod) != member]
+            info = self._ensure_group_info(group, members)
+            tpus = int(info.get("tpus_per_host")
+                       or members[0].chips or 1)
+            return self._migrate(group, moving, survivors, tpus,
+                                 members[0].tenant,
+                                 members[0].priority, "defrag", rid)
+        finally:
+            with self._lock:
+                self._repairing.discard(group)
+
+    def finish_member_detach(self, group: str, member: tuple[str, str],
+                             rid: str) -> bool:
+        """Complete an ADOPTED defrag move whose grow already landed: a
+        clean detach of the superseded member plus the generation bump
+        — the tail ``_migrate`` would have run had its master survived.
+        Returns False when the member could not leave yet (busy device,
+        or a repair holds the group); the group stays at full strength
+        either way and a later tick re-judges it."""
+        member = tuple(member)
+        with self._lock:
+            if group in self._repairing:
+                return False
+            self._repairing.add(group)
+        try:
+            members = self.broker.leases.group_leases(group)
+            if member not in [(m.namespace, m.pod) for m in members]:
+                return True     # already gone — nothing left to finish
+            info = self._ensure_group_info(group, members)
+            tpus = int(info.get("tpus_per_host")
+                       or members[0].chips or 1)
+            ok, results = self.detach_members(
+                [member], cause=f"defrag-adopt:{rid}", force=False,
+                rid=rid)
+            for result in results:
+                if result.result in _GONE:
+                    self.broker.release(result.namespace, result.pod)
+            target = [(m.namespace, m.pod)
+                      for m in self.broker.leases.group_leases(group)]
+            self._bump_generation(group, target, tpus, rid)
+            return ok
+        finally:
+            with self._lock:
+                self._repairing.discard(group)
 
     def _teardown_group(self, group: str, survivors:
                         list[tuple[str, str]], rid: str, cause: str,

@@ -263,6 +263,50 @@ class SliceBarrierRecord:
         return record
 
 
+@dataclasses.dataclass
+class DefragMoveRecord:
+    """One planned defrag migration (master/defrag.py), journaled BEFORE
+    the actuator touches anything. ``state`` says how far it got:
+    "planned" = computed only (plan mode, or act mode pre-actuation —
+    safe to drop, the next tick re-plans); "acting" = a grow-first slice
+    txn was (or was about to be) issued under ``rid``. A record still
+    present at rehydration is a move whose writer died mid-flight: the
+    adopting leader compares the group's membership against ``hosts``
+    (the pre-move member count) and either finishes the detach of the
+    old member (grow landed — the new placement) or drops the record
+    with the group intact (grow never landed / rolled back — the old
+    placement). Either way no group is ever left half-moved."""
+
+    group: str
+    namespace: str
+    pod: str                 # the member being moved off src_node
+    rid: str = ""
+    tenant: str = ""
+    priority: str = consts.DEFAULT_PRIORITY
+    tpus_per_host: int = 0
+    hosts: int = 0           # member count BEFORE the move (adopt key)
+    src_node: str = ""
+    gain: int = 0
+    created_unix: float = 0.0
+    state: str = "planned"   # "planned" | "acting"
+
+    @property
+    def annotation_key(self) -> str:
+        return (consts.STORE_DEFRAG_ANNOTATION_PREFIX
+                + _digest(f"{self.group}/{self.namespace}/{self.pod}"))
+
+    def to_json(self) -> str:
+        return _canonical(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, text: str) -> "DefragMoveRecord":
+        obj = json.loads(text)
+        record = cls(**obj)
+        if not record.group or not record.pod:
+            raise ValueError(f"defrag record missing identity: {text!r}")
+        return record
+
+
 class IntentStore:
     """Write-through persistence of broker intent, sharded by namespace.
 
@@ -427,6 +471,16 @@ class IntentStore:
 
     def delete_barrier(self, namespace: str, group: str) -> bool:
         key = consts.STORE_BARRIER_ANNOTATION_PREFIX + _digest(group)
+        return self._mutate(self.shard_of(namespace), key, None)
+
+    def put_defrag_move(self, record: DefragMoveRecord) -> bool:
+        return self._mutate(self.shard_of(record.namespace),
+                            record.annotation_key, record.to_json())
+
+    def delete_defrag_move(self, namespace: str, group: str,
+                           pod: str) -> bool:
+        key = (consts.STORE_DEFRAG_ANNOTATION_PREFIX
+               + _digest(f"{group}/{namespace}/{pod}"))
         return self._mutate(self.shard_of(namespace), key, None)
 
     # -- group commit (the coalescer seam) -------------------------------------
@@ -851,7 +905,7 @@ class IntentStore:
         # the records belong to the new leader now — freezing our last
         # counts would double-count them in any cross-replica sum (same
         # vanished-series discipline as lease.py's _known_tenants)
-        for kind in ("lease", "waiter", "slice"):
+        for kind in ("lease", "waiter", "slice", "defrag"):
             REGISTRY.store_records.set(0, kind=kind, shard=str(shard))
         self._export_lag_locked_free()
 
@@ -888,6 +942,11 @@ class IntentStore:
             1 for k in annotations
             if k.startswith(consts.STORE_BARRIER_ANNOTATION_PREFIX))
         REGISTRY.store_records.set(barriers, kind="barrier",
+                                   shard=str(shard))
+        defrag = sum(
+            1 for k in annotations
+            if k.startswith(consts.STORE_DEFRAG_ANNOTATION_PREFIX))
+        REGISTRY.store_records.set(defrag, kind="defrag",
                                    shard=str(shard))
 
     # -- rehydration -----------------------------------------------------------
@@ -988,6 +1047,37 @@ class IntentStore:
             except (ValueError, TypeError) as e:
                 torn += 1
                 logger.warning("torn barrier record %s dropped (%s)",
+                               key, e)
+        if torn:
+            self.torn_records += torn
+        return records, torn
+
+    def rehydrate_defrag_moves(self, shard: int
+                               ) -> tuple[list[DefragMoveRecord], int]:
+        """The shard's journaled defrag moves: (records, torn). A record
+        here after a failover is a migration whose planning leader died
+        — the adopting actuator (master/defrag.py adopt) finishes or
+        aborts it against the group's actual membership. Torn records
+        are counted and dropped (the next optimizer tick re-plans)."""
+        try:
+            cm = self.kube.get_config_map(self.namespace,
+                                          self.cm_name(shard))
+        except K8sApiError as e:
+            if e.status == 404:
+                return [], 0
+            raise
+        self._remember(shard, cm)
+        annotations = dict(cm.get("metadata", {}).get("annotations") or {})
+        records: list[DefragMoveRecord] = []
+        torn = 0
+        for key, value in annotations.items():
+            if not key.startswith(consts.STORE_DEFRAG_ANNOTATION_PREFIX):
+                continue
+            try:
+                records.append(DefragMoveRecord.from_json(value))
+            except (ValueError, TypeError) as e:
+                torn += 1
+                logger.warning("torn defrag record %s dropped (%s)",
                                key, e)
         if torn:
             self.torn_records += torn

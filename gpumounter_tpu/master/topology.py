@@ -130,16 +130,20 @@ class FleetTopology:
 
     def __init__(self, *, leases_fn=None, groups_fn=None,
                  local_usage_fn=None, peers_fn=None, replica: str = "",
-                 scrape_timeout_s: float = 1.0):
+                 scrape_timeout_s: float = 1.0, node_excluded_fn=None):
         # leases_fn() -> list[Lease] (broker table; defrag candidates);
         # groups_fn() -> {group: [Lease, ...]} (slice contiguity);
         # local_usage_fn() -> {tenant: chips in use} (this shard's half
         # of the global rollup); peers_fn() -> election leaders()
-        # ({shard: {holder, url, fence, expired}}) for the peer scrape.
+        # ({shard: {holder, url, fence, expired}}) for the peer scrape;
+        # node_excluded_fn(node) -> bool (cordoned/draining/suspect —
+        # the gateway binds the node-health tracker) prunes candidates
+        # whose node is no migration source.
         self.leases_fn = leases_fn
         self.groups_fn = groups_fn
         self.local_usage_fn = local_usage_fn
         self.peers_fn = peers_fn
+        self.node_excluded_fn = node_excluded_fn
         self.replica = replica
         self.scrape_timeout_s = scrape_timeout_s
         self._lock = threading.Lock()
@@ -274,8 +278,21 @@ class FleetTopology:
         except Exception:    # noqa: BLE001 — view degrades, never dies
             logger.exception("lease listing failed")
             return []
+        # Staleness guards: a candidate computed from last tick's world
+        # must not survive its group's teardown or its node's fencing —
+        # a dead candidate in /fleetz would re-emit its event (and feed
+        # the actuator a move against a gone group).
+        live_groups: set[str] | None = None
+        if self.groups_fn is not None:
+            try:
+                live_groups = set(self.groups_fn() or {})
+            except Exception:    # noqa: BLE001 — skip the guard, not
+                live_groups = None            # the whole report
         out: list[dict] = []
         for lease in leases:
+            if lease.group and live_groups is not None \
+                    and lease.group not in live_groups:
+                continue    # group torn down between ticks
             node = lease.node
             if node not in payloads and lease.uuids:
                 # re-derived leases may lack a node; join by device uuid
@@ -285,6 +302,12 @@ class FleetTopology:
                         break
             if node not in payloads:
                 continue
+            if self.node_excluded_fn is not None:
+                try:
+                    if self.node_excluded_fn(node):
+                        continue    # fenced/cordoned between ticks
+                except Exception:    # noqa: BLE001 — guard degrades
+                    pass             # open, never kills the report
             payload = payloads[node]
             owner = f"{lease.namespace}/{lease.pod}"
             freed = {tuple(c["coord"]) for c in payload["chips"]

@@ -406,8 +406,75 @@ def assert_topology_invariants(topology_section: dict) -> None:
         assert cand["node"] in nodes, cand
 
 
+def assert_defrag_invariants(broker, store=None, actuator=None) -> None:
+    """The defragmenter's safety contract (master/defrag.py), checkable
+    at ANY settled instant of a chaos plan:
+
+    1. **No move on a busy lease**: every lease the defragmenter names —
+       a journaled record or a standing plan — is idle (the PR 10
+       ``idle_since_unix`` signal every interlock gates on). A busy
+       lease in the plan set means an interlock was skipped.
+    2. **No group below strength mid-move**: a group named by any defrag
+       record holds AT LEAST its recorded membership — grow-first means
+       the old member leaves only after the new one landed, so a
+       shrunken group under an open record is a degrading move.
+    3. **No orphaned journal records**: ``planned`` records correspond
+       to standing plans in the live actuator; ``acting`` records exist
+       only while a move (or its failover adoption) is genuinely in
+       flight. With no actuator (``TPU_DEFRAG_MODE=0`` or a plan that
+       never enabled one), the journal must be empty — a record nobody
+       will ever adopt is leaked intent. No record is torn.
+    """
+    records = []
+    if store is not None:
+        for shard in range(store.ring.shards):
+            shard_records, torn = store.rehydrate_defrag_moves(shard)
+            assert torn == 0, \
+                f"shard {shard}: {torn} torn defrag record(s)"
+            records.extend(shard_records)
+    if actuator is None:
+        assert not records, \
+            f"{len(records)} defrag record(s) journaled with no " \
+            f"actuator to ever adopt them: " \
+            f"{[(r.group, r.pod, r.state) for r in records]}"
+        return
+    with actuator._lock:
+        plans = {(p["namespace"], p["group"], p["pod"])
+                 for p in actuator._plans.values()}
+        inflight = actuator._inflight
+        adopting = set(actuator._adopting)
+    named = [(r.namespace, r.pod, r.group, r.hosts) for r in records]
+    for record in records:
+        key = (record.namespace, record.group, record.pod)
+        if record.state == "planned":
+            assert key in plans, \
+                f"ORPHANED defrag record: planned move {key} has no " \
+                f"standing plan in the actuator"
+        else:
+            assert inflight > 0 or adopting, \
+                f"ORPHANED defrag record: acting move {key} with no " \
+                f"move in flight and no adoption running"
+    groups = broker.leases.groups()
+    for namespace, pod, group, hosts in named:
+        members = groups.get(group) or []
+        if members and hosts:
+            assert len(members) >= hosts, \
+                f"group {group} BELOW STRENGTH mid-move: " \
+                f"{len(members)} member(s), record says {hosts}"
+    with actuator._lock:
+        standing = [dict(p) for p in actuator._plans.values()]
+    for plan in standing + [
+            {"namespace": r.namespace, "pod": r.pod} for r in records]:
+        lease = broker.leases.get(plan["namespace"], plan["pod"])
+        if lease is None:
+            continue    # already moved or released — nothing to judge
+        assert lease.idle_since_unix is not None, \
+            f"defrag names BUSY lease {plan['namespace']}/" \
+            f"{plan['pod']} (no idle signal): an interlock was skipped"
+
+
 def assert_broker_invariants(broker, sim, store=None,
-                             health=None) -> None:
+                             health=None, defrag=None) -> None:
     """The broker-layer contract after any contention / lease-race /
     preemption / master-restart plan (rides on top of
     :func:`assert_invariants`, which owns the node-local guarantees):
@@ -427,9 +494,13 @@ def assert_broker_invariants(broker, sim, store=None,
        would rehydrate is the truth, not a stale or doubled ledger.
     4. **Node-death clauses** (``health`` given — the master's
        NodeHealthTracker): see :func:`assert_node_death_invariants`.
+    5. **Defrag clauses** (``store`` and/or ``defrag`` — the gateway's
+       DefragActuator — given): see :func:`assert_defrag_invariants`.
     """
     if health is not None:
         assert_node_death_invariants(broker, health)
+    if store is not None or defrag is not None:
+        assert_defrag_invariants(broker, store=store, actuator=defrag)
     from gpumounter_tpu.k8s import objects
     from gpumounter_tpu.utils import consts
     held: dict[tuple[str, str], int] = {}

@@ -789,6 +789,36 @@ class MultiNodeStack:
         self.master_kube.put_pod(pod)
         return pod
 
+    def fragment(self, chips: list[int],
+                 idle: tuple[int, ...] = ()) -> dict[int, str]:
+        """Deterministically fragment the fleet: node ``i``'s
+        ``workload-i`` becomes a single-pod slice GROUP holding
+        ``chips[i]`` chips (0 = leave the node untouched), and nodes in
+        ``idle`` get the PR 10 idle stamp on their lease — the exact
+        shape the defrag suite needs (group leases are the only thing
+        the defragmenter may move, idleness its hardest interlock).
+        Returns ``{i: group}`` for the attached nodes."""
+        import json as json_mod
+        out: dict[int, str] = {}
+        for i, n in enumerate(chips):
+            if not n:
+                continue
+            body = json_mod.dumps({
+                "pods": [{"namespace": "default",
+                          "pod": f"workload-{i}"}],
+                "tpusPerHost": n}).encode()
+            status, payload = self.gateway.handle(
+                "POST", "/addtpuslice", body)
+            assert status == 200 and payload["result"] == "SUCCESS", \
+                (status, payload)
+            out[i] = payload["group"]
+        for i in idle:
+            lease = self.gateway.broker.leases.get(
+                "default", f"workload-{i}")
+            assert lease is not None, f"no lease to idle on node-{i}"
+            lease.idle_since_unix = time.time()
+        return out
+
     # -- node failure primitives -----------------------------------------------
 
     def kill_node(self, i: int) -> None:

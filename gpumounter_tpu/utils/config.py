@@ -190,6 +190,15 @@ class Settings:
     # every new series, so existing endpoints answer exactly the
     # pre-topology payloads.
     topology_enabled: bool = True
+    # Fleet defragmenter (master/defrag.py): the actuator over the
+    # topology plane's candidate report. "plan" (default) journals plans
+    # without actuating; "act" executes grow-first migrations through
+    # the slice repair seam; "0" removes the subsystem byte-for-byte.
+    defrag_mode: str = "plan"               # "0" | "plan" | "act"
+    defrag_hysteresis_ticks: int = consts.DEFAULT_DEFRAG_HYSTERESIS_TICKS
+    defrag_idle_duty_max: float = consts.DEFAULT_DEFRAG_IDLE_DUTY_MAX
+    defrag_max_inflight: int = consts.DEFAULT_DEFRAG_MAX_INFLIGHT
+    defrag_budget: int = consts.DEFAULT_DEFRAG_BUDGET
     # Graceful worker drain (worker/drain.py): how long the SIGTERM /
     # /drainz sequence waits for in-flight actuation to settle before
     # the gRPC server goes down anyway.
@@ -310,6 +319,38 @@ class Settings:
             s.attach_cache_ttl_s = float(t)
         s.usage_enabled = env.get(consts.ENV_USAGE, "1") != "0"
         s.topology_enabled = env.get(consts.ENV_TOPOLOGY, "1") != "0"
+        mode = env.get(consts.ENV_DEFRAG_MODE, "plan")
+        if mode not in ("0", "plan", "act"):
+            raise ValueError(
+                f"{consts.ENV_DEFRAG_MODE} must be 0|plan|act, got {mode!r}")
+        s.defrag_mode = mode
+        if t := env.get(consts.ENV_DEFRAG_HYSTERESIS_TICKS):
+            s.defrag_hysteresis_ticks = int(t)
+            if s.defrag_hysteresis_ticks < 1:
+                raise ValueError(
+                    f"{consts.ENV_DEFRAG_HYSTERESIS_TICKS} must be >= 1 "
+                    f"(a 0-tick hysteresis moves on a single noisy "
+                    f"observation), got {t!r}")
+        if t := env.get(consts.ENV_DEFRAG_IDLE_DUTY_MAX):
+            s.defrag_idle_duty_max = float(t)
+            if not 0.0 <= s.defrag_idle_duty_max <= 1.0:
+                raise ValueError(
+                    f"{consts.ENV_DEFRAG_IDLE_DUTY_MAX} must be within "
+                    f"[0, 1] (it is a duty-cycle fraction), got {t!r}")
+        if t := env.get(consts.ENV_DEFRAG_MAX_INFLIGHT):
+            s.defrag_max_inflight = int(t)
+            if s.defrag_max_inflight < 1:
+                raise ValueError(
+                    f"{consts.ENV_DEFRAG_MAX_INFLIGHT} must be >= 1; use "
+                    f"{consts.ENV_DEFRAG_MODE}=plan to stop actuation, "
+                    f"got {t!r}")
+        if t := env.get(consts.ENV_DEFRAG_BUDGET):
+            s.defrag_budget = int(t)
+            if s.defrag_budget < 1:
+                raise ValueError(
+                    f"{consts.ENV_DEFRAG_BUDGET} must be >= 1; use "
+                    f"{consts.ENV_DEFRAG_MODE}=plan to stop actuation, "
+                    f"got {t!r}")
         if t := env.get(consts.ENV_USAGE_INTERVAL_S):
             s.usage_interval_s = float(t)
             if s.usage_interval_s <= 0:

@@ -237,6 +237,34 @@ DEFAULT_IDLE_LEASE_S = 300.0
 # existing endpoint answers byte-for-byte the pre-topology payloads.
 ENV_TOPOLOGY = "TPU_TOPOLOGY"
 
+# --- Fleet defragmenter (master/defrag.py) ------------------------------------
+# Staged enablement of the actuator that CONSUMES the topology plane's
+# defrag-candidate report. "plan" (default): compute + journal migration
+# plans, emit defrag_plan events and the /fleetz defrag.plans section,
+# actuate NOTHING. "act": execute plans as grow-first migrations through
+# the SliceTxnManager repair seam. "0": the actuator does not exist —
+# no thread, no routes, no series; every endpoint answers byte-for-byte
+# the pre-defrag payloads (like TPU_TOPOLOGY=0).
+ENV_DEFRAG_MODE = "TPU_DEFRAG_MODE"
+# A candidate must persist this many CONSECUTIVE fleet ticks before it is
+# eligible to move (hysteresis against churning placements).
+ENV_DEFRAG_HYSTERESIS_TICKS = "TPU_DEFRAG_HYSTERESIS_TICKS"
+DEFAULT_DEFRAG_HYSTERESIS_TICKS = 3
+# Only idle leases ever move: max observed duty cycle (0..1) a lease may
+# show and still be migrated.
+ENV_DEFRAG_IDLE_DUTY_MAX = "TPU_DEFRAG_IDLE_DUTY_MAX"
+DEFAULT_DEFRAG_IDLE_DUTY_MAX = 0.05
+# Fleet-wide cap on concurrently in-flight defrag migrations (per-group
+# exclusivity is separate: defrag shares the repair_group guard).
+ENV_DEFRAG_MAX_INFLIGHT = "TPU_DEFRAG_MAX_INFLIGHT"
+DEFAULT_DEFRAG_MAX_INFLIGHT = 1
+# Sliding-window migration budget: at most this many moves per
+# DEFRAG_BUDGET_WINDOW_S; exhausting it HALTS the actuator (and charges
+# a slot for any move whose post-check shows no score improvement).
+ENV_DEFRAG_BUDGET = "TPU_DEFRAG_BUDGET"
+DEFAULT_DEFRAG_BUDGET = 4
+DEFRAG_BUDGET_WINDOW_S = 1800.0
+
 # --- Master gateway front (master/httpfront.py) --------------------------------
 # "multiplexed" (default): bounded selector + worker-pool front with
 # HTTP/1.1 keep-alive and connection admission before thread allocation.
@@ -299,6 +327,7 @@ ELECTION_CONFIGMAP_PREFIX = "tpu-mounter-election-"
 STORE_LEASE_ANNOTATION_PREFIX = "tpumounter.io/l-"
 STORE_WAITER_ANNOTATION_PREFIX = "tpumounter.io/w-"
 STORE_SLICE_ANNOTATION_PREFIX = "tpumounter.io/s-"
+STORE_DEFRAG_ANNOTATION_PREFIX = "tpumounter.io/defrag-"
 STORE_FENCE_ANNOTATION = "tpumounter.io/fence"
 # Cross-shard capacity nudge (master/store.py poke_peers): a detach on
 # one shard's leader frees node chips another shard's parked waiters may

@@ -831,6 +831,27 @@ class Registry:
             "relocation would merge free blocks into a schedulable "
             "slice, by node — paired 1:1 with defrag_candidate events")
         self.defrag_candidates.inc(0.0, node="")
+        # Fleet defragmenter (master/defrag.py): the actuator over the
+        # candidate report. Every plan/move transition crosses the
+        # _note_move seam (lint-pinned), so counter and event can never
+        # drift. planned = plan journaled; migrated = grow-first move
+        # landed; deferred = interlock or busy refusal postponed it with
+        # the group intact; aborted = mid-move failure rolled back (or a
+        # failover adopted a torn plan); budget_exhausted = the sliding-
+        # window budget halted the actuator. All series vanish under
+        # TPU_DEFRAG_MODE=0.
+        self.defrag_moves = Counter(
+            "tpumounter_defrag_moves_total",
+            "Defrag migration transitions by outcome (planned / migrated"
+            " / deferred / aborted / budget_exhausted) — paired 1:1 with"
+            " defrag_plan/defrag_move events")
+        for outcome in ("planned", "migrated", "deferred", "aborted",
+                        "budget_exhausted"):
+            self.defrag_moves.inc(0.0, outcome=outcome)
+        self.defrag_inflight = Gauge(
+            "tpumounter_defrag_inflight",
+            "Defrag migrations currently in flight (journaled and "
+            "actuating; bounded by TPU_DEFRAG_MAX_INFLIGHT)")
         # Device-access accounting (the gpu_ext audit-counter half):
         # every observed idle→busy transition of a chip's device node is
         # one "open". outcome=attributed names the owning tenant (the

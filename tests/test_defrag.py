@@ -1,0 +1,697 @@
+"""Safe fleet defragmenter (ISSUE 18).
+
+Unit coverage for the actuator's interlocks (hysteresis counted on real
+fleet ticks, idle-only, cordoned-source exclusion, duty/busy-chip
+refusal, the in-flight cap, the sliding budget and its halt transition,
+the post-move score check charging thrash) and the failover adoption
+decision table; then the acceptance e2es — an act-mode 4-host fragmented
+fleet consolidates through the repair seam (score strictly drops, the
+freed host is schedulable again, busy gangs never move), a master
+SIGKILL'd mid-move leaves the group at exactly the old or the new
+placement depending on whether the adopted grow could complete,
+plan mode journals and reports but never actuates, and
+TPU_DEFRAG_MODE=0 removes the actuator and its /fleetz section.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import types
+import urllib.request
+
+import pytest
+
+from gpumounter_tpu.master import defrag as defrag_mod
+from gpumounter_tpu.master.admission import BrokerConfig
+from gpumounter_tpu.master.defrag import DefragActuator
+from gpumounter_tpu.master.store import DefragMoveRecord
+from gpumounter_tpu.testing.chaos import (assert_defrag_invariants,
+                                          assert_slice_invariants)
+from gpumounter_tpu.testing.sim import (MultiMasterStack, MultiNodeStack,
+                                        WorkerRig)
+from gpumounter_tpu.utils import consts
+from gpumounter_tpu.utils.config import HostPaths
+from gpumounter_tpu.utils.metrics import REGISTRY
+
+NS = consts.DEFAULT_POOL_NAMESPACE
+
+
+def _get_json(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _run_cli(base, *argv):
+    import contextlib
+    import io
+
+    from gpumounter_tpu import cli
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli.main(["--master", base, *argv])
+    return rc, out.getvalue()
+
+
+def _host(tmp_path, i):
+    base = tmp_path / f"node{i}"
+    for sub in ("dev", "proc", "sys/fs/cgroup"):
+        (base / sub).mkdir(parents=True)
+    return HostPaths(dev_root=str(base / "dev"),
+                     proc_root=str(base / "proc"),
+                     sys_root=str(base / "sys"),
+                     cgroup_root=str(base / "sys" / "fs" / "cgroup"),
+                     kubelet_socket=str(base / "pr" / "kubelet.sock"))
+
+
+def _wait(predicate, timeout_s=20.0, message=""):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(message or "condition never held")
+
+
+# -- unit rig: a fake repair seam + a scripted topology view -------------------
+
+class _Slices:
+    """The repair seam, scripted: records every migrate_member call and
+    answers with a canned result; group membership is a plain dict."""
+
+    def __init__(self):
+        self.members: dict[str, list] = {}
+        self.calls: list[tuple] = []
+        self.result: dict = {"outcome": "migrated", "generation": 2,
+                             "added": [("default", "spare-0")]}
+        self.inflight_rids: set[str] = set()
+        self.finished: list[tuple] = []
+        self.finish_ok = True
+        self.broker = types.SimpleNamespace(
+            leases=types.SimpleNamespace(
+                group_leases=lambda g: list(self.members.get(g, []))),
+            _on_fenced=lambda e: None)
+
+    def migrate_member(self, group, member, rid):
+        self.calls.append((group, tuple(member), rid))
+        return dict(self.result)
+
+    def txn_inflight(self, rid):
+        return rid in self.inflight_rids
+
+    def finish_member_detach(self, group, member, rid):
+        self.finished.append((group, tuple(member), rid))
+        return self.finish_ok
+
+
+class _ViewBox:
+    """A hand-cranked FleetTopology.snapshot(): tests advance the tick
+    counter explicitly — the actuator must count THESE, not its own
+    wakeups."""
+
+    def __init__(self):
+        self.ticks = 0
+        self.score = 0.7
+        self.cands: list[dict] = []
+
+    def snapshot(self):
+        return {"enabled": True, "ticks": self.ticks,
+                "fleet": {"score": self.score, "nodes": {"node-0": {}},
+                          "defrag_candidates": [dict(c)
+                                                for c in self.cands]}}
+
+
+def _cand(**kw):
+    base = {"namespace": "default", "pod": "w0", "tenant": "t",
+            "node": "node-0", "chips": 1, "gain": 2, "idle": True,
+            "group": "g1"}
+    base.update(kw)
+    return base
+
+
+def _lease(ns="default", pod="w0"):
+    return types.SimpleNamespace(namespace=ns, pod=pod)
+
+
+def _actuator(sl, box, **kw):
+    kw.setdefault("mode", "act")
+    kw.setdefault("hysteresis_ticks", 3)
+    kw.setdefault("max_inflight", 1)
+    kw.setdefault("budget", 4)
+    return DefragActuator(slices=sl, view_fn=box.snapshot, **kw)
+
+
+def _round(box, act):
+    box.ticks += 1
+    act.tick()
+
+
+def test_hysteresis_counts_fleet_ticks_not_wakeups():
+    sl, box = _Slices(), _ViewBox()
+    sl.members["g1"] = [_lease()]
+    box.cands = [_cand()]
+    act = _actuator(sl, box, hysteresis_ticks=3)
+    for _ in range(2):
+        _round(box, act)
+    # extra wakeups against an UNCHANGED fleet tick must not advance
+    # the streak — the whole point of gating on the view's counter
+    for _ in range(5):
+        act.tick()
+    assert sl.calls == []
+    _round(box, act)                       # 3rd real fleet tick
+    assert len(sl.calls) == 1
+    assert sl.calls[0][:2] == ("g1", ("default", "w0"))
+
+
+def test_candidate_vanishing_resets_the_streak():
+    sl, box = _Slices(), _ViewBox()
+    sl.members["g1"] = [_lease()]
+    box.cands = [_cand()]
+    act = _actuator(sl, box, hysteresis_ticks=3)
+    _round(box, act)
+    _round(box, act)
+    box.cands = []                         # gone for one tick
+    _round(box, act)
+    box.cands = [_cand()]
+    _round(box, act)                       # streak restarts at 1
+    _round(box, act)
+    assert sl.calls == []
+    _round(box, act)
+    assert len(sl.calls) == 1
+
+
+@pytest.mark.parametrize("why,cand_kw,act_kw", [
+    ("not idle", {"idle": False}, {}),
+    ("not a group lease", {"group": ""}, {}),
+    ("cordoned source", {}, {"node_excluded_fn": lambda node: True}),
+    ("duty above threshold", {},
+     {"activity_fn": lambda: {("default", "w0"): {"duty": 0.5,
+                                                  "busy_chips": 0}}}),
+    ("busy chips", {},
+     {"activity_fn": lambda: {("default", "w0"): {"duty": 0.0,
+                                                  "busy_chips": 1}}}),
+])
+def test_interlocks_never_issue_the_move(why, cand_kw, act_kw):
+    sl, box = _Slices(), _ViewBox()
+    sl.members["g1"] = [_lease()]
+    box.cands = [_cand(**cand_kw)]
+    act = _actuator(sl, box, hysteresis_ticks=1, **act_kw)
+    for _ in range(5):
+        _round(box, act)
+    assert sl.calls == [], why
+    assert act.fleetz_section()["plans"] == [], why
+
+
+def test_repair_in_flight_defers_and_keeps_the_group():
+    """The per-group guard is SHARED with repair_group: the seam answers
+    "repair in flight" and the actuator records a deferral — nothing
+    retried in the same pass, nothing torn down."""
+    sl, box = _Slices(), _ViewBox()
+    sl.members["g1"] = [_lease()]
+    sl.result = {"outcome": "deferred", "why": "repair in flight"}
+    box.cands = [_cand()]
+    act = _actuator(sl, box, hysteresis_ticks=1)
+    _round(box, act)
+    assert len(sl.calls) == 1
+    recent = act.fleetz_section()["recent"]
+    assert recent[0]["outcome"] == "deferred"
+    assert recent[0]["why"] == "repair in flight"
+    assert sl.members["g1"]                 # group untouched
+
+
+def test_budget_exhaustion_halts_until_the_window_slides():
+    sl, box = _Slices(), _ViewBox()
+    sl.members["g1"] = [_lease()]
+    box.cands = [_cand()]
+    base = REGISTRY.defrag_moves.value(outcome="budget_exhausted")
+    act = _actuator(sl, box, hysteresis_ticks=1, budget=2)
+    _round(box, act)
+    box.score = 0.6          # each move improves the score: the only
+    _round(box, act)         # budget charges are the moves themselves
+    assert len(sl.calls) == 2
+    # third and fourth pass: budget spent — halted, ONE transition note
+    box.score = 0.5
+    _round(box, act)
+    _round(box, act)
+    assert len(sl.calls) == 2
+    assert REGISTRY.defrag_moves.value(outcome="budget_exhausted") \
+        == base + 1
+    assert act.fleetz_section()["budget"]["exhausted"] is True
+    # the window slides: stamps age out, the actuator resumes
+    act._move_stamps[:] = [time.monotonic()
+                           - consts.DEFRAG_BUDGET_WINDOW_S - 1.0] * 2
+    _round(box, act)
+    assert len(sl.calls) == 3
+    assert act.fleetz_section()["budget"]["exhausted"] is False
+
+
+def test_failed_score_check_charges_budget_and_rearms_hysteresis():
+    sl, box = _Slices(), _ViewBox()
+    sl.members["g1"] = [_lease()]
+    box.cands = [_cand()]
+    act = _actuator(sl, box, hysteresis_ticks=2, budget=10)
+    _round(box, act)
+    _round(box, act)                       # streak 2 -> move
+    assert len(sl.calls) == 1
+    # the fleet score never improves: the NEXT tick's verify pass
+    # charges the budget and clears the group's streak
+    _round(box, act)
+    assert len(act._move_stamps) == 2      # the move + the charge
+    assert sl.calls and len(sl.calls) == 1
+    recent = act.fleetz_section()["recent"]
+    assert recent[0]["outcome"] == "migrated"
+    assert recent[0]["improved"] is False
+    # hysteresis re-armed: one more tick is not enough again
+    _round(box, act)
+    assert len(sl.calls) == 2
+
+
+def test_improved_score_does_not_charge_the_budget():
+    sl, box = _Slices(), _ViewBox()
+    sl.members["g1"] = [_lease()]
+    box.cands = [_cand()]
+    act = _actuator(sl, box, hysteresis_ticks=1, budget=10)
+    _round(box, act)
+    assert len(sl.calls) == 1
+    box.score = 0.4                        # the move worked
+    box.cands = []
+    _round(box, act)
+    assert len(act._move_stamps) == 1      # the move only, no charge
+    assert act.fleetz_section()["recent"][0]["improved"] is True
+
+
+def test_plan_mode_journals_and_reports_but_never_actuates():
+    sl, box = _Slices(), _ViewBox()
+    sl.members["g1"] = [_lease()]
+    box.cands = [_cand()]
+    base = REGISTRY.defrag_moves.value(outcome="planned")
+    act = _actuator(sl, box, mode="plan", hysteresis_ticks=1)
+    for _ in range(4):
+        _round(box, act)
+    assert sl.calls == []
+    section = act.fleetz_section()
+    assert section["mode"] == "plan"
+    assert [p["pod"] for p in section["plans"]] == ["w0"]
+    assert REGISTRY.defrag_moves.value(outcome="planned") == base + 1
+
+
+# -- failover adoption: the decision table -------------------------------------
+
+class _Store:
+    def __init__(self):
+        self.put: list = []
+        self.deleted: list = []
+
+    def put_defrag_move(self, record):
+        self.put.append(record)
+
+    def delete_defrag_move(self, namespace, group, pod):
+        self.deleted.append((namespace, group, pod))
+
+
+def _record(**kw):
+    base = dict(group="g1", namespace="default", pod="w0", rid="r1",
+                hosts=1, src_node="node-0", state="acting")
+    base.update(kw)
+    return DefragMoveRecord(**base)
+
+
+def _adopt_one(sl, record):
+    store = _Store()
+    act = DefragActuator(slices=sl, view_fn=lambda: None, store=store)
+    assert act.adopt([record]) == (1 if record.state == "acting" else 0)
+    act.join_adoptions()
+    return act, store
+
+
+def test_adopt_planned_record_drops_quietly():
+    sl = _Slices()
+    act, store = _adopt_one(sl, _record(state="planned"))
+    assert store.deleted == [("default", "g1", "w0")]
+    assert sl.finished == []
+
+
+def test_adopt_group_gone_aborts():
+    sl = _Slices()                          # no members at all
+    base = REGISTRY.defrag_moves.value(outcome="aborted")
+    act, store = _adopt_one(sl, _record())
+    assert store.deleted == [("default", "g1", "w0")]
+    assert REGISTRY.defrag_moves.value(outcome="aborted") == base + 1
+
+
+def test_adopt_completed_move_is_migrated():
+    sl = _Slices()
+    sl.members["g1"] = [_lease(pod="spare-0")]      # old member gone
+    base = REGISTRY.defrag_moves.value(outcome="migrated")
+    act, store = _adopt_one(sl, _record())
+    assert sl.finished == []                # nothing left to detach
+    assert store.deleted == [("default", "g1", "w0")]
+    assert REGISTRY.defrag_moves.value(outcome="migrated") == base + 1
+
+
+def test_adopt_landed_grow_finishes_the_detach():
+    sl = _Slices()
+    sl.members["g1"] = [_lease(), _lease(pod="spare-0")]
+    act, store = _adopt_one(sl, _record())
+    assert sl.finished == [("g1", ("default", "w0"), "r1")]
+    assert store.deleted == [("default", "g1", "w0")]
+
+
+def test_adopt_unlanded_grow_aborts_to_old_placement():
+    sl = _Slices()
+    sl.members["g1"] = [_lease()]           # exactly the old world
+    base = REGISTRY.defrag_moves.value(outcome="aborted")
+    act, store = _adopt_one(sl, _record())
+    assert sl.finished == []
+    assert store.deleted == [("default", "g1", "w0")]
+    assert REGISTRY.defrag_moves.value(outcome="aborted") == base + 1
+
+
+def test_adopt_waits_for_the_inflight_slice_txn():
+    sl = _Slices()
+    sl.members["g1"] = [_lease(), _lease(pod="spare-0")]
+    sl.inflight_rids.add("r1")
+    store = _Store()
+    act = DefragActuator(slices=sl, view_fn=lambda: None, store=store)
+    act.adopt([_record()])
+    time.sleep(0.2)
+    assert sl.finished == []                # still polling
+    sl.inflight_rids.discard("r1")
+    act.join_adoptions()
+    assert sl.finished == [("g1", ("default", "w0"), "r1")]
+
+
+# -- acceptance e2e: consolidation through the repair seam ---------------------
+
+def test_e2e_act_mode_consolidates_the_fragmented_fleet(tmp_path,
+                                                        monkeypatch):
+    """The PR's acceptance bar: a 4-host fleet fragmented by one idle
+    1-chip group and three busy 2-chip gangs. In act mode the actuator
+    waits out hysteresis, then migrates ONLY the idle group onto the
+    spare host through the repair seam — the fleet score strictly
+    drops, the freed host schedules a full 4-chip mount again, and the
+    busy gangs never move."""
+    monkeypatch.setenv(consts.ENV_DEFRAG_MODE, "act")
+    stack = MultiNodeStack([_host(tmp_path, i) for i in range(4)],
+                           n_chips=4, health=True, topo=True,
+                           broker_config=BrokerConfig())
+    base_migrated = REGISTRY.defrag_moves.value(outcome="migrated")
+    try:
+        defrag = stack.gateway.defrag
+        assert defrag is not None and defrag.mode == "act"
+        defrag.stop()                      # drive ticks by hand
+        groups = stack.fragment([1, 2, 2, 2], idle=(0,))
+        stack.add_workload(3, "spare-0", spare=True)
+        busy_before = {
+            i: (lease.node, lease.chips)
+            for i in (1, 2, 3)
+            for lease in [stack.gateway.broker.leases.get(
+                "default", f"workload-{i}")]}
+
+        stack.gateway.fleet.tick()
+        before = _get_json(f"{stack.base}/fleetz")
+        pre_score = before["topology"]["score"]
+        assert pre_score == pytest.approx(1 - 2 / 9, abs=1e-3)
+        # the plan set is visible on /fleetz before anything moves
+        defrag.tick()
+        assert before["defrag"]["mode"] == "act"
+
+        for _ in range(6):
+            if REGISTRY.defrag_moves.value(outcome="migrated") \
+                    > base_migrated:
+                break
+            stack.gateway.fleet.tick()
+            defrag.tick()
+        assert REGISTRY.defrag_moves.value(outcome="migrated") \
+            == base_migrated + 1
+
+        # the idle group now lives on the spare host; the old member
+        # detached cleanly (no slave pod left on node-0)
+        members = stack.gateway.broker.leases.group_leases(groups[0])
+        assert [(m.pod, m.node) for m in members] == \
+            [("spare-0", "node-3")]
+        assert stack.rigs[0].sim.slave_pods() == []
+        assert stack.gateway.broker.leases.get(
+            "default", "workload-0") is None
+        # busy gangs never moved
+        for i, (node, chips) in busy_before.items():
+            lease = stack.gateway.broker.leases.get(
+                "default", f"workload-{i}")
+            assert (lease.node, lease.chips) == (node, chips), i
+
+        # score strictly drops and node-0 merged whole
+        stack.gateway.fleet.tick()
+        defrag.tick()                      # the verify pass (improved)
+        after = _get_json(f"{stack.base}/fleetz")
+        assert after["topology"]["score"] < pre_score
+        assert after["topology"]["nodes"]["node-0"][
+            "largest_free_block"] == 4
+        recent = after["defrag"]["recent"]
+        assert recent and recent[0]["outcome"] == "migrated"
+        assert recent[0]["group"] == groups[0]
+        # a successful move never charges the budget
+        assert after["defrag"]["budget"]["used"] == 1
+
+        # the freed host is schedulable again: a full-host mount lands
+        body = _get_json(
+            f"{stack.base}/addtpu/namespace/default/pod/workload-0"
+            f"/tpu/4/isEntireMount/true", timeout=60)
+        assert body["result"] == "SUCCESS", body
+
+        assert_defrag_invariants(stack.gateway.broker,
+                                 actuator=defrag)
+        assert_slice_invariants(stack.gateway.broker,
+                                [rig.sim for rig in stack.rigs])
+
+        # tpumounterctl defrag renders the move ring and the budget;
+        # exhausting the budget flips the exit code non-zero
+        rc, out = _run_cli(stack.base, "defrag")
+        assert rc == 0, out
+        assert "mode act" in out and "MIGRATED" in out
+        with defrag._lock:
+            defrag._move_stamps = [time.monotonic()] * defrag.budget
+            defrag._budget_exhausted = True
+        rc, out = _run_cli(stack.base, "defrag")
+        assert rc != 0, out
+        assert "BUDGET EXHAUSTED" in out
+    finally:
+        stack.close()
+
+
+# -- acceptance e2e: SIGKILL mid-move ------------------------------------------
+
+class _MasterCrash(BaseException):
+    """Simulated master death mid-move: a BaseException skips every
+    Exception-typed cleanup on the way out — no rollback, no record
+    retirement, exactly what SIGKILL leaves."""
+
+
+def _store_defrag_records(kube) -> list[DefragMoveRecord]:
+    from gpumounter_tpu.utils.errors import K8sApiError
+    try:
+        cm = kube.get_config_map(NS, f"{consts.STORE_CONFIGMAP_PREFIX}0")
+    except K8sApiError:
+        return []
+    out = []
+    for key, value in (cm["metadata"].get("annotations") or {}).items():
+        if key.startswith(consts.STORE_DEFRAG_ANNOTATION_PREFIX):
+            out.append(DefragMoveRecord.from_json(value))
+    return out
+
+
+def _crash_stack(tmp_path, monkeypatch, queue_timeout_s):
+    monkeypatch.setenv(consts.ENV_DEFRAG_MODE, "act")
+    rigs = [WorkerRig(_host(tmp_path, i), n_chips=4, node=f"node-{i}",
+                      pod_name=f"workload-{i}") for i in range(2)]
+    stack = MultiMasterStack(
+        rigs=rigs, masters=2, shards=1,
+        broker_config=BrokerConfig(queue_timeout_s=queue_timeout_s,
+                                   tick_interval_s=0.1))
+    stack.wait_converged()
+    # the spare destination on node-1, visible to the masters AND
+    # provisioned on its node's worker
+    spare = stack.rigs[1].sim.add_target_pod(
+        name="spare-0", uid="uid-spare-0",
+        container_id="containerd://" + ("ab" * 32)[:64])
+    spare["metadata"]["labels"][consts.SLICE_SPARE_LABEL_KEY] = \
+        consts.SLICE_SPARE_LABEL_VALUE
+    stack.rigs[1].sim.kube.put_pod(spare)
+    stack.rigs[1].provision_container(spare)
+    stack.kube.put_pod(spare)
+    return stack
+
+
+def _crash_leader_mid_move(stack):
+    """Journal + start the move on the leader and SIGKILL it while the
+    grow is in flight: the defrag record (state=acting) and the slice
+    txn record survive on the store — the survivor's breadcrumbs.
+    Returns (group, leader index)."""
+    leader = stack.leader_for("default")
+    gateway = stack.gateways[leader]
+    status, payload = gateway.handle("POST", "/addtpuslice", json.dumps({
+        "pods": [{"namespace": "default", "pod": "workload-0"}],
+        "tpusPerHost": 4}).encode())
+    assert status == 200 and payload["result"] == "SUCCESS", payload
+    group = payload["group"]
+    # freeze the doomed leader's maintenance loops: a live master would
+    # self-heal its own crashed move — the record must be left for the
+    # SURVIVOR
+    gateway.broker.stop()
+    gateway.defrag.stop()
+    crashed = threading.Event()
+
+    def before_host_attach(namespace, pod):
+        if pod == "spare-0":
+            crashed.set()
+            raise _MasterCrash()
+
+    gateway.slices.before_host_attach = before_host_attach
+    plan = {"namespace": "default", "pod": "workload-0", "tenant": "",
+            "node": "node-0", "chips": 4, "gain": 2, "group": group,
+            "rid": "defrag-crash1", "created_unix": round(time.time(), 3)}
+    key = ("default", "workload-0", "node-0", group)
+
+    def run():
+        try:
+            gateway.defrag._execute(key, plan, 0.9, 1)
+        except BaseException:   # noqa: BLE001 — the simulated SIGKILL
+            pass
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    assert crashed.wait(timeout=30), "crash point never armed"
+    thread.join(timeout=10)
+    # the torn mid-state, asserted while the frozen leader still holds
+    # the lock: one acting defrag record, the group still whole at the
+    # OLD placement
+    records = _store_defrag_records(stack.kube)
+    assert [(r.group, r.pod, r.state, r.hosts) for r in records] == \
+        [(group, "workload-0", "acting", 1)]
+    assert len(stack.rigs[0].sim.slave_pods()) == 1
+    assert stack.rigs[1].sim.slave_pods() == []
+    stack.kill(leader)
+    return group, leader
+
+
+def _survivor(stack, dead):
+    [i] = [i for i in stack.live() if i != dead]
+    return stack.gateways[i]
+
+
+def test_e2e_crash_mid_move_survivor_completes_to_new_placement(
+        tmp_path, monkeypatch):
+    """Queue deadline still open at failover ⇒ the survivor finishes the
+    adopted grow txn under the original rid, then the defrag adoption
+    finishes the detach: the group lands WHOLE at the new placement."""
+    stack = _crash_stack(tmp_path, monkeypatch, queue_timeout_s=30)
+    try:
+        group, dead = _crash_leader_mid_move(stack)
+        surv = _survivor(stack, dead)
+        _wait(lambda: not _store_defrag_records(stack.kube),
+              timeout_s=30, message="defrag record never resolved")
+        surv.defrag.join_adoptions()
+        _wait(lambda: [
+            (m.pod, m.node) for m in
+            surv.broker.leases.group_leases(group)] ==
+            [("spare-0", "node-1")],
+            timeout_s=30, message="group never reached new placement")
+        assert len(stack.rigs[1].sim.slave_pods()) == 1
+        _wait(lambda: stack.rigs[0].sim.slave_pods() == [],
+              timeout_s=30, message="old member never detached")
+        assert_slice_invariants(surv.broker,
+                                [rig.sim for rig in stack.rigs],
+                                store=surv.broker.store)
+        assert_defrag_invariants(surv.broker, store=surv.broker.store,
+                                 actuator=surv.defrag)
+    finally:
+        stack.close()
+
+
+def test_e2e_crash_mid_move_survivor_aborts_to_old_placement(
+        tmp_path, monkeypatch):
+    """Queue deadline already passed at failover ⇒ the adopted grow txn
+    rolls back, the defrag adoption sees the grow never landed and
+    aborts: the group stays WHOLE at the old placement."""
+    stack = _crash_stack(tmp_path, monkeypatch, queue_timeout_s=0)
+    try:
+        group, dead = _crash_leader_mid_move(stack)
+        surv = _survivor(stack, dead)
+        _wait(lambda: not _store_defrag_records(stack.kube),
+              timeout_s=30, message="defrag record never resolved")
+        surv.defrag.join_adoptions()
+        members = surv.broker.leases.group_leases(group)
+        assert [(m.pod, m.node) for m in members] == \
+            [("workload-0", "node-0")]
+        assert len(stack.rigs[0].sim.slave_pods()) == 1
+        assert stack.rigs[1].sim.slave_pods() == []
+        assert_slice_invariants(surv.broker,
+                                [rig.sim for rig in stack.rigs],
+                                store=surv.broker.store)
+        assert_defrag_invariants(surv.broker, store=surv.broker.store,
+                                 actuator=surv.defrag)
+    finally:
+        stack.close()
+
+
+# -- plan mode + mode 0 --------------------------------------------------------
+
+def test_e2e_plan_mode_reports_but_never_moves(tmp_path):
+    """The staged-rollout default: plans appear on /fleetz and as
+    defrag_plan events, but nothing is ever actuated — no slave pod
+    moves, no migrated outcome, mode says plan."""
+    stack = MultiNodeStack([_host(tmp_path, i) for i in range(2)],
+                           n_chips=4, health=True, topo=True,
+                           broker_config=BrokerConfig())
+    base_migrated = REGISTRY.defrag_moves.value(outcome="migrated")
+    try:
+        defrag = stack.gateway.defrag
+        assert defrag is not None and defrag.mode == "plan"
+        defrag.stop()
+        stack.fragment([1, 2], idle=(0,))
+        stack.add_workload(1, "spare-0", spare=True)
+        slaves_before = [len(rig.sim.slave_pods())
+                         for rig in stack.rigs]
+        for _ in range(5):
+            stack.gateway.fleet.tick()
+            defrag.tick()
+        fleetz = _get_json(f"{stack.base}/fleetz")
+        section = fleetz["defrag"]
+        assert section["mode"] == "plan"
+        assert [p["pod"] for p in section["plans"]] == ["workload-0"]
+        eventz = _get_json(f"{stack.base}/eventz?limit=-1")
+        assert any(e["kind"] == "defrag_plan"
+                   and e.get("pod") == "workload-0"
+                   for e in eventz["events"])
+        assert REGISTRY.defrag_moves.value(outcome="migrated") \
+            == base_migrated
+        assert [len(rig.sim.slave_pods()) for rig in stack.rigs] \
+            == slaves_before
+        assert_defrag_invariants(stack.gateway.broker, actuator=defrag)
+        # the CLI labels plan mode and lists the standing plan
+        rc, out = _run_cli(stack.base, "defrag")
+        assert rc == 0, out
+        assert "mode plan" in out and "no moves" in out
+        assert "move default/workload-0" in out
+    finally:
+        stack.close()
+
+
+def test_e2e_mode_0_removes_the_actuator_and_section(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv(consts.ENV_DEFRAG_MODE, "0")
+    stack = MultiNodeStack([_host(tmp_path, 0)], n_chips=4,
+                           health=True, topo=True,
+                           broker_config=BrokerConfig())
+    try:
+        assert stack.gateway.defrag is None
+        stack.gateway.fleet.tick()
+        fleetz = _get_json(f"{stack.base}/fleetz")
+        assert "defrag" not in fleetz
+        assert "topology" in fleetz        # the measurement half stays
+        # the CLI reports the disabled defragmenter as a state, exit 0
+        rc, out = _run_cli(stack.base, "defrag")
+        assert rc == 0, out
+        assert "disabled" in out
+    finally:
+        stack.close()
